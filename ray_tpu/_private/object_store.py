@@ -1,0 +1,236 @@
+"""Node-local shared-memory object store client.
+
+Python side of ``ray_tpu/native/shm_store.cpp``. Every process on a node maps
+the same file under /dev/shm; create/seal/get/release are direct
+shared-memory calls into the native library — no daemon round trip on the hot
+path (contrast with the reference's plasma client/server unix-socket protocol,
+reference: src/ray/object_manager/plasma/client.cc).
+
+Reads are zero-copy: ``get`` returns memoryviews over the mapped arena, kept
+valid by a pin that is released when the returned buffer object is freed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+from ray_tpu.native.build import build
+
+ID_LEN = 20
+DEFAULT_STORE_BYTES = int(os.environ.get("RAY_TPU_OBJECT_STORE_BYTES", 2 * 1024**3))
+
+
+class _Lib:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            lib = ctypes.CDLL(build("shm_store"))
+            lib.rt_store_create.restype = ctypes.c_void_p
+            lib.rt_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+            lib.rt_store_open.restype = ctypes.c_void_p
+            lib.rt_store_open.argtypes = [ctypes.c_char_p]
+            lib.rt_store_close.argtypes = [ctypes.c_void_p]
+            lib.rt_store_base.restype = ctypes.c_void_p
+            lib.rt_store_base.argtypes = [ctypes.c_void_p]
+            lib.rt_store_capacity.restype = ctypes.c_uint64
+            lib.rt_store_capacity.argtypes = [ctypes.c_void_p]
+            lib.rt_store_total_size.restype = ctypes.c_uint64
+            lib.rt_store_total_size.argtypes = [ctypes.c_void_p]
+            lib.rt_create.restype = ctypes.c_int64
+            lib.rt_create.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_int,
+            ]
+            for fn in ("rt_seal", "rt_release", "rt_contains", "rt_delete", "rt_abort"):
+                f = getattr(lib, fn)
+                f.restype = ctypes.c_int
+                f.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rt_get.restype = ctypes.c_int64
+            lib.rt_get.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_int,
+            ]
+            lib.rt_evict.restype = ctypes.c_uint64
+            lib.rt_evict.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.rt_gc_unsealed.restype = ctypes.c_uint64
+            lib.rt_gc_unsealed.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.rt_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+            lib.rt_list.restype = ctypes.c_uint64
+            lib.rt_list.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+            cls._instance = super().__new__(cls)
+            cls._instance.lib = lib
+        return cls._instance
+
+
+def store_path(session_name: str, node_id_hex: str) -> str:
+    return f"/dev/shm/raytpu_{session_name}_{node_id_hex[:12]}"
+
+
+class SharedBuffer:
+    """A pinned view of an object's payload in the shared arena.
+
+    Holds the pin until ``close`` or garbage collection; slicing the
+    memoryview is zero-copy.
+    """
+
+    __slots__ = ("data", "metadata", "_client", "_oid", "_closed")
+
+    def __init__(self, client: "ObjectStoreClient", oid: bytes,
+                 data: memoryview, metadata: bytes):
+        self._client = client
+        self._oid = oid
+        self.data = data
+        self.metadata = metadata
+        self._closed = False
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self.data = None
+            self._client._release(self._oid)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class ObjectStoreClient:
+    """Maps the node's shared arena and exposes object operations."""
+
+    def __init__(self, path: str, create: bool = False,
+                 size: int = DEFAULT_STORE_BYTES):
+        self._lib = _Lib().lib
+        self.path = path
+        if create:
+            self._h = self._lib.rt_store_create(path.encode(), size)
+        else:
+            self._h = self._lib.rt_store_open(path.encode())
+        if not self._h:
+            raise OSError(f"failed to {'create' if create else 'open'} object store at {path}")
+        base = self._lib.rt_store_base(self._h)
+        total = self._lib.rt_store_total_size(self._h)
+        self._mem = (ctypes.c_uint8 * total).from_address(base)
+        self._view = memoryview(self._mem).cast("B")
+        # oid -> live pin count held by this client; used so close() can
+        # release pins a crashed/leaked SharedBuffer would otherwise hold
+        # forever, and so we never munmap while zero-copy views are live.
+        self._pins: dict = {}
+
+    # -- object ops ---------------------------------------------------------
+
+    def create(self, oid: bytes, data_size: int, meta_size: int = 0,
+               evictable: bool = True) -> Optional[Tuple[memoryview, memoryview]]:
+        """Allocate a buffer; returns (data_view, meta_view) to write into.
+
+        Returns None if the object already exists. Raises MemoryError if the
+        arena is full even after LRU eviction.
+        """
+        off = self._lib.rt_create(self._h, oid, data_size, meta_size,
+                                  1 if evictable else 0)
+        if off == -17:  # EEXIST
+            return None
+        if off < 0:
+            raise MemoryError(f"object store create failed (rc={off})")
+        data = self._view[off:off + data_size]
+        meta = self._view[off + data_size:off + data_size + meta_size]
+        return data, meta
+
+    def seal(self, oid: bytes) -> None:
+        rc = self._lib.rt_seal(self._h, oid)
+        if rc != 0:
+            raise KeyError(f"seal failed for {oid.hex()} rc={rc}")
+
+    def seal_and_release(self, oid: bytes) -> None:
+        # seal() resets pin_count; creator's implicit pin is consumed by it.
+        self.seal(oid)
+
+    def abort(self, oid: bytes) -> None:
+        self._lib.rt_abort(self._h, oid)
+
+    def get(self, oid: bytes) -> Optional[SharedBuffer]:
+        """Zero-copy read of a sealed object; None if not present."""
+        dsize = ctypes.c_uint64()
+        msize = ctypes.c_uint64()
+        off = self._lib.rt_get(self._h, oid, ctypes.byref(dsize),
+                               ctypes.byref(msize), 1)
+        if off < 0:
+            return None
+        self._pins[oid] = self._pins.get(oid, 0) + 1
+        data = self._view[off:off + dsize.value]
+        meta = bytes(self._view[off + dsize.value:off + dsize.value + msize.value])
+        return SharedBuffer(self, oid, data, meta)
+
+    def _release(self, oid: bytes) -> None:
+        if self._h and self._pins.get(oid):
+            n = self._pins[oid] - 1
+            if n:
+                self._pins[oid] = n
+            else:
+                del self._pins[oid]
+            self._lib.rt_release(self._h, oid)
+
+    def contains(self, oid: bytes) -> bool:
+        return bool(self._lib.rt_contains(self._h, oid))
+
+    def delete(self, oid: bytes) -> None:
+        self._lib.rt_delete(self._h, oid)
+
+    def evict(self, nbytes: int) -> int:
+        return self._lib.rt_evict(self._h, nbytes)
+
+    def gc_unsealed(self, max_age_sec: int = 300) -> int:
+        """Reclaim orphaned never-sealed objects (writer died before seal)."""
+        return self._lib.rt_gc_unsealed(self._h, max_age_sec)
+
+    def put_bytes(self, oid: bytes, payload, metadata: bytes = b"") -> bool:
+        """Convenience: create+write+seal. False if already present."""
+        payload = memoryview(payload)
+        bufs = self.create(oid, payload.nbytes, len(metadata))
+        if bufs is None:
+            return False
+        data, meta = bufs
+        data[:] = payload
+        if metadata:
+            meta[:] = metadata
+        self.seal(oid)
+        return True
+
+    def stats(self) -> dict:
+        arr = (ctypes.c_uint64 * 9)()
+        self._lib.rt_stats(self._h, arr)
+        keys = ["bytes_in_use", "capacity", "num_objects", "num_evictions",
+                "bytes_evicted", "create_count", "get_hits", "get_misses",
+                "poisoned"]
+        return dict(zip(keys, arr))
+
+    def list_objects(self, max_n: int = 65536) -> list:
+        buf = ctypes.create_string_buffer(max_n * ID_LEN)
+        n = self._lib.rt_list(self._h, buf, max_n)
+        raw = buf.raw
+        return [raw[i * ID_LEN:(i + 1) * ID_LEN] for i in range(n)]
+
+    def close(self):
+        """Release this client's pins. Unmaps only when no zero-copy views
+        remain — a live SharedBuffer keeps the mapping for process lifetime
+        (munmap under a live view would be a use-after-free)."""
+        if not self._h:
+            return
+        h = self._h
+        if self._pins:
+            # Outstanding zero-copy views: drop the pins so the objects stay
+            # evictable node-wide, but keep the mapping alive.
+            for oid, n in list(self._pins.items()):
+                for _ in range(n):
+                    self._lib.rt_release(h, oid)
+            self._pins.clear()
+            self._h = None
+            return
+        self._h = None
+        self._view.release()
+        self._lib.rt_store_close(h)

@@ -1,0 +1,43 @@
+"""Build the native components on demand.
+
+The native library is compiled once per source change into
+``ray_tpu/native/_build/`` and loaded via ctypes (no pybind11 in this image;
+the C ABI + ctypes keeps the binding dependency-free).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_LOCK = threading.Lock()
+
+_SOURCES = {
+    "shm_store": ["shm_store.cpp"],
+}
+
+
+def lib_path(name: str) -> str:
+    return os.path.join(_BUILD_DIR, f"lib{name}.so")
+
+
+def build(name: str) -> str:
+    """Compile (if stale) and return the path to lib<name>.so."""
+    srcs = [os.path.join(_HERE, s) for s in _SOURCES[name]]
+    out = lib_path(name)
+    with _LOCK:
+        src_mtime = max(os.path.getmtime(s) for s in srcs)
+        if os.path.exists(out) and os.path.getmtime(out) >= src_mtime:
+            return out
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        tmp = f"{out}.tmp.{os.getpid()}"  # per-process tmp; os.replace is atomic
+        cmd = [
+            "g++", "-O2", "-g", "-shared", "-fPIC", "-std=c++17",
+            "-o", tmp, *srcs, "-lpthread",
+        ]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, out)
+    return out

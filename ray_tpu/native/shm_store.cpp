@@ -1,0 +1,573 @@
+// shm_store.cpp — node-local shared-memory object store.
+//
+// TPU-native re-design of the reference's plasma store
+// (reference: src/ray/object_manager/plasma/store.cc, plasma_allocator.h).
+// Unlike plasma (a store *server* that clients reach over a unix socket with
+// fd-passing), the entire store — allocator, object table, LRU — lives in one
+// file-backed shared-memory arena that every process on the node maps at a
+// known path. create/seal/get/release are direct shared-memory operations
+// under a robust process-shared mutex: no socket round trip, no fd passing.
+// The node daemon only coordinates eviction-to-remote and cross-node transfer.
+//
+// Layout:
+//   [Header | ObjectTable (open-addressed) | data arena (boundary-tag heap)]
+//
+// Object lifecycle: CREATED (writer owns buffer) -> SEALED (immutable,
+// readable by all) -> deleted (deferred until pin_count drops to zero).
+// Eviction: LRU over sealed, unpinned, evictable objects.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x5250555453544f52ULL;  // "RPUTSTOR"
+constexpr uint32_t kVersion = 1;
+constexpr uint32_t kIdLen = 20;
+constexpr uint32_t kTableCapacity = 1 << 16;  // 65536 entries, power of two
+constexpr uint64_t kAlign = 64;
+constexpr uint32_t kNil = 0xffffffffu;
+
+// Object states.
+enum : uint32_t { kEmpty = 0, kCreated = 1, kSealed = 2, kTombstone = 3 };
+
+struct Entry {
+  uint8_t id[kIdLen];
+  uint32_t state;
+  uint64_t offset;     // offset of payload (data then metadata) from arena base
+  uint64_t data_size;
+  uint64_t meta_size;
+  uint32_t pin_count;
+  uint32_t flags;      // bit0: delete-pending, bit1: not-evictable
+  uint64_t seq;        // LRU clock value at last touch
+  uint64_t ctime_sec;  // CLOCK_MONOTONIC seconds at creation
+  uint32_t lru_prev, lru_next;  // doubly-linked LRU list (entry indices)
+};
+
+struct Header {
+  uint64_t magic;
+  uint32_t version;
+  uint32_t table_capacity;
+  uint64_t total_size;      // whole mapping size
+  uint64_t arena_offset;    // start of heap area
+  uint64_t arena_size;
+  pthread_mutex_t mutex;
+  // heap state
+  uint64_t free_head;       // offset of first free block (arena-relative), or ~0
+  uint64_t bytes_in_use;    // allocated payload bytes (incl. block headers)
+  uint64_t num_objects;
+  uint64_t lru_clock;
+  uint32_t lru_head, lru_tail;  // head = most recent
+  uint64_t num_evictions;
+  uint64_t bytes_evicted;
+  uint64_t create_count;
+  uint64_t seal_count;
+  uint64_t get_hits;
+  uint64_t get_misses;
+  uint32_t mutating;   // a mutation is in progress under the lock
+  uint32_t poisoned;   // a lock holder died mid-mutation; store is suspect
+};
+
+// Boundary-tag heap block. Located in the arena. Size includes the header.
+struct Block {
+  uint64_t size;       // total block size incl. header; low bit = free flag
+  uint64_t prev_size;  // size of physically-previous block (0 if first)
+  // free blocks only:
+  uint64_t next_free;  // arena offset or ~0
+  uint64_t prev_free;  // arena offset or ~0
+};
+
+constexpr uint64_t kBlockHeader = 16;  // size + prev_size (used blocks)
+constexpr uint64_t kMinBlock = 64;
+constexpr uint64_t kNone = ~0ULL;
+
+struct Store {
+  Header* hdr;
+  uint8_t* base;     // mapping base
+  uint8_t* arena;    // heap base
+  Entry* table;
+  uint64_t map_size;
+  int fd;
+};
+
+inline uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+inline bool blk_free(Block* b) { return b->size & 1; }
+inline uint64_t blk_size(Block* b) { return b->size & ~1ULL; }
+inline void set_size(Block* b, uint64_t s, bool f) { b->size = s | (f ? 1 : 0); }
+
+inline Block* at(Store* s, uint64_t off) {
+  return reinterpret_cast<Block*>(s->arena + off);
+}
+inline uint64_t off_of(Store* s, Block* b) {
+  return reinterpret_cast<uint8_t*>(b) - s->arena;
+}
+
+void free_list_push(Store* s, Block* b) {
+  uint64_t off = off_of(s, b);
+  b->next_free = s->hdr->free_head;
+  b->prev_free = kNone;
+  if (s->hdr->free_head != kNone) at(s, s->hdr->free_head)->prev_free = off;
+  s->hdr->free_head = off;
+}
+
+void free_list_remove(Store* s, Block* b) {
+  if (b->prev_free != kNone)
+    at(s, b->prev_free)->next_free = b->next_free;
+  else
+    s->hdr->free_head = b->next_free;
+  if (b->next_free != kNone) at(s, b->next_free)->prev_free = b->prev_free;
+}
+
+Block* phys_next(Store* s, Block* b) {
+  uint64_t off = off_of(s, b) + blk_size(b);
+  if (off >= s->hdr->arena_size) return nullptr;
+  return at(s, off);
+}
+
+Block* phys_prev(Store* s, Block* b) {
+  if (b->prev_size == 0) return nullptr;
+  return at(s, off_of(s, b) - b->prev_size);
+}
+
+// Allocate `need` payload bytes; returns arena offset of payload or kNone.
+uint64_t heap_alloc(Store* s, uint64_t need) {
+  uint64_t want = align_up(need + kBlockHeader, kAlign);
+  if (want < kMinBlock) want = kMinBlock;
+  // first-fit
+  uint64_t off = s->hdr->free_head;
+  while (off != kNone) {
+    Block* b = at(s, off);
+    uint64_t bs = blk_size(b);
+    if (bs >= want) {
+      free_list_remove(s, b);
+      if (bs - want >= kMinBlock) {
+        // split
+        Block* rest = at(s, off + want);
+        set_size(rest, bs - want, true);
+        rest->prev_size = want;
+        Block* nxt = phys_next(s, rest);
+        if (nxt) nxt->prev_size = blk_size(rest);
+        free_list_push(s, rest);
+        set_size(b, want, false);
+      } else {
+        set_size(b, bs, false);
+      }
+      s->hdr->bytes_in_use += blk_size(b);
+      return off + kBlockHeader;
+    }
+    off = b->next_free;
+  }
+  return kNone;
+}
+
+void heap_free(Store* s, uint64_t payload_off) {
+  Block* b = at(s, payload_off - kBlockHeader);
+  s->hdr->bytes_in_use -= blk_size(b);
+  set_size(b, blk_size(b), true);
+  // coalesce with next
+  Block* n = phys_next(s, b);
+  if (n && blk_free(n)) {
+    free_list_remove(s, n);
+    set_size(b, blk_size(b) + blk_size(n), true);
+  }
+  // coalesce with prev
+  Block* p = phys_prev(s, b);
+  if (p && blk_free(p)) {
+    free_list_remove(s, p);
+    set_size(p, blk_size(p) + blk_size(b), true);
+    b = p;
+  }
+  Block* after = phys_next(s, b);
+  if (after) after->prev_size = blk_size(b);
+  free_list_push(s, b);
+}
+
+inline uint64_t hash_id(const uint8_t* id) {
+  // Mix all 20 bytes: ids that share a task prefix differ only in the
+  // trailing index word, so the tail must feed the hash.
+  uint64_t a, b;
+  uint32_t c;
+  memcpy(&a, id, 8);
+  memcpy(&b, id + 8, 8);
+  memcpy(&c, id + 16, 4);
+  uint64_t h = a ^ (b * 0x9e3779b97f4a7c15ULL) ^ ((uint64_t)c << 17);
+  h ^= h >> 33; h *= 0xff51afd7ed558ccdULL; h ^= h >> 33;
+  return h;
+}
+
+// Find entry index for id; returns kNil if absent.
+uint32_t table_find(Store* s, const uint8_t* id) {
+  uint32_t mask = s->hdr->table_capacity - 1;
+  uint32_t i = static_cast<uint32_t>(hash_id(id)) & mask;
+  for (uint32_t probe = 0; probe <= mask; ++probe, i = (i + 1) & mask) {
+    Entry* e = &s->table[i];
+    if (e->state == kEmpty) return kNil;
+    if (e->state != kTombstone && memcmp(e->id, id, kIdLen) == 0) return i;
+  }
+  return kNil;
+}
+
+// Find slot to insert id (assumes not present); kNil if table full.
+uint32_t table_slot(Store* s, const uint8_t* id) {
+  uint32_t mask = s->hdr->table_capacity - 1;
+  uint32_t i = static_cast<uint32_t>(hash_id(id)) & mask;
+  for (uint32_t probe = 0; probe <= mask; ++probe, i = (i + 1) & mask) {
+    Entry* e = &s->table[i];
+    if (e->state == kEmpty || e->state == kTombstone) return i;
+  }
+  return kNil;
+}
+
+void lru_unlink(Store* s, uint32_t i) {
+  Entry* e = &s->table[i];
+  if (e->lru_prev != kNil) s->table[e->lru_prev].lru_next = e->lru_next;
+  else if (s->hdr->lru_head == i) s->hdr->lru_head = e->lru_next;
+  if (e->lru_next != kNil) s->table[e->lru_next].lru_prev = e->lru_prev;
+  else if (s->hdr->lru_tail == i) s->hdr->lru_tail = e->lru_prev;
+  e->lru_prev = e->lru_next = kNil;
+}
+
+void lru_push_front(Store* s, uint32_t i) {
+  Entry* e = &s->table[i];
+  e->lru_prev = kNil;
+  e->lru_next = s->hdr->lru_head;
+  if (s->hdr->lru_head != kNil) s->table[s->hdr->lru_head].lru_prev = i;
+  s->hdr->lru_head = i;
+  if (s->hdr->lru_tail == kNil) s->hdr->lru_tail = i;
+  e->seq = ++s->hdr->lru_clock;
+}
+
+void entry_free(Store* s, uint32_t i) {
+  Entry* e = &s->table[i];
+  lru_unlink(s, i);
+  heap_free(s, e->offset);
+  e->state = kTombstone;
+  s->hdr->num_objects--;
+  // Anti-tombstone-exhaustion: if the next probe slot is empty, this
+  // tombstone (and any run of tombstones before it) can revert to empty
+  // without breaking probe chains.
+  uint32_t mask = s->hdr->table_capacity - 1;
+  if (s->table[(i + 1) & mask].state == kEmpty) {
+    uint32_t j = i;
+    while (s->table[j].state == kTombstone) {
+      s->table[j].state = kEmpty;
+      j = (j - 1) & mask;
+    }
+  }
+}
+
+class Guard {
+ public:
+  explicit Guard(Store* s) : h_(s->hdr), m_(&s->hdr->mutex) {
+    int rc = pthread_mutex_lock(m_);
+    if (rc == EOWNERDEAD) {
+      pthread_mutex_consistent(m_);
+      // If the dead holder was mid-mutation, heap/table invariants may be
+      // broken: poison the store instead of walking corrupt structures.
+      if (h_->mutating) h_->poisoned = 1;
+    }
+    h_->mutating = 1;
+  }
+  ~Guard() {
+    h_->mutating = 0;
+    pthread_mutex_unlock(m_);
+  }
+  bool poisoned() const { return h_->poisoned != 0; }
+
+ private:
+  Header* h_;
+  pthread_mutex_t* m_;
+};
+
+// Evict LRU sealed+unpinned+evictable objects until `bytes` are reclaimable.
+// Called with lock held. Returns bytes freed.
+uint64_t evict_locked(Store* s, uint64_t bytes) {
+  uint64_t freed = 0;
+  uint32_t i = s->hdr->lru_tail;
+  while (freed < bytes && i != kNil) {
+    uint32_t prev = s->table[i].lru_prev;
+    Entry* e = &s->table[i];
+    if (e->state == kSealed && e->pin_count == 0 && !(e->flags & 2)) {
+      uint64_t sz = e->data_size + e->meta_size;
+      entry_free(s, i);
+      s->hdr->num_evictions++;
+      s->hdr->bytes_evicted += sz;
+      freed += sz;
+    }
+    i = prev;
+  }
+  return freed;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rt_store_create(const char* path, uint64_t size) {
+  // Always create a fresh inode (O_EXCL after unlink): truncating an
+  // existing path would SIGBUS any process still mapping the old store.
+  unlink(path);
+  int fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t table_bytes = align_up(sizeof(Entry) * (uint64_t)kTableCapacity, 4096);
+  uint64_t header_bytes = align_up(sizeof(Header), 4096);
+  uint64_t total = align_up(header_bytes + table_bytes + size, 4096);
+  if (ftruncate(fd, (off_t)total) != 0) { close(fd); return nullptr; }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) { close(fd); return nullptr; }
+
+  Store* s = new Store();
+  s->base = static_cast<uint8_t*>(mem);
+  s->hdr = reinterpret_cast<Header*>(mem);
+  s->table = reinterpret_cast<Entry*>(s->base + header_bytes);
+  s->arena = s->base + header_bytes + table_bytes;
+  s->map_size = total;
+  s->fd = fd;
+
+  Header* h = s->hdr;
+  memset(h, 0, sizeof(Header));
+  memset(s->table, 0, sizeof(Entry) * (uint64_t)kTableCapacity);
+  h->version = kVersion;
+  h->table_capacity = kTableCapacity;
+  h->total_size = total;
+  h->arena_offset = header_bytes + table_bytes;
+  h->arena_size = total - h->arena_offset;
+  h->free_head = kNone;
+  h->lru_head = h->lru_tail = kNil;
+
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+
+  // one giant free block
+  Block* b = at(s, 0);
+  set_size(b, h->arena_size, true);
+  b->prev_size = 0;
+  free_list_push(s, b);
+
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  h->magic = kMagic;  // publish last
+  return s;
+}
+
+void* rt_store_open(const char* path) {
+  int fd = open(path, O_RDWR);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+  void* mem = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) { close(fd); return nullptr; }
+  Header* h = reinterpret_cast<Header*>(mem);
+  if (h->magic != kMagic || h->version != kVersion) {
+    munmap(mem, (size_t)st.st_size);
+    close(fd);
+    return nullptr;
+  }
+  Store* s = new Store();
+  s->base = static_cast<uint8_t*>(mem);
+  s->hdr = h;
+  uint64_t header_bytes = align_up(sizeof(Header), 4096);
+  s->table = reinterpret_cast<Entry*>(s->base + header_bytes);
+  s->arena = s->base + h->arena_offset;
+  s->map_size = h->total_size;
+  s->fd = fd;
+  return s;
+}
+
+void rt_store_close(void* hs) {
+  Store* s = static_cast<Store*>(hs);
+  munmap(s->base, s->map_size);
+  close(s->fd);
+  delete s;
+}
+
+uint8_t* rt_store_base(void* hs) { return static_cast<Store*>(hs)->base; }
+uint64_t rt_store_capacity(void* hs) { return static_cast<Store*>(hs)->hdr->arena_size; }
+uint64_t rt_store_total_size(void* hs) { return static_cast<Store*>(hs)->hdr->total_size; }
+
+// Create an object buffer. Returns base-relative offset of the payload
+// (data followed by metadata), or a negative errno-style code:
+//   -EEXIST already exists, -ENOMEM no space even after eviction,
+//   -ENFILE table full.
+int64_t rt_create(void* hs, const uint8_t* id, uint64_t data_size,
+                  uint64_t meta_size, int evictable) {
+  Store* s = static_cast<Store*>(hs);
+  uint64_t need = data_size + meta_size;
+  Guard g(s);
+  if (g.poisoned()) return -EIO;
+  if (table_find(s, id) != kNil) return -EEXIST;
+  uint32_t slot = table_slot(s, id);
+  if (slot == kNil) return -ENFILE;
+  uint64_t off = heap_alloc(s, need);
+  if (off == kNone) {
+    evict_locked(s, need);
+    off = heap_alloc(s, need);
+    if (off == kNone) return -ENOMEM;
+  }
+  Entry* e = &s->table[slot];
+  memcpy(e->id, id, kIdLen);
+  e->state = kCreated;
+  e->offset = off;
+  e->data_size = data_size;
+  e->meta_size = meta_size;
+  e->pin_count = 1;  // creator holds a pin until seal+release
+  e->flags = evictable ? 0 : 2;
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  e->ctime_sec = (uint64_t)ts.tv_sec;
+  e->lru_prev = e->lru_next = kNil;
+  s->hdr->num_objects++;
+  s->hdr->create_count++;
+  return (int64_t)(s->hdr->arena_offset + off);
+}
+
+int rt_seal(void* hs, const uint8_t* id) {
+  Store* s = static_cast<Store*>(hs);
+  Guard g(s);
+  uint32_t i = table_find(s, id);
+  if (i == kNil) return -ENOENT;
+  Entry* e = &s->table[i];
+  if (e->state != kCreated) return -EINVAL;
+  e->state = kSealed;
+  e->pin_count = 0;
+  lru_push_front(s, i);
+  s->hdr->seal_count++;
+  return 0;
+}
+
+// Look up a sealed object. On hit fills sizes, pins if pin!=0, returns
+// base-relative payload offset. -ENOENT if absent or not sealed.
+int64_t rt_get(void* hs, const uint8_t* id, uint64_t* data_size,
+               uint64_t* meta_size, int pin) {
+  Store* s = static_cast<Store*>(hs);
+  Guard g(s);
+  if (g.poisoned()) return -EIO;
+  uint32_t i = table_find(s, id);
+  if (i == kNil || s->table[i].state != kSealed) {
+    s->hdr->get_misses++;
+    return -ENOENT;
+  }
+  Entry* e = &s->table[i];
+  *data_size = e->data_size;
+  *meta_size = e->meta_size;
+  if (pin) e->pin_count++;
+  // touch LRU
+  lru_unlink(s, i);
+  lru_push_front(s, i);
+  s->hdr->get_hits++;
+  return (int64_t)(s->hdr->arena_offset + e->offset);
+}
+
+int rt_release(void* hs, const uint8_t* id) {
+  Store* s = static_cast<Store*>(hs);
+  Guard g(s);
+  uint32_t i = table_find(s, id);
+  if (i == kNil) return -ENOENT;
+  Entry* e = &s->table[i];
+  if (e->pin_count > 0) e->pin_count--;
+  if ((e->flags & 1) && e->pin_count == 0) entry_free(s, i);
+  return 0;
+}
+
+int rt_contains(void* hs, const uint8_t* id) {
+  Store* s = static_cast<Store*>(hs);
+  Guard g(s);
+  uint32_t i = table_find(s, id);
+  return (i != kNil && s->table[i].state == kSealed) ? 1 : 0;
+}
+
+// Delete (deferred if pinned). -ENOENT if absent.
+int rt_delete(void* hs, const uint8_t* id) {
+  Store* s = static_cast<Store*>(hs);
+  Guard g(s);
+  uint32_t i = table_find(s, id);
+  if (i == kNil) return -ENOENT;
+  Entry* e = &s->table[i];
+  if (e->pin_count > 0) {
+    e->flags |= 1;  // delete-pending
+    return 0;
+  }
+  entry_free(s, i);
+  return 0;
+}
+
+// Abort an in-progress creation (writer failed before seal).
+int rt_abort(void* hs, const uint8_t* id) {
+  Store* s = static_cast<Store*>(hs);
+  Guard g(s);
+  uint32_t i = table_find(s, id);
+  if (i == kNil) return -ENOENT;
+  if (s->table[i].state != kCreated) return -EINVAL;
+  entry_free(s, i);
+  return 0;
+}
+
+// Reclaim CREATED-but-never-sealed objects older than max_age_sec — their
+// writer likely died before sealing. Returns number reclaimed. Called
+// periodically by the node daemon.
+uint64_t rt_gc_unsealed(void* hs, uint64_t max_age_sec) {
+  Store* s = static_cast<Store*>(hs);
+  Guard g(s);
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  uint64_t now = (uint64_t)ts.tv_sec;
+  uint64_t n = 0;
+  for (uint32_t i = 0; i < s->hdr->table_capacity; ++i) {
+    Entry* e = &s->table[i];
+    if (e->state == kCreated && now - e->ctime_sec >= max_age_sec) {
+      entry_free(s, i);
+      ++n;
+    }
+  }
+  return n;
+}
+
+uint64_t rt_evict(void* hs, uint64_t bytes) {
+  Store* s = static_cast<Store*>(hs);
+  Guard g(s);
+  return evict_locked(s, bytes);
+}
+
+void rt_stats(void* hs, uint64_t* out) {
+  Store* s = static_cast<Store*>(hs);
+  Guard g(s);
+  Header* h = s->hdr;
+  out[0] = h->bytes_in_use;
+  out[1] = h->arena_size;
+  out[2] = h->num_objects;
+  out[3] = h->num_evictions;
+  out[4] = h->bytes_evicted;
+  out[5] = h->create_count;
+  out[6] = h->get_hits;
+  out[7] = h->get_misses;
+  out[8] = h->poisoned;
+}
+
+// List up to max_n sealed object ids into out (max_n * kIdLen bytes).
+uint64_t rt_list(void* hs, uint8_t* out, uint64_t max_n) {
+  Store* s = static_cast<Store*>(hs);
+  Guard g(s);
+  uint64_t n = 0;
+  for (uint32_t i = 0; i < s->hdr->table_capacity && n < max_n; ++i) {
+    Entry* e = &s->table[i];
+    if (e->state == kSealed) {
+      memcpy(out + n * kIdLen, e->id, kIdLen);
+      ++n;
+    }
+  }
+  return n;
+}
+
+}  // extern "C"
