@@ -1,0 +1,240 @@
+"""ray_tpu — a TPU-native distributed runtime with Ray's capabilities.
+
+Public core API (reference: python/ray/_private/worker.py — ray.init :1260,
+get/put/wait/remote): tasks, actors, objects over a C+±backed shared-memory
+object store, an asyncio control plane, and a JAX/XLA-native device layer.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.serialization import (ActorDiedError, ObjectLostError,
+                                            TaskError, WorkerCrashedError)
+from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.remote_function import RemoteFunction
+
+__version__ = "0.1.0"
+
+_ctx_lock = threading.RLock()
+_context: Optional["_Context"] = None
+
+
+class _Context:
+    def __init__(self, worker, node=None, owns_node=False, job_id=0):
+        self.worker = worker
+        self.node = node
+        self.owns_node = owns_node
+        self.job_id = job_id
+
+
+def _get_worker():
+    ctx = _context
+    if ctx is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return ctx.worker
+
+
+def is_initialized() -> bool:
+    return _context is not None
+
+
+def _set_connected_from_worker(core):
+    """Called by worker_main: tasks executing here see a connected API."""
+    global _context
+    from ray_tpu._private import worker as worker_mod
+    with _ctx_lock:
+        if _context is None:
+            _context = _Context(worker_mod.global_worker, node=None,
+                                owns_node=False, job_id=core.job_id)
+
+
+def init(address: Optional[str] = None, *,
+         num_cpus: Optional[float] = None,
+         resources: Optional[Dict[str, float]] = None,
+         object_store_memory: Optional[int] = None,
+         namespace: str = "default",
+         labels: Optional[Dict[str, str]] = None,
+         ignore_reinit_error: bool = False,
+         _node_address: Optional[str] = None,
+         _store_path: Optional[str] = None,
+         _node_id: Optional[str] = None):
+    """Connect to (or start) a cluster. With no address, starts a local
+    head: GCS + node manager subprocesses (reference: ray.init at
+    python/ray/_private/worker.py:1260)."""
+    global _context
+    from ray_tpu._private import node as node_mod
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.worker import Worker
+
+    with _ctx_lock:
+        if _context is not None:
+            if ignore_reinit_error:
+                return _context
+            raise RuntimeError("ray_tpu.init() already called "
+                               "(use ignore_reinit_error=True)")
+        owns_node = False
+        node = None
+        if address is None:
+            node = node_mod.start_head(
+                num_cpus=num_cpus, resources=resources,
+                object_store_memory=object_store_memory, labels=labels)
+            owns_node = True
+            gcs_address = node.gcs_address
+            node_address = node.node_address
+            store_path = node.store_path
+            node_id = node.node_id
+        else:
+            gcs_address = address
+            node_address = _node_address
+            store_path = _store_path
+            node_id = _node_id
+            if node_address is None:
+                # find (or start) a node manager on this host via GCS
+                probe = Worker.start(mode="driver", gcs_address=gcs_address,
+                                     node_address="", store_path="",
+                                     node_id="probe", namespace=namespace)
+                try:
+                    nodes_list = probe.gcs_call("get_all_nodes")
+                finally:
+                    probe.stop()
+                from ray_tpu._private.rpc import node_ip_address
+                my_ip = node_ip_address()
+                local = [n for n in nodes_list
+                         if n["alive"] and n["node_ip"] in (my_ip, "127.0.0.1")]
+                if local:
+                    node_address = local[0]["address"]
+                    store_path = local[0]["object_store_address"]
+                    node_id = local[0]["node_id"]
+                else:
+                    ln = node_mod.start_node(gcs_address, num_cpus=num_cpus,
+                                             resources=resources,
+                                             object_store_memory=object_store_memory)
+                    node = ln
+                    owns_node = True
+                    node_address = ln.node_address
+                    store_path = ln.store_path
+                    node_id = ln.node_id
+
+        worker = Worker.start(mode="driver", gcs_address=gcs_address,
+                              node_address=node_address,
+                              store_path=store_path, node_id=node_id,
+                              namespace=namespace)
+        job_id = worker.gcs_call("register_job",
+                                 driver_address=worker.core.address,
+                                 metadata={})
+        worker.core.job_id = job_id
+        worker_mod.global_worker = worker
+        _context = _Context(worker, node, owns_node, job_id)
+        atexit.register(shutdown)
+        return _context
+
+
+def shutdown():
+    global _context
+    with _ctx_lock:
+        ctx = _context
+        if ctx is None:
+            return
+        _context = None
+        try:
+            ctx.worker.gcs_call("finish_job", job_id=ctx.job_id)
+        except Exception:
+            pass
+        ctx.worker.stop()
+        if ctx.owns_node and ctx.node is not None:
+            ctx.node.kill()
+        from ray_tpu._private import worker as worker_mod
+        worker_mod.global_worker = None
+
+
+def remote(*args, **kwargs):
+    """Decorator making a function a remote task or a class an actor class
+    (reference: python/ray/_private/worker.py remote decorator)."""
+    def make(obj):
+        if isinstance(obj, type):
+            return ActorClass(obj, kwargs)
+        return RemoteFunction(obj, kwargs)
+
+    if len(args) == 1 and callable(args[0]) and not kwargs:
+        return make(args[0])
+    return make
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    return _get_worker().get(refs, timeout=timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    return _get_worker().put(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None):
+    return _get_worker().wait(list(refs), num_returns=num_returns,
+                              timeout=timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    _get_worker().kill_actor(actor._id, no_restart=no_restart)
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    info = _get_worker().gcs_call("get_named_actor", name=name,
+                                  namespace=namespace)
+    if info is None:
+        raise ValueError(f"no actor named {name!r} in namespace {namespace!r}")
+    return ActorHandle(info["actor_id"], info.get("method_names") or [], {})
+
+
+def nodes() -> List[Dict]:
+    return _get_worker().gcs_call("get_all_nodes")
+
+
+def cluster_resources() -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for n in nodes():
+        if n["alive"]:
+            for k, v in n["total"].items():
+                total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def available_resources() -> Dict[str, float]:
+    avail: Dict[str, float] = {}
+    for n in nodes():
+        if n["alive"]:
+            for k, v in n["available"].items():
+                avail[k] = avail.get(k, 0.0) + v
+    return avail
+
+
+def get_gcs_address() -> str:
+    ctx = _context
+    if ctx is None:
+        raise RuntimeError("not initialized")
+    return ctx.worker.core.gcs_address
+
+
+def get_runtime_context():
+    ctx = _context
+    w = _get_worker()
+    return {"job_id": w.core.job_id, "node_id": w.core.node_id,
+            "worker_id": w.core.worker_id,
+            "actor_id": w.core.actor_id,
+            "gcs_address": w.core.gcs_address}
+
+
+import ray_tpu.util as util  # noqa: E402  (public subpackage)
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "get_actor", "nodes", "cluster_resources", "available_resources",
+    "ObjectRef", "ActorHandle", "ActorClass", "RemoteFunction",
+    "TaskError", "ActorDiedError", "ObjectLostError", "WorkerCrashedError",
+    "util", "get_runtime_context", "get_gcs_address",
+]

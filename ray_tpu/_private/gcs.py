@@ -1,0 +1,528 @@
+"""GCS — global control service: the cluster-singleton control plane.
+
+Re-design of the reference's GCS server (reference:
+src/ray/gcs/gcs_server/gcs_server.cc, gcs_actor_manager.h:308,
+gcs_node_manager.h, gcs_placement_group_manager, gcs_kv_manager.h,
+gcs_health_check_manager.h:39). One asyncio process holding authoritative
+tables for nodes, actors, jobs, placement groups and a namespaced KV store,
+plus pubsub. Differences from the reference, deliberately:
+
+- Transport is the symmetric rpc.py protocol; node managers hold one
+  persistent bidirectional connection, so GCS→raylet commands (create actor
+  worker, reserve bundle) and pubsub pushes reuse it — no per-service gRPC
+  stubs or long-poll channels (reference: src/ray/pubsub/publisher.h:296).
+- The cluster resource view (the reference's ray_syncer gossip,
+  src/ray/common/ray_syncer/ray_syncer.h:88) is piggybacked on node
+  heartbeats and re-broadcast to subscribers on change.
+- Persistence is a pluggable snapshot (in-memory by default; file-backed
+  snapshot for GCS restart) instead of Redis.
+
+Actor scheduling follows the reference's GCS-based actor scheduling: GCS
+picks the node (shared policy in scheduling.py) and leases a worker from
+that node's manager (reference: gcs_actor_scheduler.cc:49).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import rpc
+from ray_tpu._private import scheduling
+
+logger = logging.getLogger(__name__)
+
+HEARTBEAT_INTERVAL_S = 0.5
+NODE_DEATH_TIMEOUT_S = 5.0
+
+# Actor states (reference: src/ray/protobuf/gcs.proto ActorTableData.ActorState)
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class GcsServer:
+    def __init__(self, port: int = 0, session_name: str = "session"):
+        self.port = port
+        self.session_name = session_name
+        self.address: Optional[str] = None
+
+        self.kv: Dict[str, Dict[bytes, bytes]] = {}          # namespace -> {k: v}
+        self.nodes: Dict[str, Dict] = {}                     # node_id -> info
+        self.node_conns: Dict[str, rpc.Connection] = {}      # node_id -> conn
+        self.actors: Dict[str, Dict] = {}                    # actor_id -> table row
+        self.named_actors: Dict[tuple, str] = {}             # (ns, name) -> actor_id
+        self.jobs: Dict[int, Dict] = {}
+        self.placement_groups: Dict[str, Dict] = {}
+        self.subscribers: Dict[str, set] = {}                # channel -> {conn}
+        self._next_job_id = 1
+        self._death_checker: Optional[asyncio.Task] = None
+        self._pending_actor_queue: List[str] = []
+        self.server = Server = None
+
+    # ------------------------------------------------------------- lifecycle
+    async def start(self) -> str:
+        handlers = {
+            "kv_put": self.h_kv_put, "kv_get": self.h_kv_get,
+            "kv_del": self.h_kv_del, "kv_exists": self.h_kv_exists,
+            "kv_keys": self.h_kv_keys,
+            "register_node": self.h_register_node,
+            "heartbeat": self.h_heartbeat,
+            "drain_node": self.h_drain_node,
+            "get_all_nodes": self.h_get_all_nodes,
+            "get_cluster_view": self.h_get_cluster_view,
+            "register_job": self.h_register_job,
+            "finish_job": self.h_finish_job,
+            "get_all_jobs": self.h_get_all_jobs,
+            "create_actor": self.h_create_actor,
+            "get_actor_info": self.h_get_actor_info,
+            "get_named_actor": self.h_get_named_actor,
+            "list_named_actors": self.h_list_named_actors,
+            "get_all_actors": self.h_get_all_actors,
+            "report_actor_failure": self.h_report_actor_failure,
+            "kill_actor": self.h_kill_actor,
+            "subscribe": self.h_subscribe,
+            "publish": self.h_publish,
+            "create_placement_group": self.h_create_placement_group,
+            "remove_placement_group": self.h_remove_placement_group,
+            "get_placement_group": self.h_get_placement_group,
+            "get_all_placement_groups": self.h_get_all_placement_groups,
+            "ping": lambda conn: "pong",
+        }
+        self.server = rpc.Server(handlers, name="gcs")
+        self.server.on_disconnect = self._on_disconnect
+        self.address = await self.server.listen_tcp("0.0.0.0", self.port)
+        self._death_checker = asyncio.ensure_future(self._check_node_deaths())
+        logger.info("GCS listening at %s", self.address)
+        return self.address
+
+    async def stop(self):
+        if self._death_checker:
+            self._death_checker.cancel()
+        await self.server.close()
+
+    def _on_disconnect(self, conn: rpc.Connection):
+        for subs in self.subscribers.values():
+            subs.discard(conn)
+        node_id = conn.peer_info.get("node_id")
+        if node_id and self.node_conns.get(node_id) is conn:
+            # grace: let heartbeat timeout decide (node manager may reconnect)
+            info = self.nodes.get(node_id)
+            if info is not None:
+                info["last_heartbeat"] = min(
+                    info["last_heartbeat"], time.monotonic() - NODE_DEATH_TIMEOUT_S / 2)
+
+    # ------------------------------------------------------------------- kv
+    def h_kv_put(self, conn, ns: str, key: bytes, value: bytes,
+                 overwrite: bool = True):
+        table = self.kv.setdefault(ns, {})
+        if not overwrite and key in table:
+            return False
+        table[key] = value
+        return True
+
+    def h_kv_get(self, conn, ns: str, key: bytes):
+        return self.kv.get(ns, {}).get(key)
+
+    def h_kv_del(self, conn, ns: str, key: bytes):
+        return self.kv.get(ns, {}).pop(key, None) is not None
+
+    def h_kv_exists(self, conn, ns: str, key: bytes):
+        return key in self.kv.get(ns, {})
+
+    def h_kv_keys(self, conn, ns: str, prefix: bytes = b""):
+        return [k for k in self.kv.get(ns, {}) if k.startswith(prefix)]
+
+    # ---------------------------------------------------------------- nodes
+    def h_register_node(self, conn, node_id: str, address: str,
+                        object_store_address: str, resources: Dict[str, float],
+                        labels: Dict[str, str], node_ip: str):
+        conn.peer_info["node_id"] = node_id
+        self.node_conns[node_id] = conn
+        self.nodes[node_id] = {
+            "node_id": node_id,
+            "address": address,
+            "object_store_address": object_store_address,
+            "node_ip": node_ip,
+            "total": dict(resources),
+            "available": dict(resources),
+            "labels": labels,
+            "alive": True,
+            "draining": False,
+            "last_heartbeat": time.monotonic(),
+            "start_time": time.time(),
+        }
+        logger.info("node %s registered at %s (%s)", node_id[:12], address, resources)
+        self._publish("NODE", node_id, {"state": "ALIVE", **_node_public(self.nodes[node_id])})
+        return {"node_id": node_id, "cluster_view": self._cluster_view()}
+
+    def h_heartbeat(self, conn, node_id: str, available: Dict[str, float],
+                    total: Optional[Dict[str, float]] = None):
+        info = self.nodes.get(node_id)
+        if info is None or not info["alive"]:
+            return {"ok": False, "reason": "unknown or dead node"}
+        info["last_heartbeat"] = time.monotonic()
+        info["available"] = available
+        if total is not None:
+            info["total"] = total
+        return {"ok": True}
+
+    def h_drain_node(self, conn, node_id: str):
+        info = self.nodes.get(node_id)
+        if info:
+            info["draining"] = True
+        return True
+
+    def h_get_all_nodes(self, conn):
+        return [_node_public(n) for n in self.nodes.values()]
+
+    def h_get_cluster_view(self, conn):
+        return self._cluster_view()
+
+    def _cluster_view(self) -> Dict[str, Dict]:
+        return {nid: {"total": n["total"], "available": n["available"],
+                      "alive": n["alive"], "address": n["address"],
+                      "object_store_address": n["object_store_address"],
+                      "node_ip": n["node_ip"], "labels": n["labels"]}
+                for nid, n in self.nodes.items()}
+
+    async def _check_node_deaths(self):
+        while True:
+            await asyncio.sleep(HEARTBEAT_INTERVAL_S)
+            now = time.monotonic()
+            for node_id, info in list(self.nodes.items()):
+                if info["alive"] and now - info["last_heartbeat"] > NODE_DEATH_TIMEOUT_S:
+                    await self._mark_node_dead(node_id, "heartbeat timeout")
+
+    async def _mark_node_dead(self, node_id: str, reason: str):
+        info = self.nodes.get(node_id)
+        if info is None or not info["alive"]:
+            return
+        info["alive"] = False
+        logger.warning("node %s dead: %s", node_id[:12], reason)
+        self.node_conns.pop(node_id, None)
+        self._publish("NODE", node_id, {"state": "DEAD", "reason": reason,
+                                        **_node_public(info)})
+        # fail/restart actors that lived there
+        for actor_id, row in list(self.actors.items()):
+            if row.get("node_id") == node_id and row["state"] in (ALIVE, PENDING_CREATION):
+                await self._handle_actor_failure(
+                    actor_id, f"node {node_id[:12]} died: {reason}")
+
+    # ----------------------------------------------------------------- jobs
+    def h_register_job(self, conn, driver_address: str, metadata: Dict):
+        job_id = self._next_job_id
+        self._next_job_id += 1
+        self.jobs[job_id] = {"job_id": job_id, "driver_address": driver_address,
+                             "metadata": metadata, "start_time": time.time(),
+                             "finished": False}
+        return job_id
+
+    def h_finish_job(self, conn, job_id: int):
+        job = self.jobs.get(job_id)
+        if job:
+            job["finished"] = True
+            job["end_time"] = time.time()
+        self._publish("JOB", str(job_id), {"state": "FINISHED"})
+        return True
+
+    def h_get_all_jobs(self, conn):
+        return list(self.jobs.values())
+
+    # --------------------------------------------------------------- actors
+    async def h_create_actor(self, conn, spec: Dict):
+        """Register + schedule an actor. spec: actor_id, job_id, name,
+        namespace, resources, max_restarts, scheduling (strategy dict),
+        owner_address, definition (bytes key into KV function table),
+        init_args (serialized), options."""
+        actor_id = spec["actor_id"]
+        name = spec.get("name")
+        ns = spec.get("namespace", "default")
+        if name:
+            existing = self.named_actors.get((ns, name))
+            if existing is not None and self.actors[existing]["state"] != DEAD:
+                raise ValueError(f"actor name {name!r} already taken in namespace {ns!r}")
+            self.named_actors[(ns, name)] = actor_id
+        row = {
+            "actor_id": actor_id, "spec": spec, "state": PENDING_CREATION,
+            "name": name, "namespace": ns, "node_id": None, "address": None,
+            "restarts_remaining": spec.get("max_restarts", 0),
+            "death_cause": None, "num_restarts": 0,
+        }
+        self.actors[actor_id] = row
+        asyncio.ensure_future(self._schedule_actor(actor_id))
+        return True
+
+    async def _schedule_actor(self, actor_id: str, delay: float = 0.0):
+        if delay:
+            await asyncio.sleep(delay)
+        row = self.actors.get(actor_id)
+        if row is None or row["state"] == DEAD:
+            return
+        spec = row["spec"]
+        req = dict(spec.get("resources") or {})
+        sched = spec.get("scheduling") or {}
+        pg_id = sched.get("placement_group_id")
+        target = None
+        if pg_id:
+            pg = self.placement_groups.get(pg_id)
+            if pg is None or pg["state"] != "CREATED":
+                row["state"] = DEAD
+                row["death_cause"] = f"placement group {pg_id} not ready"
+                self._publish("ACTOR", actor_id, _actor_public(row))
+                return
+            idx = sched.get("placement_group_bundle_index", 0)
+            if idx < 0:
+                idx = 0
+            target = pg["node_ids"][idx]
+        else:
+            alive = {nid: n for nid, n in self.nodes.items() if n["alive"]
+                     and not n["draining"]}
+            target = scheduling.pick_node(
+                alive, req, strategy=sched.get("strategy", "DEFAULT"),
+                strategy_args=sched)
+        if target is None:
+            # infeasible right now: retry until resources appear
+            asyncio.ensure_future(self._schedule_actor(actor_id, delay=0.5))
+            return
+        node_conn = self.node_conns.get(target)
+        if node_conn is None or node_conn.closed:
+            asyncio.ensure_future(self._schedule_actor(actor_id, delay=0.2))
+            return
+        try:
+            result = await node_conn.call("create_actor", spec=spec,
+                                          pg_id=pg_id,
+                                          bundle_index=sched.get(
+                                              "placement_group_bundle_index", 0))
+        except (rpc.RpcError, rpc.ConnectionLost) as e:
+            logger.warning("actor %s creation on %s failed: %s",
+                           actor_id[:12], target[:12], e)
+            await self._handle_actor_failure(actor_id, f"creation failed: {e}")
+            return
+        row = self.actors.get(actor_id)
+        if row is None or row["state"] == DEAD:
+            return
+        row["state"] = ALIVE
+        row["node_id"] = target
+        row["address"] = result["worker_address"]
+        row["worker_id"] = result["worker_id"]
+        self._publish("ACTOR", actor_id, _actor_public(row))
+
+    async def _handle_actor_failure(self, actor_id: str, reason: str):
+        row = self.actors.get(actor_id)
+        if row is None or row["state"] == DEAD:
+            return
+        if row["restarts_remaining"] != 0:
+            if row["restarts_remaining"] > 0:
+                row["restarts_remaining"] -= 1
+            row["num_restarts"] += 1
+            row["state"] = RESTARTING
+            row["address"] = None
+            row["node_id"] = None
+            self._publish("ACTOR", actor_id, _actor_public(row))
+            asyncio.ensure_future(self._schedule_actor(actor_id))
+        else:
+            row["state"] = DEAD
+            row["death_cause"] = reason
+            self._publish("ACTOR", actor_id, _actor_public(row))
+
+    def h_get_actor_info(self, conn, actor_id: str):
+        row = self.actors.get(actor_id)
+        return _actor_public(row) if row else None
+
+    def h_get_named_actor(self, conn, name: str, namespace: str = "default"):
+        actor_id = self.named_actors.get((namespace, name))
+        if actor_id is None:
+            return None
+        row = self.actors[actor_id]
+        if row["state"] == DEAD:
+            return None
+        return _actor_public(row)
+
+    def h_list_named_actors(self, conn, namespace: Optional[str] = None):
+        out = []
+        for (ns, name), aid in self.named_actors.items():
+            if namespace is not None and ns != namespace:
+                continue
+            if self.actors.get(aid, {}).get("state") != DEAD:
+                out.append({"name": name, "namespace": ns, "actor_id": aid})
+        return out
+
+    def h_get_all_actors(self, conn):
+        return [_actor_public(r) for r in self.actors.values()]
+
+    async def h_report_actor_failure(self, conn, actor_id: str, reason: str):
+        await self._handle_actor_failure(actor_id, reason)
+        return True
+
+    async def h_kill_actor(self, conn, actor_id: str, no_restart: bool = True):
+        row = self.actors.get(actor_id)
+        if row is None:
+            return False
+        if no_restart:
+            row["restarts_remaining"] = 0
+        node_conn = self.node_conns.get(row.get("node_id"))
+        row["state"] = DEAD
+        row["death_cause"] = "ray_tpu.kill"
+        if row.get("name"):
+            self.named_actors.pop((row["namespace"], row["name"]), None)
+        self._publish("ACTOR", actor_id, _actor_public(row))
+        if node_conn is not None and not node_conn.closed:
+            try:
+                await node_conn.call("kill_worker", worker_id=row.get("worker_id"),
+                                     reason="actor killed")
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass
+        return True
+
+    # --------------------------------------------------------------- pubsub
+    def h_subscribe(self, conn, channel: str):
+        self.subscribers.setdefault(channel, set()).add(conn)
+        return True
+
+    def h_publish(self, conn, channel: str, key: str, payload: Any):
+        self._publish(channel, key, payload)
+        return True
+
+    def _publish(self, channel: str, key: str, payload: Any):
+        for sub in list(self.subscribers.get(channel, ())):
+            if sub.closed:
+                self.subscribers[channel].discard(sub)
+                continue
+            asyncio.ensure_future(self._safe_notify(sub, channel, key, payload))
+
+    async def _safe_notify(self, conn, channel, key, payload):
+        try:
+            await conn.notify("pubsub", channel=channel, key=key, payload=payload)
+        except Exception:
+            self.subscribers.get(channel, set()).discard(conn)
+
+    # ----------------------------------------------------- placement groups
+    async def h_create_placement_group(self, conn, pg_id: str,
+                                       bundles: List[Dict[str, float]],
+                                       strategy: str = "PACK",
+                                       name: str = ""):
+        """Two-phase bundle reservation (reference:
+        gcs_placement_group_scheduler Prepare/Commit)."""
+        alive = {nid: n for nid, n in self.nodes.items()
+                 if n["alive"] and not n["draining"]}
+        placement = scheduling.schedule_bundles(alive, bundles, strategy)
+        row = {"pg_id": pg_id, "bundles": bundles, "strategy": strategy,
+               "name": name, "state": "PENDING", "node_ids": None}
+        self.placement_groups[pg_id] = row
+        if placement is None:
+            row["state"] = "PENDING"   # infeasible now; retried by caller wait
+            return {"state": "PENDING"}
+        # phase 1: prepare on every node
+        prepared = []
+        ok = True
+        for idx, (nid, bundle) in enumerate(zip(placement, bundles)):
+            node_conn = self.node_conns.get(nid)
+            if node_conn is None or node_conn.closed:
+                ok = False
+                break
+            try:
+                good = await node_conn.call("prepare_bundle", pg_id=pg_id,
+                                            bundle_index=idx, resources=bundle)
+            except (rpc.RpcError, rpc.ConnectionLost):
+                good = False
+            if not good:
+                ok = False
+                break
+            prepared.append((nid, idx))
+        if not ok:
+            for nid, idx in prepared:
+                node_conn = self.node_conns.get(nid)
+                if node_conn and not node_conn.closed:
+                    try:
+                        await node_conn.call("return_bundle", pg_id=pg_id,
+                                             bundle_index=idx)
+                    except (rpc.RpcError, rpc.ConnectionLost):
+                        pass
+            return {"state": "PENDING"}
+        # phase 2: commit
+        for nid, idx in prepared:
+            node_conn = self.node_conns.get(nid)
+            try:
+                await node_conn.call("commit_bundle", pg_id=pg_id, bundle_index=idx)
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass
+        row["state"] = "CREATED"
+        row["node_ids"] = placement
+        self._publish("PG", pg_id, {"state": "CREATED", "node_ids": placement})
+        return {"state": "CREATED", "node_ids": placement}
+
+    async def h_remove_placement_group(self, conn, pg_id: str):
+        row = self.placement_groups.get(pg_id)
+        if row is None:
+            return False
+        if row.get("node_ids"):
+            for idx, nid in enumerate(row["node_ids"]):
+                node_conn = self.node_conns.get(nid)
+                if node_conn and not node_conn.closed:
+                    try:
+                        await node_conn.call("return_bundle", pg_id=pg_id,
+                                             bundle_index=idx)
+                    except (rpc.RpcError, rpc.ConnectionLost):
+                        pass
+        row["state"] = "REMOVED"
+        self._publish("PG", pg_id, {"state": "REMOVED"})
+        return True
+
+    def h_get_placement_group(self, conn, pg_id: str):
+        row = self.placement_groups.get(pg_id)
+        if row is None:
+            return None
+        return {k: row[k] for k in ("pg_id", "bundles", "strategy", "name",
+                                    "state", "node_ids")}
+
+    def h_get_all_placement_groups(self, conn):
+        return [self.h_get_placement_group(conn, pid)
+                for pid in self.placement_groups]
+
+
+def _node_public(n: Dict) -> Dict:
+    return {k: n[k] for k in ("node_id", "address", "object_store_address",
+                              "node_ip", "total", "available", "labels",
+                              "alive")}
+
+
+def _actor_public(row: Dict) -> Dict:
+    return {"actor_id": row["actor_id"], "state": row["state"],
+            "name": row.get("name"), "namespace": row.get("namespace"),
+            "node_id": row.get("node_id"), "address": row.get("address"),
+            "death_cause": row.get("death_cause"),
+            "num_restarts": row.get("num_restarts", 0),
+            "method_names": (row.get("spec") or {}).get("method_names") or [],
+            "resources": (row.get("spec") or {}).get("resources") or {}}
+
+
+def main():
+    import argparse
+    import sys
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--session-name", default="session")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="[gcs] %(asctime)s %(levelname)s %(message)s")
+
+    async def run():
+        gcs = GcsServer(port=args.port, session_name=args.session_name)
+        addr = await gcs.start()
+        # announce the bound address on stdout for the supervisor
+        print(f"GCS_ADDRESS={addr}", flush=True)
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,669 @@
+"""Node manager — the per-node daemon (raylet equivalent).
+
+Re-design of the reference's raylet (reference: src/ray/raylet/node_manager.h:119,
+worker_pool.h:174, local_task_manager.cc, object_manager/object_manager.h:117).
+Owns the node's resource accounting, the worker pool, lease grants for task
+execution, placement-group bundle reservations, and node-to-node object
+transfer against the shared-memory arena (object_store.py). Differences:
+
+- Scheduling is lease-granting only: callers push tasks directly to leased
+  workers; the node manager never sees task payloads (the reference routes
+  the lease the same way but also manages arg-dependency pulls — here the
+  executing worker pulls its own args through this daemon's pull_object).
+- Spillback is an explicit redirect reply carrying the chosen node's
+  address (reference: spillback in local_task_manager.cc).
+- Object transfer is whole-object-chunked RPC between node managers; the
+  store arena is mapped by every local process so serving bytes is a
+  zero-copy read (reference: chunked gRPC Push/Pull, pull_manager.h:52).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private import rpc, scheduling
+from ray_tpu._private.object_store import ObjectStoreClient
+
+logger = logging.getLogger(__name__)
+
+FETCH_CHUNK = 64 * 1024 * 1024
+HEARTBEAT_S = 0.5
+VIEW_REFRESH_S = 1.0
+
+
+class WorkerProc:
+    __slots__ = ("worker_id", "address", "pid", "conn", "proc", "state",
+                 "actor_id", "lease_id", "registered")
+
+    def __init__(self, proc=None):
+        self.worker_id = None
+        self.address = None
+        self.pid = None
+        self.conn: Optional[rpc.Connection] = None
+        self.proc: Optional[subprocess.Popen] = proc
+        self.state = "starting"        # starting | idle | leased | actor | dead
+        self.actor_id: Optional[str] = None
+        self.lease_id: Optional[str] = None
+        self.registered = asyncio.Event()
+
+
+class NodeManager:
+    def __init__(self, gcs_address: str, node_id: Optional[str] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 session_name: str = "session",
+                 store_bytes: int = 0, port: int = 0,
+                 store_path: Optional[str] = None):
+        self.gcs_address = gcs_address
+        self.node_id = node_id or os.urandom(16).hex()
+        self.session_name = session_name
+        self.labels = labels or {}
+        self.port = port
+        ncpu = os.cpu_count() or 1
+        self.total = dict(resources or {})
+        self.total.setdefault("CPU", float(ncpu))
+        self.total.setdefault("memory", float(2 * 1024**3))
+        self.total.setdefault("object_store_memory",
+                              float(store_bytes or 512 * 1024**2))
+        self.available = dict(self.total)
+        self.store_path = store_path or \
+            f"/dev/shm/raytpu_{session_name}_{self.node_id[:12]}"
+        self.store_bytes = int(store_bytes or self.total["object_store_memory"])
+
+        self.gcs: Optional[rpc.Connection] = None
+        self.server: Optional[rpc.Server] = None
+        self.address: Optional[str] = None
+        self.unix_address: Optional[str] = None
+        self.store: Optional[ObjectStoreClient] = None
+        self.pool = rpc.ConnectionPool(name=f"nm-{self.node_id[:8]}")
+
+        self.workers: Dict[str, WorkerProc] = {}
+        self._idle: List[WorkerProc] = []
+        self._spawning = 0
+        self._lease_waiters: List[asyncio.Future] = []
+        self._leases: Dict[str, Dict] = {}
+        self._lease_seq = 0
+        self.bundles: Dict[tuple, Dict] = {}   # (pg_id, idx) -> {resources, available, committed}
+        self.cluster_view: Dict[str, Dict] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._draining = False
+        self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
+
+    # -------------------------------------------------------------- lifecycle
+    async def start(self) -> str:
+        self.store = ObjectStoreClient(self.store_path, create=True,
+                                       size=self.store_bytes)
+        handlers = {
+            "register_worker": self.h_register_worker,
+            "request_lease": self.h_request_lease,
+            "return_lease": self.h_return_lease,
+            "create_actor": self.h_create_actor,
+            "kill_worker": self.h_kill_worker,
+            "prepare_bundle": self.h_prepare_bundle,
+            "commit_bundle": self.h_commit_bundle,
+            "return_bundle": self.h_return_bundle,
+            "pull_object": self.h_pull_object,
+            "fetch_object": self.h_fetch_object,
+            "free_object": self.h_free_object,
+            "free_remote_object": self.h_free_remote_object,
+            "get_node_info": self.h_get_node_info,
+            "ping": lambda conn: "pong",
+        }
+        self.server = rpc.Server(handlers, name=f"nm-{self.node_id[:8]}")
+        self.server.on_disconnect = self._on_disconnect
+        self.address = await self.server.listen_tcp("0.0.0.0", self.port)
+        self.unix_address = await self.server.listen_unix(
+            f"/tmp/raytpu/{self.session_name}/nm_{self.node_id[:12]}.sock")
+        self.gcs = await rpc.connect(
+            self.gcs_address, handlers={
+                "create_actor": self.h_create_actor,
+                "kill_worker": self.h_kill_worker,
+                "prepare_bundle": self.h_prepare_bundle,
+                "commit_bundle": self.h_commit_bundle,
+                "return_bundle": self.h_return_bundle,
+                "pubsub": self.h_pubsub,
+            }, name="nm->gcs", retries=20)
+        resp = await self.gcs.call(
+            "register_node", node_id=self.node_id, address=self.address,
+            object_store_address=self.store_path,
+            resources=self.total, labels=self.labels,
+            node_ip=rpc.node_ip_address())
+        self.cluster_view = resp["cluster_view"]
+        await self.gcs.call("subscribe", channel="NODE")
+        self._tasks = [
+            asyncio.ensure_future(self._heartbeat_loop()),
+            asyncio.ensure_future(self._view_refresh_loop()),
+            asyncio.ensure_future(self._reap_children_loop()),
+        ]
+        logger.info("node manager %s at %s (store %s, %s)",
+                    self.node_id[:12], self.address, self.store_path,
+                    {k: v for k, v in self.total.items() if v})
+        return self.address
+
+    async def stop(self):
+        for t in self._tasks:
+            t.cancel()
+        for w in self.workers.values():
+            self._kill_proc(w)
+        await self.server.close()
+        if self.gcs:
+            await self.gcs.close()
+        await self.pool.close()
+        if self.store:
+            self.store.close()
+        try:
+            os.unlink(self.store_path)
+        except OSError:
+            pass
+
+    def _kill_proc(self, w: WorkerProc):
+        if w.proc is not None and w.proc.poll() is None:
+            try:
+                w.proc.kill()
+            except OSError:
+                pass
+
+    async def _heartbeat_loop(self):
+        while True:
+            try:
+                await self.gcs.call("heartbeat", node_id=self.node_id,
+                                    available=self._reported_available())
+            except (rpc.RpcError, rpc.ConnectionLost):
+                logger.warning("heartbeat failed; reconnecting to GCS")
+                try:
+                    self.gcs = await rpc.connect(
+                        self.gcs_address, handlers=self.gcs.handlers,
+                        name="nm->gcs", retries=20)
+                    await self.gcs.call(
+                        "register_node", node_id=self.node_id,
+                        address=self.address,
+                        object_store_address=self.store_path,
+                        resources=self.total, labels=self.labels,
+                        node_ip=rpc.node_ip_address())
+                    await self.gcs.call("subscribe", channel="NODE")
+                except Exception:
+                    pass
+            await asyncio.sleep(HEARTBEAT_S)
+
+    def _reported_available(self) -> Dict[str, float]:
+        avail = dict(self.available)
+        if self.store is not None:
+            st = self.store.stats()
+            avail["object_store_memory"] = max(
+                0.0, float(self.store_bytes - st["bytes_in_use"]))
+        return avail
+
+    async def _view_refresh_loop(self):
+        while True:
+            await asyncio.sleep(VIEW_REFRESH_S)
+            try:
+                self.cluster_view = await self.gcs.call("get_cluster_view")
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass
+
+    async def _reap_children_loop(self):
+        while True:
+            await asyncio.sleep(1.0)
+            for w in list(self.workers.values()):
+                if w.proc is not None and w.proc.poll() is not None \
+                        and w.state != "dead":
+                    await self._on_worker_death(w, f"exit code {w.proc.returncode}")
+
+    def h_pubsub(self, conn, channel, key, payload):
+        if channel == "NODE":
+            if payload.get("state") == "DEAD":
+                view = self.cluster_view.get(key)
+                if view:
+                    view["alive"] = False
+
+    # ------------------------------------------------------------ worker pool
+    def _spawn_worker(self) -> WorkerProc:
+        env = dict(os.environ)
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        cmd = [sys.executable, "-m", "ray_tpu._private.worker_main",
+               "--node-address", self.unix_address,
+               "--gcs-address", self.gcs_address,
+               "--store-path", self.store_path,
+               "--node-id", self.node_id,
+               "--session-name", self.session_name]
+        # detach stdio so workers never hold a driver/pytest pipe open;
+        # logs go to the session log dir (reference: per-process log files
+        # under the session dir, python/ray/_private/log_monitor.py)
+        log_dir = f"/tmp/raytpu/{self.session_name}/logs"
+        os.makedirs(log_dir, exist_ok=True)
+        logf = open(os.path.join(log_dir, "workers.err"), "ab")
+        proc = subprocess.Popen(cmd, env=env, stdin=subprocess.DEVNULL,
+                                stdout=subprocess.DEVNULL, stderr=logf,
+                                start_new_session=True)
+        logf.close()
+        w = WorkerProc(proc)
+        self._spawning += 1
+        return w
+
+    def h_register_worker(self, conn, worker_id: str, address: str, pid: int,
+                          mode: str):
+        w = None
+        # match a spawned-but-unregistered proc by pid
+        for cand in self.workers.values():
+            if cand.proc is not None and cand.proc.pid == pid:
+                w = cand
+                break
+        if w is None:
+            w = WorkerProc()
+            if mode == "worker":
+                pass
+        w.worker_id = worker_id
+        w.address = address
+        w.pid = pid
+        w.conn = conn
+        conn.peer_info["worker_id"] = worker_id
+        self.workers[worker_id] = w
+        if mode == "driver":
+            w.state = "driver"
+        elif w.state == "starting":
+            self._spawning = max(0, self._spawning - 1)
+            w.state = "idle"
+            self._idle.append(w)
+            self._wake_lease_waiters()
+        w.registered.set()
+        return {"node_id": self.node_id}
+
+    def _on_disconnect(self, conn: rpc.Connection):
+        wid = conn.peer_info.get("worker_id")
+        if wid is None:
+            return
+        w = self.workers.get(wid)
+        if w is not None and w.state not in ("dead", "driver"):
+            asyncio.ensure_future(self._on_worker_death(w, "connection lost"))
+        elif w is not None and w.state == "driver":
+            self.workers.pop(wid, None)
+
+    async def _on_worker_death(self, w: WorkerProc, reason: str):
+        prev_state = w.state
+        w.state = "dead"
+        if w in self._idle:
+            self._idle.remove(w)
+        self.workers.pop(w.worker_id, None)
+        self._kill_proc(w)
+        if w.lease_id is not None:
+            self._release_lease(w.lease_id, worker_dead=True)
+        if prev_state == "actor" and w.actor_id is not None:
+            try:
+                await self.gcs.call("report_actor_failure", actor_id=w.actor_id,
+                                    reason=f"worker died: {reason}")
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass
+
+    async def _obtain_worker(self, timeout: float = 60.0) -> WorkerProc:
+        """Pop an idle worker, spawning a new process if needed."""
+        while True:
+            while self._idle:
+                w = self._idle.pop()
+                if w.state == "idle":
+                    return w
+            w = self._spawn_worker()
+            # temporary key until registration rebinds by worker_id
+            self.workers[f"spawn-{w.proc.pid}"] = w
+            try:
+                await asyncio.wait_for(w.registered.wait(), timeout)
+            except asyncio.TimeoutError:
+                self._kill_proc(w)
+                raise RuntimeError("worker failed to start in time")
+            self.workers.pop(f"spawn-{w.proc.pid}", None)
+            if w.state == "idle" and w in self._idle:
+                self._idle.remove(w)
+                return w
+            # else someone else grabbed it; loop
+
+    def _wake_lease_waiters(self):
+        for fut in self._lease_waiters:
+            if not fut.done():
+                fut.set_result(None)
+        self._lease_waiters.clear()
+
+    # ---------------------------------------------------------------- leases
+    def _bundle_pool(self, scheduling_opts: Dict) -> Optional[Dict]:
+        pg_id = scheduling_opts.get("placement_group_id")
+        if not pg_id:
+            return None
+        idx = scheduling_opts.get("placement_group_bundle_index", 0)
+        return self.bundles.get((pg_id, idx))
+
+    async def h_request_lease(self, conn, resources: Dict[str, float],
+                              scheduling: Dict, worker_id: str):
+        """Grant a worker lease, queue, or redirect (spillback)."""
+        deadline = time.monotonic() + 300.0
+        strategy = scheduling.get("strategy", "DEFAULT")
+        while True:
+            bundle = self._bundle_pool(scheduling)
+            pool_avail = bundle["available"] if bundle else self.available
+            if scheduling.get("placement_group_id") and bundle is None:
+                # bundle lives on another node: redirect the caller there
+                spill = await self._bundle_node_address(scheduling)
+                if spill is not None:
+                    return {"status": "spill", "spill_to": spill}
+                return {"status": "error",
+                        "reason": "placement group bundle not found"}
+            if bundle is None and strategy in ("NODE_AFFINITY", "SPREAD"):
+                # strategy decides the node even when we fit locally
+                view = self._live_view()
+                target = scheduling_pick(view, resources, scheduling,
+                                         self.node_id)
+                if target is None:
+                    if strategy == "NODE_AFFINITY" and not scheduling.get("soft"):
+                        return {"status": "error",
+                                "reason": "affinity node unavailable"}
+                elif target != self.node_id:
+                    return {"status": "spill",
+                            "spill_to": view[target]["address"]}
+            if scheduling_fits(pool_avail, resources):
+                scheduling_sub(pool_avail, resources)
+                try:
+                    w = await self._obtain_worker()
+                except RuntimeError as e:
+                    scheduling_addback(pool_avail, resources)
+                    return {"status": "error", "reason": str(e)}
+                self._lease_seq += 1
+                lease_id = f"{self.node_id[:8]}-{self._lease_seq}"
+                w.state = "leased"
+                w.lease_id = lease_id
+                self._leases[lease_id] = {"worker": w, "resources": resources,
+                                          "bundle": bundle}
+                return {"status": "ok", "lease_id": lease_id,
+                        "worker_address": w.address,
+                        "node_address": self.address,
+                        "node_id": self.node_id}
+            if bundle is None:
+                # consider spillback using the cluster view
+                view = self._live_view()
+                target = scheduling_pick(view, resources, scheduling, self.node_id)
+                if target is not None and target != self.node_id:
+                    return {"status": "spill",
+                            "spill_to": view[target]["address"]}
+                if target is None and not scheduling_feasible_anywhere(
+                        view, resources, self.total):
+                    return {"status": "error",
+                            "reason": f"resources {resources} unschedulable "
+                                      f"anywhere in the cluster"}
+            # wait for resources to free up locally
+            if time.monotonic() > deadline:
+                return {"status": "error", "reason": "lease wait timed out"}
+            fut = asyncio.get_event_loop().create_future()
+            self._lease_waiters.append(fut)
+            try:
+                await asyncio.wait_for(fut, timeout=1.0)
+            except asyncio.TimeoutError:
+                pass
+
+    def _live_view(self) -> Dict[str, Dict]:
+        view = {nid: v for nid, v in self.cluster_view.items()
+                if v.get("alive", True)}
+        if self.node_id in view:
+            view[self.node_id] = {**view[self.node_id],
+                                  "available": self._reported_available(),
+                                  "total": self.total}
+        return view
+
+    async def _bundle_node_address(self, sched: Dict) -> Optional[str]:
+        pg_id = sched.get("placement_group_id")
+        idx = sched.get("placement_group_bundle_index", 0)
+        try:
+            info = await self.gcs.call("get_placement_group", pg_id=pg_id)
+        except (rpc.RpcError, rpc.ConnectionLost):
+            return None
+        if not info or info.get("state") != "CREATED":
+            return None
+        node_ids = info.get("node_ids") or []
+        if idx < 0 or idx >= len(node_ids):
+            return None
+        target = node_ids[idx]
+        if target == self.node_id:
+            return None   # bundle claims to be here but isn't (race)
+        view = self.cluster_view.get(target)
+        return view["address"] if view and view.get("alive", True) else None
+
+    def h_return_lease(self, conn, lease_id: str, worker_dead: bool = False):
+        self._release_lease(lease_id, worker_dead)
+        return True
+
+    def _release_lease(self, lease_id: str, worker_dead: bool):
+        info = self._leases.pop(lease_id, None)
+        if info is None:
+            return
+        pool_avail = info["bundle"]["available"] if info["bundle"] else self.available
+        scheduling_addback(pool_avail, info["resources"])
+        w = info["worker"]
+        w.lease_id = None
+        if not worker_dead and w.state == "leased":
+            w.state = "idle"
+            self._idle.append(w)
+        self._wake_lease_waiters()
+
+    # ---------------------------------------------------------------- actors
+    async def h_create_actor(self, conn, spec: Dict, pg_id=None, bundle_index=0):
+        resources = dict(spec.get("resources") or {})
+        bundle = self.bundles.get((pg_id, bundle_index)) if pg_id else None
+        pool_avail = bundle["available"] if bundle else self.available
+        # queue for resources (leases drain within their idle timeout)
+        deadline = time.monotonic() + 60.0
+        while not scheduling_fits(pool_avail, resources):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"insufficient resources for actor: {resources}")
+            fut = asyncio.get_event_loop().create_future()
+            self._lease_waiters.append(fut)
+            try:
+                await asyncio.wait_for(fut, timeout=0.5)
+            except asyncio.TimeoutError:
+                pass
+        scheduling_sub(pool_avail, resources)
+        try:
+            w = await self._obtain_worker()
+        except RuntimeError:
+            scheduling_addback(pool_avail, resources)
+            raise
+        w.state = "actor"
+        w.actor_id = spec["actor_id"]
+        # register the reservation as a lease keyed off the worker so
+        # _on_worker_death releases the resources on crash
+        lease_id = f"actor-{spec['actor_id']}-{w.worker_id[:8]}"
+        w.lease_id = lease_id
+        self._leases[lease_id] = {"worker": w, "resources": resources,
+                                  "bundle": bundle}
+        try:
+            await w.conn.call("become_actor", spec=spec)
+        except (rpc.RpcError, rpc.ConnectionLost) as e:
+            await self._on_worker_death(w, f"actor init failed: {e}")
+            raise RuntimeError(f"actor __init__ failed: {e}")
+        return {"worker_address": w.address, "worker_id": w.worker_id}
+
+    async def h_kill_worker(self, conn, worker_id: str, reason: str = ""):
+        w = self.workers.get(worker_id)
+        if w is None:
+            return False
+        w.state = "dead"
+        if w.lease_id is not None:
+            self._release_lease(w.lease_id, worker_dead=True)
+        if w.conn is not None and not w.conn.closed:
+            try:
+                await w.conn.call("exit", reason=reason, timeout=1.0)
+            except Exception:
+                pass
+        await asyncio.sleep(0.1)
+        self._kill_proc(w)
+        self.workers.pop(worker_id, None)
+        return True
+
+    # --------------------------------------------------------------- bundles
+    def h_prepare_bundle(self, conn, pg_id: str, bundle_index: int,
+                         resources: Dict[str, float]):
+        if not scheduling_fits(self.available, resources):
+            return False
+        scheduling_sub(self.available, resources)
+        self.bundles[(pg_id, bundle_index)] = {
+            "resources": dict(resources), "available": dict(resources),
+            "committed": False}
+        return True
+
+    def h_commit_bundle(self, conn, pg_id: str, bundle_index: int):
+        b = self.bundles.get((pg_id, bundle_index))
+        if b is not None:
+            b["committed"] = True
+        return True
+
+    def h_return_bundle(self, conn, pg_id: str, bundle_index: int):
+        b = self.bundles.pop((pg_id, bundle_index), None)
+        if b is not None:
+            scheduling_addback(self.available, b["resources"])
+            self._wake_lease_waiters()
+        return True
+
+    # ------------------------------------------------------- object transfer
+    async def h_pull_object(self, conn, oid: bytes, node_id: str):
+        """Pull an object from a remote node into the local store
+        (admission-deduplicated like the reference's PullManager)."""
+        if self.store.contains(oid):
+            return True
+        inflight = self._pulls_inflight.get(oid)
+        if inflight is not None:
+            return await asyncio.shield(inflight)
+        fut = asyncio.get_event_loop().create_future()
+        self._pulls_inflight[oid] = fut
+        try:
+            view = self.cluster_view.get(node_id)
+            if view is None:
+                self.cluster_view = await self.gcs.call("get_cluster_view")
+                view = self.cluster_view.get(node_id)
+            if view is None:
+                raise RuntimeError(f"unknown node {node_id}")
+            addr = view["address"]
+            meta = await self.pool.call(addr, "fetch_object", oid=oid,
+                                        part="meta")
+            if meta is None:
+                raise RuntimeError(f"{oid.hex()[:16]} not on node {node_id[:12]}")
+            data_size = meta["data_size"]
+            bufs = self.store.create(oid, data_size, len(meta["meta"]))
+            if bufs is not None:
+                data, meta_view = bufs
+                meta_view[:] = meta["meta"]
+                off = 0
+                while off < data_size:
+                    n = min(FETCH_CHUNK, data_size - off)
+                    chunk = await self.pool.call(addr, "fetch_object", oid=oid,
+                                                 part="data", offset=off,
+                                                 length=n)
+                    data[off:off + len(chunk)] = chunk
+                    off += len(chunk)
+                self.store.seal(oid)
+            fut.set_result(True)
+            return True
+        except Exception as e:
+            try:
+                self.store.abort(oid)
+            except Exception:
+                pass
+            fut.set_exception(e)
+            raise
+        finally:
+            self._pulls_inflight.pop(oid, None)
+            if not fut.done():
+                fut.cancel()
+
+    def h_fetch_object(self, conn, oid: bytes, part: str = "meta",
+                       offset: int = 0, length: int = 0):
+        buf = self.store.get(oid)
+        if buf is None:
+            return None
+        try:
+            if part == "meta":
+                return {"data_size": len(buf.data), "meta": buf.metadata}
+            return bytes(buf.data[offset:offset + length])
+        finally:
+            buf.close()
+
+    def h_free_object(self, conn, oid: bytes):
+        try:
+            self.store.delete(oid)
+        except Exception:
+            pass
+        return True
+
+    async def h_free_remote_object(self, conn, oid: bytes, node_id: str):
+        if node_id == self.node_id:
+            return self.h_free_object(conn, oid)
+        view = self.cluster_view.get(node_id)
+        if view is not None and view.get("alive", True):
+            try:
+                await self.pool.call(view["address"], "free_object", oid=oid)
+            except Exception:
+                pass
+        return True
+
+    def h_get_node_info(self, conn):
+        return {"node_id": self.node_id, "address": self.address,
+                "store_path": self.store_path, "total": self.total,
+                "available": self._reported_available(),
+                "num_workers": len(self.workers)}
+
+
+# thin aliases so the handler bodies read clearly
+scheduling_fits = scheduling.fits
+scheduling_sub = scheduling.subtract
+scheduling_addback = scheduling.add_back
+
+
+def scheduling_pick(view, resources, sched_opts, self_node_id):
+    return scheduling.pick_node(view, resources,
+                                strategy=sched_opts.get("strategy", "DEFAULT"),
+                                preferred_node=self_node_id,
+                                strategy_args=sched_opts)
+
+
+def scheduling_feasible_anywhere(view, resources, self_total):
+    if scheduling.feasible(self_total, resources):
+        return True
+    return any(scheduling.feasible(v["total"], resources)
+               for v in view.values() if v.get("alive", True))
+
+
+def main():
+    import argparse
+    import json
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--node-id", default=None)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--session-name", default="session")
+    parser.add_argument("--store-bytes", type=int, default=0)
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="[node] %(asctime)s %(levelname)s %(message)s")
+
+    async def run():
+        nm = NodeManager(gcs_address=args.gcs_address, node_id=args.node_id,
+                         resources=json.loads(args.resources),
+                         labels=json.loads(args.labels),
+                         session_name=args.session_name,
+                         store_bytes=args.store_bytes, port=args.port)
+        addr = await nm.start()
+        print(f"NODE_ADDRESS={addr}", flush=True)
+        print(f"NODE_ID={nm.node_id}", flush=True)
+        print(f"STORE_PATH={nm.store_path}", flush=True)
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

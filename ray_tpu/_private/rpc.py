@@ -1,0 +1,340 @@
+"""Asyncio RPC: length-prefixed msgpack frames over unix/tcp sockets.
+
+The control-plane transport for the whole runtime — the role gRPC plays in
+the reference (reference: src/ray/rpc/grpc_server.h, client_call.h). Design
+differences, deliberately: one tiny symmetric protocol instead of per-service
+protobuf schemas; connections are bidirectional (either side may issue
+requests over an established connection), which removes the server→client
+callback channels the reference needs (pubsub long-polling, owner RPCs).
+
+Frame:   [u32 little-endian length][msgpack payload]
+Payload: [type, seq, method, kwargs]          type: 0=request 1=response
+         [1, seq, ok, result_or_error]              2=notify (no response)
+Large binary values ride inside msgpack bin fields; bulk object payloads
+never transit this layer (they live in the shm store / object transfer path).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import os
+import socket
+import traceback
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+REQUEST = 0
+RESPONSE = 1
+NOTIFY = 2
+
+_MAX_FRAME = 1 << 31
+
+
+class RpcError(Exception):
+    """Remote handler raised; carries the remote traceback text."""
+
+    def __init__(self, kind: str, message: str, remote_tb: str = ""):
+        super().__init__(f"{kind}: {message}")
+        self.kind = kind
+        self.message = message
+        self.remote_tb = remote_tb
+
+
+class ConnectionLost(Exception):
+    pass
+
+
+def _pack(obj) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def _unpack(data) -> Any:
+    return msgpack.unpackb(data, raw=False, strict_map_key=False)
+
+
+class Connection:
+    """One bidirectional framed connection. Both peers can call/notify."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                 handlers: Optional[Dict[str, Callable]] = None, name: str = "?"):
+        self.reader = reader
+        self.writer = writer
+        self.handlers = handlers if handlers is not None else {}
+        self.name = name
+        self._seq = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._writer_lock = asyncio.Lock()
+        self._task: Optional[asyncio.Task] = None
+        self.on_close: Optional[Callable[["Connection"], None]] = None
+        # opaque slot for servers to stash peer identity (node id, worker id)
+        self.peer_info: Dict[str, Any] = {}
+
+    def start(self):
+        self._task = asyncio.ensure_future(self._read_loop())
+        return self
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    async def _read_loop(self):
+        try:
+            while True:
+                hdr = await self.reader.readexactly(4)
+                n = int.from_bytes(hdr, "little")
+                if n > _MAX_FRAME:
+                    raise ConnectionLost(f"frame too large: {n}")
+                body = await self.reader.readexactly(n)
+                msg = _unpack(body)
+                mtype = msg[0]
+                if mtype == REQUEST or mtype == NOTIFY:
+                    asyncio.ensure_future(self._dispatch(msg))
+                elif mtype == RESPONSE:
+                    _, seq, ok, payload = msg
+                    fut = self._pending.pop(seq, None)
+                    if fut is not None and not fut.done():
+                        if ok:
+                            fut.set_result(payload)
+                        else:
+                            fut.set_exception(RpcError(*payload))
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                ConnectionLost, BrokenPipeError, OSError):
+            pass
+        except Exception:
+            logger.exception("rpc read loop error on %s", self.name)
+        finally:
+            await self._shutdown()
+
+    async def _shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(f"connection {self.name} lost"))
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        if self.on_close is not None:
+            try:
+                cb = self.on_close
+                self.on_close = None
+                res = cb(self)
+                if asyncio.iscoroutine(res):
+                    await res
+            except Exception:
+                logger.exception("on_close callback failed for %s", self.name)
+
+    async def _dispatch(self, msg):
+        mtype, seq, method, kwargs = msg
+        handler = self.handlers.get(method)
+        if handler is None:
+            if mtype == REQUEST:
+                await self._send([RESPONSE, seq, False,
+                                  ("NotImplementedError", f"no handler {method!r}", "")])
+            return
+        try:
+            result = handler(self, **kwargs)
+            if asyncio.iscoroutine(result) or isinstance(result, Awaitable):
+                result = await result
+            if mtype == REQUEST:
+                await self._send([RESPONSE, seq, True, result])
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            if mtype == REQUEST:
+                await self._send([RESPONSE, seq, False,
+                                  (type(e).__name__, str(e), traceback.format_exc())])
+            else:
+                logger.exception("notify handler %s failed", method)
+
+    async def _send(self, obj):
+        data = _pack(obj)
+        async with self._writer_lock:
+            if self._closed:
+                raise ConnectionLost(f"connection {self.name} closed")
+            self.writer.write(len(data).to_bytes(4, "little"))
+            self.writer.write(data)
+            await self.writer.drain()
+
+    async def call(self, method: str, timeout: Optional[float] = None, **kwargs) -> Any:
+        fut = await self.call_start(method, **kwargs)
+        if timeout is not None:
+            return await asyncio.wait_for(fut, timeout)
+        return await fut
+
+    async def call_start(self, method: str, **kwargs) -> asyncio.Future:
+        """Issue the request and return the response future without awaiting
+        it — callers that must preserve send order serialize on this, then
+        pipeline the responses."""
+        seq = next(self._seq)
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[seq] = fut
+        try:
+            await self._send([REQUEST, seq, method, kwargs])
+        except BaseException:
+            self._pending.pop(seq, None)
+            fut.cancel()
+            raise
+        return fut
+
+    async def notify(self, method: str, **kwargs):
+        await self._send([NOTIFY, 0, method, kwargs])
+
+    async def close(self):
+        if self._task is not None:
+            self._task.cancel()
+        await self._shutdown()
+
+
+def parse_address(addr: str):
+    """'unix:/path' or 'tcp:host:port' -> (kind, ...)."""
+    if addr.startswith("unix:"):
+        return ("unix", addr[5:])
+    if addr.startswith("tcp:"):
+        host, port = addr[4:].rsplit(":", 1)
+        return ("tcp", host, int(port))
+    # bare host:port
+    host, port = addr.rsplit(":", 1)
+    return ("tcp", host, int(port))
+
+
+class Server:
+    """RPC server accepting unix and/or tcp connections with shared handlers."""
+
+    def __init__(self, handlers: Dict[str, Callable], name: str = "server"):
+        self.handlers = handlers
+        self.name = name
+        self._servers = []
+        self.connections: set = set()
+        self.on_connection: Optional[Callable[[Connection], None]] = None
+        self.on_disconnect: Optional[Callable[[Connection], None]] = None
+
+    async def _on_client(self, reader, writer):
+        conn = Connection(reader, writer, self.handlers,
+                          name=f"{self.name}-peer").start()
+        self.connections.add(conn)
+
+        def _closed(c):
+            self.connections.discard(c)
+            if self.on_disconnect is not None:
+                self.on_disconnect(c)
+
+        conn.on_close = _closed
+        if self.on_connection is not None:
+            self.on_connection(conn)
+
+    async def listen_unix(self, path: str):
+        if os.path.exists(path):
+            os.unlink(path)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        srv = await asyncio.start_unix_server(self._on_client, path=path)
+        self._servers.append(srv)
+        return f"unix:{path}"
+
+    async def listen_tcp(self, host: str = "0.0.0.0", port: int = 0) -> str:
+        srv = await asyncio.start_server(self._on_client, host=host, port=port,
+                                         reuse_address=True)
+        self._servers.append(srv)
+        port = srv.sockets[0].getsockname()[1]
+        return f"tcp:{_advertise_host(host)}:{port}"
+
+    async def close(self):
+        for srv in self._servers:
+            srv.close()
+            await srv.wait_closed()
+        for conn in list(self.connections):
+            await conn.close()
+
+
+def _advertise_host(bind_host: str) -> str:
+    if bind_host not in ("0.0.0.0", "::", ""):
+        return bind_host
+    return node_ip_address()
+
+
+_cached_ip: Optional[str] = None
+
+
+def node_ip_address() -> str:
+    global _cached_ip
+    if _cached_ip is None:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            # no traffic is sent; just picks the interface with a default route
+            s.connect(("8.8.8.8", 80))
+            _cached_ip = s.getsockname()[0]
+        except OSError:
+            _cached_ip = "127.0.0.1"
+        finally:
+            s.close()
+    return _cached_ip
+
+
+async def connect(addr: str, handlers: Optional[Dict[str, Callable]] = None,
+                  name: str = "client", retries: int = 0,
+                  retry_delay: float = 0.1) -> Connection:
+    parsed = parse_address(addr)
+    last_err: Optional[Exception] = None
+    for attempt in range(retries + 1):
+        try:
+            if parsed[0] == "unix":
+                reader, writer = await asyncio.open_unix_connection(parsed[1])
+            else:
+                reader, writer = await asyncio.open_connection(parsed[1], parsed[2])
+                sock = writer.get_extra_info("socket")
+                if sock is not None:
+                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return Connection(reader, writer, handlers, name=name).start()
+        except (ConnectionRefusedError, FileNotFoundError, OSError) as e:
+            last_err = e
+            if attempt < retries:
+                await asyncio.sleep(retry_delay * (1.5 ** attempt))
+    raise ConnectionError(f"cannot connect to {addr}: {last_err}")
+
+
+class ConnectionPool:
+    """Caches one Connection per address; reconnects lazily on loss."""
+
+    def __init__(self, handlers: Optional[Dict[str, Callable]] = None,
+                 name: str = "pool"):
+        self.handlers = handlers or {}
+        self.name = name
+        self._conns: Dict[str, Connection] = {}
+        self._locks: Dict[str, asyncio.Lock] = {}
+
+    async def get(self, addr: str) -> Connection:
+        conn = self._conns.get(addr)
+        if conn is not None and not conn.closed:
+            return conn
+        lock = self._locks.setdefault(addr, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(addr)
+            if conn is not None and not conn.closed:
+                return conn
+            conn = await connect(addr, self.handlers,
+                                 name=f"{self.name}->{addr}", retries=3)
+            self._conns[addr] = conn
+            return conn
+
+    async def call(self, addr: str, method: str, **kwargs):
+        conn = await self.get(addr)
+        return await conn.call(method, **kwargs)
+
+    def invalidate(self, addr: str):
+        conn = self._conns.pop(addr, None)
+        if conn is not None and not conn.closed:
+            asyncio.ensure_future(conn.close())
+
+    async def close(self):
+        for conn in self._conns.values():
+            await conn.close()
+        self._conns.clear()

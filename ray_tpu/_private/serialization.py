@@ -1,0 +1,165 @@
+"""Object serialization: cloudpickle v5 with out-of-band zero-copy buffers.
+
+Counterpart of the reference's SerializationContext (reference:
+python/ray/_private/serialization.py): pickle-5 out-of-band buffers give
+zero-copy reads of numpy/jax-host arrays straight from the shm arena, and
+ObjectRefs embedded in values are detected during pickling so ownership and
+reference counting can track them (the borrowing protocol's entry point,
+reference: src/ray/core_worker/reference_count.h:64).
+
+Store layout for one object:
+  data region  = concat of 64-byte-aligned out-of-band buffers
+  meta region  = msgpack {kind, pkl, offs, lens}
+Inline objects (< INLINE_THRESHOLD) travel as (pkl, [buf bytes...]) tuples
+inside RPC frames instead of the store.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, List, Optional, Tuple
+
+import cloudpickle
+import msgpack
+
+INLINE_THRESHOLD = 100 * 1024
+_ALIGN = 64
+
+KIND_PY = 0       # ordinary python object
+KIND_ERR = 1      # serialized exception (raised on get)
+KIND_RAW = 2      # raw bytes payload (zero pickling)
+
+
+class SerializedObject:
+    __slots__ = ("kind", "pkl", "buffers", "contained_refs")
+
+    def __init__(self, kind: int, pkl: bytes, buffers: List, contained_refs: List):
+        self.kind = kind
+        self.pkl = pkl
+        self.buffers = buffers          # list of objects with buffer protocol
+        self.contained_refs = contained_refs
+
+    @property
+    def total_bytes(self) -> int:
+        n = len(self.pkl)
+        for b in self.buffers:
+            n += _ALIGN + memoryview(b).nbytes
+        return n
+
+    def is_inline(self) -> bool:
+        return self.total_bytes < INLINE_THRESHOLD
+
+    # -------- wire form (inline objects inside rpc frames)
+    def to_wire(self) -> Tuple[int, bytes, List[bytes]]:
+        return (self.kind, self.pkl,
+                [memoryview(b).tobytes() if not isinstance(b, bytes) else b
+                 for b in self.buffers])
+
+    # -------- store form
+    def write_to(self, data_mv: memoryview) -> None:
+        off = 0
+        for b in self.buffers:
+            mv = memoryview(b).cast("B")
+            n = mv.nbytes
+            data_mv[off:off + n] = mv
+            off += _aligned(n)
+
+    def store_meta(self) -> bytes:
+        offs, lens = [], []
+        off = 0
+        for b in self.buffers:
+            n = memoryview(b).nbytes
+            offs.append(off)
+            lens.append(n)
+            off += _aligned(n)
+        return msgpack.packb({"k": self.kind, "p": self.pkl,
+                              "o": offs, "l": lens}, use_bin_type=True)
+
+    def data_size(self) -> int:
+        return sum(_aligned(memoryview(b).nbytes) for b in self.buffers)
+
+
+def _aligned(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def serialize(obj: Any, ref_hook: Optional[Callable] = None) -> SerializedObject:
+    """ref_hook(ref) is called for every ObjectRef encountered while pickling."""
+    contained: List = []
+    if isinstance(obj, bytes) and len(obj) > INLINE_THRESHOLD:
+        return SerializedObject(KIND_RAW, b"", [obj], contained)
+    buffers: List[pickle.PickleBuffer] = []
+
+    def buffer_cb(pb: pickle.PickleBuffer):
+        buffers.append(pb)
+        return False  # out-of-band
+
+    from ray_tpu._private.object_ref import ObjectRef  # cycle-free at call time
+    prev = ObjectRef._serialization_hook
+    try:
+        def hook(ref):
+            contained.append(ref)
+            if ref_hook is not None:
+                ref_hook(ref)
+        ObjectRef._serialization_hook = staticmethod(hook)
+        pkl = cloudpickle.dumps(obj, protocol=5, buffer_callback=buffer_cb)
+    finally:
+        ObjectRef._serialization_hook = prev
+    return SerializedObject(KIND_PY, pkl, buffers, contained)
+
+
+def serialize_error(exc: BaseException) -> SerializedObject:
+    import traceback
+    try:
+        pkl = cloudpickle.dumps(exc, protocol=5)
+    except Exception:
+        pkl = cloudpickle.dumps(
+            RuntimeError(f"{type(exc).__name__}: {exc}\n"
+                         + "".join(traceback.format_exception(exc))),
+            protocol=5)
+    return SerializedObject(KIND_ERR, pkl, [], [])
+
+
+def deserialize_wire(kind: int, pkl: bytes, buffers: List[bytes]) -> Any:
+    if kind == KIND_RAW:
+        return buffers[0]
+    obj = pickle.loads(pkl, buffers=[pickle.PickleBuffer(b) for b in buffers])
+    if kind == KIND_ERR:
+        raise TaskError(obj)
+    return obj
+
+
+def deserialize_from_store(data_mv: memoryview, meta: bytes) -> Any:
+    m = msgpack.unpackb(meta, raw=False)
+    kind = m["k"]
+    bufs = [data_mv[o:o + n] for o, n in zip(m["o"], m["l"])]
+    if kind == KIND_RAW:
+        return bytes(bufs[0])
+    obj = pickle.loads(m["p"], buffers=[pickle.PickleBuffer(b) for b in bufs])
+    if kind == KIND_ERR:
+        raise TaskError(obj)
+    return obj
+
+
+class TaskError(Exception):
+    """Wraps an exception raised inside a remote task/actor method
+    (reference: python/ray/exceptions.py RayTaskError). Raised on ray.get."""
+
+    def __init__(self, cause: BaseException):
+        self.cause = cause
+        super().__init__(f"task failed: {type(cause).__name__}: {cause}")
+
+    def __reduce__(self):
+        return (TaskError, (self.cause,))
+
+
+class ActorDiedError(Exception):
+    pass
+
+
+class ObjectLostError(Exception):
+    pass
+
+
+class WorkerCrashedError(Exception):
+    pass

@@ -1,0 +1,50 @@
+"""Worker process entry point (reference:
+python/ray/_private/workers/default_worker.py). The asyncio loop runs on the
+main thread; task execution happens in executor threads, so user code inside
+tasks can call the public API through the same threadsafe bridge the driver
+uses."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--node-address", required=True)
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--store-path", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--session-name", default="session")
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "WARNING"),
+        format=f"[worker {os.getpid()}] %(levelname)s %(message)s")
+
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.worker import CoreWorker, Worker
+
+    core = CoreWorker(mode="worker", gcs_address=args.gcs_address,
+                      node_address=args.node_address,
+                      store_path=args.store_path, node_id=args.node_id)
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    loop.run_until_complete(core.start_async())
+    worker_mod.global_worker = Worker(core, owns_loop=False)
+
+    import ray_tpu
+    ray_tpu._set_connected_from_worker(core)
+
+    try:
+        loop.run_forever()
+    except KeyboardInterrupt:
+        pass
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,132 @@
+"""@ray_tpu.remote for classes: ActorClass / ActorHandle (reference:
+python/ray/actor.py:293 ActorClass._remote, :721 method wrappers).
+
+An ActorHandle is picklable and carries (actor_id, method names, owner gcs),
+so handles can be passed into tasks and other actors; calls from any holder
+go directly to the actor's worker over its own connection (reference:
+direct worker→worker transport, actor_task_submitter.h:75)."""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.remote_function import (_resources_from_options,
+                                     _scheduling_from_options)
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str):
+        self._handle = handle
+        self._name = name
+
+    def remote(self, *args, **kwargs):
+        return self._handle._invoke(self._name, args, kwargs,
+                                    self._handle._options)
+
+    def options(self, **opts):
+        method = ActorMethod(self._handle, self._name)
+        method._call_options = opts
+        parent = self
+
+        class _Bound:
+            def remote(self, *args, **kwargs):
+                merged = {**parent._handle._options, **opts}
+                return parent._handle._invoke(parent._name, args, kwargs, merged)
+        return _Bound()
+
+
+class ActorHandle:
+    def __init__(self, actor_id: str, method_names: List[str],
+                 options: Optional[Dict[str, Any]] = None,
+                 is_owner: bool = False):
+        self._actor_id = actor_id
+        self._method_names = list(method_names)
+        self._options = options or {}
+        # The original handle returned by ActorClass.remote() owns the actor's
+        # lifetime: dropping it terminates a non-detached actor (reference:
+        # actor GC on out-of-scope handles, gcs_actor_manager.cc ownership).
+        self._is_owner = is_owner
+
+    @property
+    def _id(self) -> str:
+        return self._actor_id
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._method_names:
+            raise AttributeError(
+                f"actor has no method {name!r}; methods: {self._method_names}")
+        return ActorMethod(self, name)
+
+    def _invoke(self, method: str, args, kwargs, opts: Dict[str, Any]):
+        from ray_tpu import _get_worker
+        w = _get_worker()
+        num_returns = opts.get("num_returns", 1)
+        refs = w.submit_actor_task(
+            self._actor_id, method, args, kwargs,
+            num_returns=num_returns,
+            max_task_retries=opts.get("max_task_retries", 0))
+        return refs[0] if num_returns == 1 else refs
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._method_names,
+                              self._options))
+
+    def __del__(self):
+        if not getattr(self, "_is_owner", False):
+            return
+        try:
+            import ray_tpu
+            if ray_tpu.is_initialized():
+                ray_tpu._get_worker().kill_actor(self._actor_id,
+                                                 no_restart=True)
+        except Exception:
+            pass
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id[:12]})"
+
+
+def _public_methods(cls) -> List[str]:
+    names = []
+    for name, member in inspect.getmembers(cls):
+        if name.startswith("__") and name != "__call__":
+            continue
+        if callable(member):
+            names.append(name)
+    return names
+
+
+class ActorClass:
+    def __init__(self, cls, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = options or {}
+        self.__name__ = getattr(cls, "__name__", "ActorClass")
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        from ray_tpu import _get_worker
+        w = _get_worker()
+        opts = self._options
+        actor_id = w.create_actor(
+            self._cls, args, kwargs,
+            resources=_resources_from_options(opts),
+            name=opts.get("name"),
+            namespace=opts.get("namespace"),
+            max_restarts=opts.get("max_restarts", 0),
+            max_concurrency=opts.get("max_concurrency", 1),
+            scheduling=_scheduling_from_options(opts),
+            lifetime=opts.get("lifetime"),
+            method_names=_public_methods(self._cls))
+        return ActorHandle(actor_id, _public_methods(self._cls),
+                           {"max_task_retries": opts.get("max_task_retries", 0)},
+                           is_owner=opts.get("lifetime") != "detached")
+
+    def options(self, **new_options) -> "ActorClass":
+        return ActorClass(self._cls, {**self._options, **new_options})
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self.__name__}' cannot be instantiated directly; "
+            "use .remote().")

@@ -1,0 +1,13 @@
+from ray_tpu.util.placement_group import (placement_group,
+                                          placement_group_table,
+                                          remove_placement_group,
+                                          PlacementGroup)
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy,
+    SpreadSchedulingStrategy)
+
+__all__ = [
+    "placement_group", "placement_group_table", "remove_placement_group",
+    "PlacementGroup", "NodeAffinitySchedulingStrategy",
+    "PlacementGroupSchedulingStrategy", "SpreadSchedulingStrategy",
+]

@@ -1,0 +1,228 @@
+"""Core API integration tests: tasks, objects, actors on a local cluster
+(reference test model: python/ray/tests/test_basic.py on ray_start_regular)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    ctx = ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_put_get_small(ray_start):
+    ref = ray_tpu.put({"a": 1, "b": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"a": 1, "b": [1, 2, 3]}
+
+
+def test_put_get_large_zero_copy(ray_start):
+    arr = np.arange(1_000_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+    # second get works too (buffer stays pinned/readable)
+    out2 = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out2)
+
+
+def test_simple_task(ray_start):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_args(ray_start):
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    ref = ray_tpu.put(21)
+    assert ray_tpu.get(double.remote(ref)) == 42
+
+
+def test_task_large_return(ray_start):
+    @ray_tpu.remote
+    def make_array(n):
+        return np.ones(n, dtype=np.float64)
+
+    out = ray_tpu.get(make_array.remote(500_000))
+    assert out.shape == (500_000,)
+    assert out.sum() == 500_000
+
+
+def test_task_chain(ray_start):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(10):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 11
+
+
+def test_many_parallel_tasks(ray_start):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs) == [i * i for i in range(50)]
+
+
+def test_task_exception(ray_start):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(ValueError, match="kaboom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_multiple_returns(ray_start):
+    @ray_tpu.remote(num_returns=2)
+    def pair():
+        return 1, 2
+
+    r1, r2 = pair.remote()
+    assert ray_tpu.get(r1) == 1
+    assert ray_tpu.get(r2) == 2
+
+
+def test_wait(ray_start):
+    @ray_tpu.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    fast = sleepy.remote(0.01)
+    slow = sleepy.remote(2.0)
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=1.0)
+    assert ready == [fast]
+    assert not_ready == [slow]
+
+
+def test_actor_basic(ray_start):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+        def get(self):
+            return self.n
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.inc.remote()) == 11
+    assert ray_tpu.get(c.inc.remote(5)) == 16
+    assert ray_tpu.get(c.get.remote()) == 16
+
+
+def test_actor_ordering(ray_start):
+    @ray_tpu.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+
+        def get(self):
+            return self.items
+
+    a = Appender.remote()
+    for i in range(20):
+        a.add.remote(i)
+    assert ray_tpu.get(a.get.remote()) == list(range(20))
+
+
+def test_async_actor(ray_start):
+    @ray_tpu.remote
+    class AsyncActor:
+        async def work(self, x):
+            import asyncio
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = AsyncActor.remote()
+    refs = [a.work.remote(i) for i in range(10)]
+    assert ray_tpu.get(refs) == [i * 2 for i in range(10)]
+
+
+def test_named_actor(ray_start):
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.d = {}
+
+        def set(self, k, v):
+            self.d[k] = v
+
+        def get(self, k):
+            return self.d.get(k)
+
+    s = Store.options(name="kvstore").remote()
+    ray_tpu.get(s.set.remote("x", 1))
+    h = ray_tpu.get_actor("kvstore")
+    assert ray_tpu.get(h.get.remote("x")) == 1
+
+
+def test_actor_handle_passing(ray_start):
+    @ray_tpu.remote
+    class Counter2:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    @ray_tpu.remote
+    def bump(c):
+        return ray_tpu.get(c.inc.remote())
+
+    c = Counter2.remote()
+    assert ray_tpu.get(bump.remote(c)) == 1
+    assert ray_tpu.get(bump.remote(c)) == 2
+
+
+def test_kill_actor(ray_start):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote()) == "pong"
+    ray_tpu.kill(v)
+    time.sleep(0.3)
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray_tpu.get(v.ping.remote())
+
+
+def test_nested_tasks(ray_start):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(0)) == 11
+
+
+def test_cluster_resources(ray_start):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU") == 4.0
