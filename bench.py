@@ -1,0 +1,55 @@
+"""Round benchmark: core runtime microbenchmark vs the reference's
+checked-in number (BASELINE.md, release/perf_metrics/microbenchmark.json:
+single-client `ray.put` calls/s = 4,962 on a 64-core node; here measured
+on this box). The direct-mapped object path (no store-daemon round trip)
+is the architectural change under test.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BASELINE_PUT_CALLS = 4962.0   # single_client_put_calls_Plasma_Store
+
+
+def bench_put_calls(duration: float = 4.0) -> float:
+    import ray_tpu
+
+    payload = {"k": 1}
+    for _ in range(200):                       # warm
+        ray_tpu.put(payload)
+    n = 0
+    kept = []
+    t0 = time.perf_counter()
+    while True:
+        for _ in range(200):
+            kept.append(ray_tpu.put(payload))
+        n += 200
+        if len(kept) > 2000:
+            kept.clear()
+        if time.perf_counter() - t0 > duration:
+            break
+    return n / (time.perf_counter() - t0)
+
+
+def main():
+    import ray_tpu
+    ray_tpu.init(object_store_memory=256 * 1024 * 1024)
+    try:
+        calls_per_s = bench_put_calls()
+    finally:
+        ray_tpu.shutdown()
+    print(json.dumps({
+        "metric": "put_calls_per_s_single_client",
+        "value": round(calls_per_s, 1),
+        "unit": "calls/s",
+        "vs_baseline": round(calls_per_s / BASELINE_PUT_CALLS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

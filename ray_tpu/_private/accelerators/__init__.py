@@ -1,0 +1,38 @@
+from ray_tpu._private.accelerators.accelerator import AcceleratorManager
+from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
+
+_MANAGERS = {"TPU": TPUAcceleratorManager}
+
+
+def get_accelerator_manager(resource_name: str):
+    return _MANAGERS.get(resource_name)
+
+
+def all_accelerator_managers():
+    return dict(_MANAGERS)
+
+
+def detect_chip_ids():
+    """Actual TPU chip ids this node owns (respects TPU_VISIBLE_CHIPS on a
+    partitioned host — ids are NOT simply range(n))."""
+    visible = TPUAcceleratorManager.get_current_process_visible_accelerator_ids()
+    if visible is not None:
+        return list(visible)
+    n = TPUAcceleratorManager.get_current_node_num_accelerators()
+    return [str(i) for i in range(n)]
+
+
+def detect_node_accelerators():
+    """Returns {resource_name: count} plus any extra slice resources, and
+    env isolation info, for this node."""
+    resources = {}
+    for name, mgr in _MANAGERS.items():
+        n = mgr.get_current_node_num_accelerators()
+        if n > 0:
+            resources[name] = float(n)
+            resources.update(mgr.get_current_node_additional_resources())
+    return resources
+
+
+__all__ = ["AcceleratorManager", "TPUAcceleratorManager",
+           "get_accelerator_manager", "detect_node_accelerators"]

@@ -68,6 +68,27 @@ class NodeManager:
         ncpu = os.cpu_count() or 1
         self.total = dict(resources or {})
         self.total.setdefault("CPU", float(ncpu))
+        # auto-detect accelerators (TPU chips + pod-slice resources) unless
+        # the caller pinned them explicitly (tests use fake resources)
+        explicit_tpu = "TPU" in self.total
+        if not explicit_tpu:
+            try:
+                from ray_tpu._private.accelerators import \
+                    detect_node_accelerators
+                for k, v in detect_node_accelerators().items():
+                    self.total.setdefault(k, v)
+            except Exception:
+                logger.exception("accelerator detection failed")
+        # chip ids are the REAL ids (TPU_VISIBLE_CHIPS-aware), not range(n)
+        try:
+            from ray_tpu._private.accelerators import detect_chip_ids
+            ids = detect_chip_ids()
+        except Exception:
+            ids = []
+        n = int(self.total.get("TPU", 0))
+        if len(ids) != n:   # explicitly-configured (fake) TPU counts
+            ids = [str(i) for i in range(n)]
+        self._free_chips = ids
         self.total.setdefault("memory", float(2 * 1024**3))
         self.total.setdefault("object_store_memory",
                               float(store_bytes or 512 * 1024**2))
@@ -221,6 +242,15 @@ class NodeManager:
                 view = self.cluster_view.get(key)
                 if view:
                     view["alive"] = False
+            elif payload.get("state") == "ALIVE":
+                self.cluster_view[key] = {
+                    "total": payload["total"],
+                    "available": payload["available"],
+                    "alive": True, "address": payload["address"],
+                    "object_store_address": payload["object_store_address"],
+                    "node_ip": payload["node_ip"],
+                    "labels": payload.get("labels", {})}
+                self._wake_lease_waiters()
 
     # ------------------------------------------------------------ worker pool
     def _spawn_worker(self) -> WorkerProc:
@@ -336,10 +366,15 @@ class NodeManager:
         return self.bundles.get((pg_id, idx))
 
     async def h_request_lease(self, conn, resources: Dict[str, float],
-                              scheduling: Dict, worker_id: str):
-        """Grant a worker lease, queue, or redirect (spillback)."""
+                              scheduling: Dict, worker_id: str,
+                              spilled: bool = False):
+        """Grant a worker lease, queue, or redirect (spillback). A request
+        that has already been redirected once is grant-or-queue here — never
+        redirected again (the reference's grant_or_reject spillback rule,
+        preventing ping-pong on stale cluster views)."""
         deadline = time.monotonic() + 300.0
         strategy = scheduling.get("strategy", "DEFAULT")
+        infeasible_since = None
         while True:
             bundle = self._bundle_pool(scheduling)
             pool_avail = bundle["available"] if bundle else self.available
@@ -350,7 +385,8 @@ class NodeManager:
                     return {"status": "spill", "spill_to": spill}
                 return {"status": "error",
                         "reason": "placement group bundle not found"}
-            if bundle is None and strategy in ("NODE_AFFINITY", "SPREAD"):
+            if bundle is None and not spilled \
+                    and strategy in ("NODE_AFFINITY", "SPREAD"):
                 # strategy decides the node even when we fit locally
                 view = self._live_view()
                 target = scheduling_pick(view, resources, scheduling,
@@ -362,7 +398,8 @@ class NodeManager:
                 elif target != self.node_id:
                     return {"status": "spill",
                             "spill_to": view[target]["address"]}
-            if scheduling_fits(pool_avail, resources):
+            if scheduling_fits(pool_avail, resources) \
+                    and self._chips_fit(resources):
                 scheduling_sub(pool_avail, resources)
                 try:
                     w = await self._obtain_worker()
@@ -373,13 +410,15 @@ class NodeManager:
                 lease_id = f"{self.node_id[:8]}-{self._lease_seq}"
                 w.state = "leased"
                 w.lease_id = lease_id
+                chips = self._allocate_chips(resources)
                 self._leases[lease_id] = {"worker": w, "resources": resources,
-                                          "bundle": bundle}
+                                          "bundle": bundle, "chips": chips}
                 return {"status": "ok", "lease_id": lease_id,
                         "worker_address": w.address,
                         "node_address": self.address,
-                        "node_id": self.node_id}
-            if bundle is None:
+                        "node_id": self.node_id,
+                        "resource_ids": {"TPU": chips} if chips else {}}
+            if bundle is None and not spilled:
                 # consider spillback using the cluster view
                 view = self._live_view()
                 target = scheduling_pick(view, resources, scheduling, self.node_id)
@@ -388,9 +427,18 @@ class NodeManager:
                             "spill_to": view[target]["address"]}
                 if target is None and not scheduling_feasible_anywhere(
                         view, resources, self.total):
-                    return {"status": "error",
-                            "reason": f"resources {resources} unschedulable "
-                                      f"anywhere in the cluster"}
+                    # Infeasible in the current view. Keep the request queued
+                    # (a node may join — the reference keeps infeasible tasks
+                    # pending and surfaces them as autoscaler demand), but
+                    # fail after a sustained infeasibility window.
+                    if infeasible_since is None:
+                        infeasible_since = time.monotonic()
+                    elif time.monotonic() - infeasible_since > 30.0:
+                        return {"status": "error",
+                                "reason": f"resources {resources} "
+                                          f"unschedulable anywhere"}
+                else:
+                    infeasible_since = None
             # wait for resources to free up locally
             if time.monotonic() > deadline:
                 return {"status": "error", "reason": "lease wait timed out"}
@@ -432,10 +480,27 @@ class NodeManager:
         self._release_lease(lease_id, worker_dead)
         return True
 
+    def _chips_fit(self, resources: Dict[str, float]) -> bool:
+        return int(resources.get("TPU", 0)) <= len(self._free_chips)
+
+    def _allocate_chips(self, resources: Dict[str, float]):
+        n = int(resources.get("TPU", 0))
+        if n <= 0:
+            return []
+        if len(self._free_chips) < n:
+            # float accounting and physical chip pool diverged — never grant
+            # a TPU lease without isolation
+            raise RuntimeError(
+                f"chip pool exhausted: need {n}, free {self._free_chips}")
+        chips = self._free_chips[:n]
+        del self._free_chips[:n]
+        return chips
+
     def _release_lease(self, lease_id: str, worker_dead: bool):
         info = self._leases.pop(lease_id, None)
         if info is None:
             return
+        self._free_chips.extend(info.get("chips") or [])
         pool_avail = info["bundle"]["available"] if info["bundle"] else self.available
         scheduling_addback(pool_avail, info["resources"])
         w = info["worker"]
@@ -452,7 +517,8 @@ class NodeManager:
         pool_avail = bundle["available"] if bundle else self.available
         # queue for resources (leases drain within their idle timeout)
         deadline = time.monotonic() + 60.0
-        while not scheduling_fits(pool_avail, resources):
+        while not (scheduling_fits(pool_avail, resources)
+                   and self._chips_fit(resources)):
             if time.monotonic() > deadline:
                 raise RuntimeError(
                     f"insufficient resources for actor: {resources}")
@@ -474,8 +540,11 @@ class NodeManager:
         # _on_worker_death releases the resources on crash
         lease_id = f"actor-{spec['actor_id']}-{w.worker_id[:8]}"
         w.lease_id = lease_id
+        chips = self._allocate_chips(resources)
         self._leases[lease_id] = {"worker": w, "resources": resources,
-                                  "bundle": bundle}
+                                  "bundle": bundle, "chips": chips}
+        if chips:
+            spec = {**spec, "accelerator_ids": {"TPU": chips}}
         try:
             await w.conn.call("become_actor", spec=spec)
         except (rpc.RpcError, rpc.ConnectionLost) as e:

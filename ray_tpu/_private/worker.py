@@ -73,14 +73,16 @@ class PendingTask:
 
 class Lease:
     __slots__ = ("lease_id", "worker_address", "node_address", "signature",
-                 "last_used")
+                 "last_used", "resource_ids")
 
-    def __init__(self, lease_id, worker_address, node_address, signature):
+    def __init__(self, lease_id, worker_address, node_address, signature,
+                 resource_ids=None):
         self.lease_id = lease_id
         self.worker_address = worker_address
         self.node_address = node_address
         self.signature = signature
         self.last_used = time.monotonic()
+        self.resource_ids = resource_ids or {}
 
 
 class ActorHandleState:
@@ -147,6 +149,8 @@ class CoreWorker:
         self.actor_id: Optional[str] = None
         self.actor_spec: Optional[Dict] = None
         self.current_task_name: Optional[str] = None
+        self._orig_visible: Dict[str, Optional[str]] = {}
+        self._visible_dirty: set = set()
         self._shutdown = False
 
     # -------------------------------------------------------------- startup
@@ -578,6 +582,8 @@ class CoreWorker:
                     self._fail_task(pt, RuntimeError(f"lease failed: {e}"))
                     return
                 try:
+                    if lease.resource_ids:
+                        pt.spec["accelerator_ids"] = lease.resource_ids
                     conn = await self.pool.get(lease.worker_address)
                     resp = await conn.call("push_task", spec=pt.spec)
                 except (rpc.ConnectionLost, ConnectionError, rpc.RpcError) as e:
@@ -654,10 +660,12 @@ class CoreWorker:
         while True:
             resp = await target_conn.call("request_lease", resources=resources,
                                           scheduling=scheduling,
-                                          worker_id=self.worker_id)
+                                          worker_id=self.worker_id,
+                                          spilled=addr_chain > 0)
             if resp["status"] == "ok":
                 return Lease(resp["lease_id"], resp["worker_address"],
-                             resp["node_address"], sig)
+                             resp["node_address"], sig,
+                             resp.get("resource_ids"))
             if resp["status"] == "spill":
                 addr_chain += 1
                 if addr_chain > 8:
@@ -895,7 +903,39 @@ class CoreWorker:
             if not fut.done():
                 fut.set_result(result)
 
+    def _apply_accelerator_ids(self, spec: Dict):
+        ids = spec.get("accelerator_ids")
+        try:
+            from ray_tpu._private.accelerators import (all_accelerator_managers,
+                                                       get_accelerator_manager)
+            if not ids:
+                # restore the process's original visibility so a reused
+                # worker doesn't leak a previous task's chip mask
+                for res, mgr in all_accelerator_managers().items():
+                    orig = self._orig_visible.get(res)
+                    var = mgr.get_visible_accelerator_ids_env_var()
+                    if res in self._visible_dirty:
+                        if orig is None:
+                            os.environ.pop(var, None)
+                        else:
+                            os.environ[var] = orig
+                        self._visible_dirty.discard(res)
+                return
+            for res, chip_ids in ids.items():
+                mgr = get_accelerator_manager(res)
+                if mgr is not None:
+                    var = mgr.get_visible_accelerator_ids_env_var()
+                    self._orig_visible.setdefault(res, os.environ.get(var))
+                    self._visible_dirty.add(res)
+                    mgr.set_current_process_visible_accelerator_ids(
+                        [str(c) for c in chip_ids])
+        except Exception:
+            logger.exception("failed to set accelerator visibility")
+
     async def _execute(self, spec: Dict) -> Dict:
+        if not spec.get("actor_id"):
+            # actor workers keep the mask set at become_actor for life
+            self._apply_accelerator_ids(spec)
         args, kwargs = await self._resolve_args(spec)
         if spec.get("actor_id"):
             if self.actor_instance is None:
@@ -962,6 +1002,7 @@ class CoreWorker:
         return args, kwargs
 
     async def h_become_actor(self, conn, spec: Dict):
+        self._apply_accelerator_ids(spec)
         cls = await self._load_function(spec["class_id"])
         args, kwargs = await self._resolve_args(
             {"args": spec["init_args"], "kwargs": spec["init_kwargs"]})

@@ -1,0 +1,8 @@
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
+                                   from_pandas, range, read_csv, read_json,
+                                   read_parquet, read_text)
+
+__all__ = ["Dataset", "range", "from_items", "from_numpy", "from_pandas",
+           "from_arrow", "read_parquet", "read_csv", "read_json",
+           "read_text"]

@@ -1,0 +1,100 @@
+"""Block model: a Dataset is a list of object-store-resident blocks.
+
+Re-design of the reference's block layer (reference:
+python/ray/data/block.py, _internal/arrow_block.py): a block is a pyarrow
+Table (columnar, zero-copy through the shm store); batches convert to
+"numpy" (dict of arrays), "pandas", or "pyarrow" on demand. TPU-first
+consequence: the numpy batch format is the device-feed path
+(iterator.iter_jax_batches), so conversions keep arrays contiguous.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+
+@dataclasses.dataclass
+class BlockMetadata:
+    num_rows: int
+    size_bytes: int
+    schema: Optional[Any] = None
+
+
+def block_from_rows(rows: List[Dict[str, Any]]) -> pa.Table:
+    if not rows:
+        return pa.table({})
+    cols: Dict[str, list] = {}
+    for row in rows:
+        if not isinstance(row, dict):
+            row = {"item": row}
+        for k, v in row.items():
+            cols.setdefault(k, []).append(v)
+    return pa.table({k: _to_arrow_array(v) for k, v in cols.items()})
+
+
+def _to_arrow_array(values: list):
+    first = next((v for v in values if v is not None), None)
+    if isinstance(first, np.ndarray):
+        # tensor column: fixed-shape list array
+        arr = np.stack(values)
+        flat = pa.array(arr.reshape(arr.shape[0], -1).tolist())
+        return flat
+    return pa.array(values)
+
+
+def block_from_batch(batch) -> pa.Table:
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, dict):
+        return pa.table({k: (pa.array(np.asarray(v).tolist())
+                             if isinstance(v, np.ndarray) and v.ndim > 1
+                             else pa.array(np.asarray(v)))
+                         for k, v in batch.items()})
+    try:
+        import pandas as pd
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+    except ImportError:
+        pass
+    if isinstance(batch, list):
+        return block_from_rows(batch)
+    raise TypeError(f"cannot build a block from {type(batch)}")
+
+
+def block_to_batch(block: pa.Table, batch_format: str = "numpy"):
+    if batch_format == "pyarrow":
+        return block
+    if batch_format == "pandas":
+        return block.to_pandas()
+    if batch_format in ("numpy", "default"):
+        return {name: np.asarray(block.column(name).to_numpy(
+            zero_copy_only=False)) for name in block.column_names}
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def block_to_rows(block: pa.Table) -> Iterable[Dict[str, Any]]:
+    cols = {name: block.column(name).to_pylist()
+            for name in block.column_names}
+    for i in range(block.num_rows):
+        yield {k: v[i] for k, v in cols.items()}
+
+
+def block_metadata(block: pa.Table) -> BlockMetadata:
+    return BlockMetadata(num_rows=block.num_rows,
+                         size_bytes=block.nbytes,
+                         schema=block.schema)
+
+
+def slice_block(block: pa.Table, start: int, end: int) -> pa.Table:
+    return block.slice(start, end - start)
+
+
+def concat_blocks(blocks: List[pa.Table]) -> pa.Table:
+    blocks = [b for b in blocks if b.num_rows > 0]
+    if not blocks:
+        return pa.table({})
+    return pa.concat_tables(blocks, promote_options="default")

@@ -1,0 +1,158 @@
+"""Dataset: lazy, streaming distributed datasets (reference:
+python/ray/data/dataset.py — logical plan of operations executed by the
+streaming executor on materialization/iteration)."""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import ray_tpu
+from ray_tpu.data import block as block_lib
+from ray_tpu.data import execution as exe
+
+
+class Dataset:
+    def __init__(self, stages: List[exe.Stage]):
+        self._stages = stages
+        self._materialized: Optional[List[exe.RefBundle]] = None
+
+    # ------------------------------------------------------------ transforms
+    def _extend(self, stage: exe.Stage) -> "Dataset":
+        return Dataset(self._stages + [stage])
+
+    def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
+                    fn_args=(), fn_kwargs=None,
+                    concurrency: Optional[int] = None,
+                    **_ignored) -> "Dataset":
+        return self._extend(exe.MapStage("map_batches", fn,
+                                         batch_format=batch_format,
+                                         fn_args=fn_args,
+                                         fn_kwargs=fn_kwargs,
+                                         concurrency=concurrency))
+
+    def map(self, fn: Callable, *, concurrency=None, **_) -> "Dataset":
+        return self._extend(exe.MapStage("map", fn, concurrency=concurrency))
+
+    def filter(self, fn: Callable, *, concurrency=None, **_) -> "Dataset":
+        return self._extend(exe.MapStage("filter", fn,
+                                         concurrency=concurrency))
+
+    def flat_map(self, fn: Callable, *, concurrency=None, **_) -> "Dataset":
+        return self._extend(exe.MapStage("flat_map", fn,
+                                         concurrency=concurrency))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._extend(exe.AllToAllStage("repartition",
+                                              num_blocks=num_blocks))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._extend(exe.AllToAllStage("random_shuffle", seed=seed))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._extend(exe.AllToAllStage("sort", key=key,
+                                              descending=descending))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._extend(exe.LimitStage(n))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        bundles = list(self._execute())
+        for o in others:
+            bundles.extend(o._execute())
+        return Dataset([exe.InputStage(bundles)])
+
+    # ------------------------------------------------------------- execution
+    def _execute(self) -> Iterator[exe.RefBundle]:
+        if self._materialized is not None:
+            return iter(self._materialized)
+        return exe.execute_plan(self._stages)
+
+    def materialize(self) -> "Dataset":
+        bundles = list(self._execute())
+        ds = Dataset([exe.InputStage(bundles)])
+        ds._materialized = bundles
+        return ds
+
+    def get_internal_block_refs(self) -> List:
+        return [r for r, _ in self._execute()]
+
+    # ----------------------------------------------------------- consumption
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy",
+                     prefetch_batches: int = 1,
+                     drop_last: bool = False):
+        from ray_tpu.data.iterator import iter_batches as _ib
+        return _ib(self._execute(), batch_size=batch_size,
+                   batch_format=batch_format, drop_last=drop_last)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for ref, _ in self._execute():
+            yield from block_lib.block_to_rows(ray_tpu.get(ref))
+
+    def iter_jax_batches(self, *, batch_size: int, mesh=None, sharding=None,
+                         batch_format: str = "numpy", drop_last: bool = True,
+                         prefetch: int = 2, dtypes=None):
+        from ray_tpu.data.iterator import iter_jax_batches as _ijb
+        return _ijb(self._execute(), batch_size=batch_size, mesh=mesh,
+                    sharding=sharding, drop_last=drop_last,
+                    prefetch=prefetch, dtypes=dtypes)
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(meta.num_rows for _, meta in self._execute())
+
+    def schema(self):
+        for ref, meta in self._execute():
+            if meta.schema is not None:
+                return meta.schema
+        return None
+
+    def num_blocks(self) -> int:
+        return len(list(self._execute()))
+
+    def to_pandas(self):
+        blocks = [ray_tpu.get(r) for r, _ in self._execute()]
+        return block_lib.concat_blocks(blocks).to_pandas()
+
+    def split(self, n: int) -> List["Dataset"]:
+        bundles = list(self._execute())
+        shards: List[List[exe.RefBundle]] = [[] for _ in range(n)]
+        # greedy row balancing
+        order = sorted(bundles, key=lambda b: -b[1].num_rows)
+        sizes = [0] * n
+        for b in order:
+            i = sizes.index(min(sizes))
+            shards[i].append(b)
+            sizes[i] += b[1].num_rows
+        return [Dataset([exe.InputStage(s)]) for s in shards]
+
+    # ---------------------------------------------------------------- writes
+    def write_parquet(self, path: str):
+        import os
+        import pyarrow.parquet as pq
+        os.makedirs(path, exist_ok=True)
+        for i, (ref, _) in enumerate(self._execute()):
+            pq.write_table(ray_tpu.get(ref),
+                           os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def write_csv(self, path: str):
+        import os
+        import pyarrow.csv as pcsv
+        os.makedirs(path, exist_ok=True)
+        for i, (ref, _) in enumerate(self._execute()):
+            pcsv.write_csv(ray_tpu.get(ref),
+                           os.path.join(path, f"part-{i:05d}.csv"))
+
+    def __repr__(self):
+        return f"Dataset(stages={len(self._stages)})"

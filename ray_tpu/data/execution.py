@@ -1,0 +1,215 @@
+"""Streaming execution of dataset plans.
+
+Re-design of the reference's StreamingExecutor (reference:
+python/ray/data/_internal/execution/streaming_executor.py:48 — dedicated
+thread, operator scheduling loop, backpressure policies). Here each
+operator is a generator stage over a stream of block refs: map stages keep
+a bounded window of in-flight remote tasks (pipelining + backpressure in
+~40 lines instead of a scheduling loop), all-to-all stages materialize
+their input. Only refs flow through the executor; blocks stay in the
+object store."""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.data import block as block_lib
+
+# (ref, BlockMetadata) pairs flow between stages
+RefBundle = Tuple[Any, block_lib.BlockMetadata]
+
+DEFAULT_MAX_IN_FLIGHT = 8
+
+
+def _map_block_remote(fn_kind: str, fn, block, batch_format: str,
+                      fn_args, fn_kwargs):
+    """Runs inside a worker: apply one transform to one block."""
+    import numpy as np
+    from ray_tpu.data import block as B
+    if fn_kind == "map_batches":
+        batch = B.block_to_batch(block, batch_format)
+        out = fn(batch, *fn_args, **(fn_kwargs or {}))
+        return B.block_from_batch(out)
+    if fn_kind == "map":
+        rows = [fn(r, *fn_args, **(fn_kwargs or {}))
+                for r in B.block_to_rows(block)]
+        return B.block_from_rows(rows)
+    if fn_kind == "filter":
+        rows = [r for r in B.block_to_rows(block)
+                if fn(r, *fn_args, **(fn_kwargs or {}))]
+        return B.block_from_rows(rows)
+    if fn_kind == "flat_map":
+        rows = []
+        for r in B.block_to_rows(block):
+            rows.extend(fn(r, *fn_args, **(fn_kwargs or {})))
+        return B.block_from_rows(rows)
+    raise ValueError(fn_kind)
+
+
+class Stage:
+    """Base: transforms a stream of RefBundles."""
+
+    def execute(self, upstream: Iterator[RefBundle]) -> Iterator[RefBundle]:
+        raise NotImplementedError
+
+
+class InputStage(Stage):
+    def __init__(self, bundles: List[RefBundle]):
+        self.bundles = bundles
+
+    def execute(self, upstream):
+        yield from self.bundles
+
+
+class ReadStage(Stage):
+    """Launches read tasks from serialized read descriptors."""
+
+    def __init__(self, read_fns: List[Callable], max_in_flight: int = None,
+                 concurrency: Optional[int] = None):
+        self.read_fns = read_fns
+        self.max_in_flight = (concurrency or max_in_flight
+                              or DEFAULT_MAX_IN_FLIGHT)
+
+    def execute(self, upstream):
+        remote_read = ray_tpu.remote(
+            lambda fn: _with_meta(fn()))
+        window = collections.deque()
+        fns = iter(self.read_fns)
+        exhausted = False
+        while True:
+            while not exhausted and len(window) < self.max_in_flight:
+                fn = next(fns, None)
+                if fn is None:
+                    exhausted = True
+                    break
+                window.append(remote_read.remote(fn))
+            if not window:
+                return
+            ref = window.popleft()
+            block, meta = ray_tpu.get(ref)
+            blk_ref = ray_tpu.put(block)
+            yield (blk_ref, meta)
+
+
+def _with_meta(block):
+    return block, block_lib.block_metadata(block)
+
+
+class MapStage(Stage):
+    def __init__(self, fn_kind: str, fn, batch_format: str = "numpy",
+                 fn_args=(), fn_kwargs=None, max_in_flight: int = None,
+                 concurrency: Optional[int] = None):
+        self.fn_kind = fn_kind
+        self.fn = fn
+        self.batch_format = batch_format
+        self.fn_args = fn_args
+        self.fn_kwargs = fn_kwargs
+        self.max_in_flight = (concurrency or max_in_flight
+                              or DEFAULT_MAX_IN_FLIGHT)
+
+    def execute(self, upstream):
+        remote_map = ray_tpu.remote(_map_block_remote)
+        window = collections.deque()
+        upstream = iter(upstream)
+        exhausted = False
+        while True:
+            while not exhausted and len(window) < self.max_in_flight:
+                nxt = next(upstream, None)
+                if nxt is None:
+                    exhausted = True
+                    break
+                ref, meta = nxt
+                window.append(remote_map.remote(
+                    self.fn_kind, self.fn, ref, self.batch_format,
+                    self.fn_args, self.fn_kwargs))
+            if not window:
+                return
+            out_ref = window.popleft()
+            # block until this output is ready (keeps order; later tasks
+            # keep running in the window)
+            block = ray_tpu.get(out_ref)
+            yield (ray_tpu.put(block), block_lib.block_metadata(block))
+
+
+class AllToAllStage(Stage):
+    """Materializes input, then reshapes (repartition / shuffle / sort)."""
+
+    def __init__(self, kind: str, **kwargs):
+        self.kind = kind
+        self.kwargs = kwargs
+
+    def execute(self, upstream):
+        bundles = list(upstream)
+        refs = [r for r, _ in bundles]
+        if self.kind == "repartition":
+            yield from self._repartition(refs, self.kwargs["num_blocks"])
+        elif self.kind == "random_shuffle":
+            yield from self._random_shuffle(refs, self.kwargs.get("seed"))
+        elif self.kind == "sort":
+            yield from self._sort(refs, self.kwargs["key"],
+                                  self.kwargs.get("descending", False))
+        else:
+            raise ValueError(self.kind)
+
+    def _repartition(self, refs, num_blocks: int):
+        blocks = ray_tpu.get(list(refs))
+        merged = block_lib.concat_blocks(blocks)
+        n = max(1, num_blocks)
+        rows = merged.num_rows
+        per = (rows + n - 1) // n if rows else 0
+        for i in range(n):
+            part = block_lib.slice_block(merged, min(i * per, rows),
+                                         min((i + 1) * per, rows)) \
+                if rows else merged
+            yield (ray_tpu.put(part), block_lib.block_metadata(part))
+
+    def _random_shuffle(self, refs, seed):
+        import numpy as np
+        blocks = ray_tpu.get(list(refs))
+        merged = block_lib.concat_blocks(blocks)
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(merged.num_rows)
+        shuffled = merged.take(idx)
+        n = max(1, len(refs))
+        per = (shuffled.num_rows + n - 1) // n if shuffled.num_rows else 1
+        for i in range(n):
+            part = block_lib.slice_block(
+                shuffled, min(i * per, shuffled.num_rows),
+                min((i + 1) * per, shuffled.num_rows))
+            yield (ray_tpu.put(part), block_lib.block_metadata(part))
+
+    def _sort(self, refs, key, descending):
+        blocks = ray_tpu.get(list(refs))
+        merged = block_lib.concat_blocks(blocks)
+        order = "descending" if descending else "ascending"
+        out = merged.sort_by([(key, order)])
+        yield (ray_tpu.put(out), block_lib.block_metadata(out))
+
+
+class LimitStage(Stage):
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def execute(self, upstream):
+        remaining = self.limit
+        for ref, meta in upstream:
+            if remaining <= 0:
+                return
+            if meta.num_rows <= remaining:
+                remaining -= meta.num_rows
+                yield (ref, meta)
+            else:
+                block = ray_tpu.get(ref)
+                part = block_lib.slice_block(block, 0, remaining)
+                remaining = 0
+                yield (ray_tpu.put(part), block_lib.block_metadata(part))
+                return
+
+
+def execute_plan(stages: List[Stage]) -> Iterator[RefBundle]:
+    stream: Iterator[RefBundle] = iter(())
+    for stage in stages:
+        stream = stage.execute(stream)
+    return stream

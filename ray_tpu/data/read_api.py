@@ -1,0 +1,138 @@
+"""Dataset creation (reference: python/ray/data/read_api.py —
+range/from_items/read_parquet/read_csv/read_json/from_numpy/from_pandas).
+Reads are lazy: each source becomes a list of zero-arg read callables
+launched as tasks by the ReadStage."""
+
+from __future__ import annotations
+
+import glob as glob_mod
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data import block as block_lib
+from ray_tpu.data import execution as exe
+from ray_tpu.data.dataset import Dataset
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:   # noqa: A001
+    import builtins
+    parallelism = max(1, min(parallelism, n or 1))
+    per = (n + parallelism - 1) // parallelism
+
+    def make(lo, hi):
+        def read():
+            import numpy as np
+            import pyarrow as pa
+            return pa.table({"id": np.arange(lo, hi, dtype=np.int64)})
+        return read
+
+    fns = [make(i * per, min((i + 1) * per, n))
+           for i in builtins.range(parallelism) if i * per < n]
+    return Dataset([exe.ReadStage(fns)])
+
+
+def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
+    import builtins
+    parallelism = max(1, min(parallelism, len(items) or 1))
+    per = (len(items) + parallelism - 1) // parallelism
+    chunks = [items[i * per:(i + 1) * per]
+              for i in builtins.range(parallelism) if i * per < len(items)]
+
+    def make(chunk):
+        return lambda: block_lib.block_from_rows(
+            [r if isinstance(r, dict) else {"item": r} for r in chunk])
+
+    return Dataset([exe.ReadStage([make(c) for c in chunks])])
+
+
+def from_numpy(arr: np.ndarray, column: str = "data",
+               *, parallelism: int = 8) -> Dataset:
+    import builtins
+    parallelism = max(1, min(parallelism, len(arr) or 1))
+    splits = np.array_split(arr, parallelism)
+
+    def make(part):
+        def read():
+            import pyarrow as pa
+            if part.ndim == 1:
+                return pa.table({column: part})
+            return pa.table({column: [row.tolist() for row in part]})
+        return read
+
+    return Dataset([exe.ReadStage([make(s) for s in splits if len(s)])])
+
+
+def from_pandas(df) -> Dataset:
+    import pyarrow as pa
+    table = pa.Table.from_pandas(df, preserve_index=False)
+    return Dataset([exe.ReadStage([lambda: table])])
+
+
+def from_arrow(table) -> Dataset:
+    return Dataset([exe.ReadStage([lambda: table])])
+
+
+def _expand_paths(paths, suffix: str) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob_mod.glob(os.path.join(p, f"*{suffix}"))))
+        elif "*" in p:
+            out.extend(sorted(glob_mod.glob(p)))
+        else:
+            out.append(p)
+    return out
+
+
+def read_parquet(paths, **kwargs) -> Dataset:
+    files = _expand_paths(paths, ".parquet")
+
+    def make(f):
+        def read():
+            import pyarrow.parquet as pq
+            return pq.read_table(f)
+        return read
+
+    return Dataset([exe.ReadStage([make(f) for f in files])])
+
+
+def read_csv(paths, **kwargs) -> Dataset:
+    files = _expand_paths(paths, ".csv")
+
+    def make(f):
+        def read():
+            import pyarrow.csv as pcsv
+            return pcsv.read_csv(f)
+        return read
+
+    return Dataset([exe.ReadStage([make(f) for f in files])])
+
+
+def read_json(paths, **kwargs) -> Dataset:
+    files = _expand_paths(paths, ".json")
+
+    def make(f):
+        def read():
+            import pyarrow.json as pjson
+            return pjson.read_json(f)
+        return read
+
+    return Dataset([exe.ReadStage([make(f) for f in files])])
+
+
+def read_text(paths, **kwargs) -> Dataset:
+    files = _expand_paths(paths, ".txt")
+
+    def make(f):
+        def read():
+            import pyarrow as pa
+            with open(f) as fh:
+                lines = [line.rstrip("\n") for line in fh]
+            return pa.table({"text": lines})
+        return read
+
+    return Dataset([exe.ReadStage([make(f) for f in files])])

@@ -1,0 +1,20 @@
+from ray_tpu.models.transformer import (TransformerConfig, TransformerLM,
+                                        count_params)
+
+MODEL_REGISTRY = {
+    "llama-debug": TransformerConfig(
+        vocab_size=1024, d_model=256, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=512, max_seq_len=512),
+    "llama-125m": TransformerConfig(
+        vocab_size=32000, d_model=768, n_layers=12, n_heads=12, n_kv_heads=12,
+        d_ff=2048, max_seq_len=2048),
+    "llama-1b": TransformerConfig(
+        vocab_size=32000, d_model=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+        d_ff=5632, max_seq_len=4096),
+    "llama-7b": TransformerConfig(
+        vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=32,
+        d_ff=11008, max_seq_len=4096),
+}
+
+__all__ = ["TransformerConfig", "TransformerLM", "MODEL_REGISTRY",
+           "count_params"]

@@ -1,0 +1,200 @@
+"""Flagship model: Llama-style decoder-only transformer (flax.linen).
+
+TPU-first design choices:
+- bfloat16 activations, fp32 params/optimizer (master-weight recipe);
+  matmuls hit the MXU at full tile size.
+- `lax.scan` over layers (one compiled layer body, fast compiles) with
+  `jax.checkpoint` rematerialization per layer.
+- Every parameter is annotated with *logical* axes via flax partitioning
+  metadata; ray_tpu.parallel.sharding maps them to the dp/fsdp/tp/sp mesh.
+- Attention dispatches to the Pallas flash kernel on one device or to
+  ring attention over the `seq` mesh axis when sequence parallelism is on.
+
+The reference framework ships no model implementations (it orchestrates
+torch code); this model exists as the framework's flagship train/serve
+workload and benchmark subject.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ray_tpu.ops.dispatch import attention as attention_dispatch
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    d_ff: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    tie_embeddings: bool = False
+    remat: bool = True
+    scan_layers: bool = True
+    # "auto": flash kernel on 1 seq shard, ring attention when seq axis > 1
+    attention_impl: str = "auto"
+    seq_axis: str = "seq"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def _p(init, *logical_axes):
+    """Attach logical-axis metadata to a param initializer."""
+    return nn.with_partitioning(init, logical_axes)
+
+
+class RMSNorm(nn.Module):
+    eps: float
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", _p(nn.initializers.ones, "embed"),
+                           (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True)
+                                + self.eps)
+        return (y * scale).astype(self.dtype)
+
+
+def rope(x, positions, theta: float):
+    """Rotary embeddings. x[B,L,H,D], positions[B,L]."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,L,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+    return out.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        B, L, E = x.shape
+        H, Hkv, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        dense = lambda feats, axes, name: nn.DenseGeneral(  # noqa: E731
+            feats, axis=-1, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name=name,
+            kernel_init=_p(nn.initializers.lecun_normal(), *axes))
+        q = dense((H, D), ("embed", "heads", "head_dim"), "q")(x)
+        k = dense((Hkv, D), ("embed", "kv_heads", "head_dim"), "k")(x)
+        v = dense((Hkv, D), ("embed", "kv_heads", "head_dim"), "v")(x)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        out = attention_dispatch(q, k, v, causal=True,
+                                 impl=cfg.attention_impl)
+        proj = nn.DenseGeneral(
+            E, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="o",
+            kernel_init=_p(nn.initializers.lecun_normal(),
+                           "heads", "head_dim", "embed"))
+        return proj(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda feats, axes, name: nn.DenseGeneral(  # noqa: E731
+            feats, axis=-1, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name=name,
+            kernel_init=_p(nn.initializers.lecun_normal(), *axes))
+        gate = dense(cfg.d_ff, ("embed", "mlp"), "gate")(x)
+        up = dense(cfg.d_ff, ("embed", "mlp"), "up")(x)
+        y = nn.silu(gate) * up
+        return dense(cfg.d_model, ("mlp", "embed"), "down")(y)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        h = x + Attention(cfg, name="attn")(
+            RMSNorm(cfg.norm_eps, cfg.dtype, name="attn_norm")(x), positions)
+        out = h + MLP(cfg, name="mlp")(
+            RMSNorm(cfg.norm_eps, cfg.dtype, name="mlp_norm")(h))
+        return out
+
+
+class ScanBlock(nn.Module):
+    """Block with a scan-compatible (carry, ys) signature."""
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        return Block(self.cfg, name="block")(x, positions), None
+
+
+class TransformerLM(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        cfg = self.cfg
+        B, L = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(L)[None, :], (B, L))
+        embed = self.param(
+            "embed", _p(nn.initializers.normal(0.02), "vocab", "embed"),
+            (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
+        x = embed.astype(cfg.dtype)[tokens]
+
+        if cfg.scan_layers:
+            scan_target = ScanBlock
+            if cfg.remat:
+                scan_target = nn.remat(
+                    ScanBlock, prevent_cse=False,
+                    policy=jax.checkpoint_policies.nothing_saveable)
+            stack = nn.scan(
+                scan_target,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=nn.broadcast,
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")
+            x, _ = stack(x, positions)
+        else:
+            block = Block
+            if cfg.remat:
+                block = nn.remat(
+                    Block, prevent_cse=False,
+                    policy=jax.checkpoint_policies.nothing_saveable)
+            for i in range(cfg.n_layers):
+                x = block(cfg, name=f"layer_{i}")(x, positions)
+        x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bld,vd->blv", x, embed.astype(cfg.dtype))
+        else:
+            out = self.param(
+                "unembed", _p(nn.initializers.normal(0.02), "embed", "vocab"),
+                (cfg.d_model, cfg.vocab_size), cfg.param_dtype)
+            logits = jnp.einsum("bld,dv->blv", x, out.astype(cfg.dtype))
+        return logits.astype(jnp.float32)
+
+
+def count_params(params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))

@@ -1,0 +1,4 @@
+from ray_tpu.ops.attention import flash_attention, mha_reference
+from ray_tpu.ops.ring_attention import ring_attention
+
+__all__ = ["flash_attention", "mha_reference", "ring_attention"]

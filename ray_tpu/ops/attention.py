@@ -1,0 +1,123 @@
+"""Attention kernels.
+
+`flash_attention` is a Pallas TPU kernel (tiled online-softmax attention,
+VMEM-blocked for the MXU; see /opt/skills/guides/pallas_guide.md
+conventions); on non-TPU backends it falls back to the XLA reference
+implementation so the same model code runs on the CPU test mesh.
+
+The reference framework has no attention kernels at all (it orchestrates
+torch models); these exist because long-context parallelism is first-class
+here (SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def mha_reference(q, k, v, causal: bool = True,
+                  q_offset: int = 0, k_offset: int = 0,
+                  scale: Optional[float] = None):
+    """XLA attention: q[B,Lq,H,D], k/v[B,Lk,Hkv,D] -> [B,Lq,H,D].
+    Supports GQA (H a multiple of Hkv) and absolute position offsets for
+    block-parallel callers."""
+    B, Lq, H, D = q.shape
+    _, Lk, Hkv, _ = k.shape
+    scale = scale if scale is not None else D ** -0.5
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qpos = jnp.arange(Lq) + q_offset
+        kpos = jnp.arange(Lk) + k_offset
+        mask = qpos[:, None] >= kpos[None, :]
+        logits = jnp.where(mask[None, None, :, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, Lk: int,
+                  causal: bool, scale: float, block_q: int):
+    qi = pl.program_id(1)
+    q = q_ref[...]                      # [block_q, D]
+    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    n_kblocks = Lk // block_k
+
+    def body(ki, carry):
+        acc, m, l = carry
+        k = k_ref[pl.ds(ki * block_k, block_k), :]
+        v = v_ref[pl.ds(ki * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + p.sum(axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p.astype(v.dtype), v,
+                                    preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    if causal:
+        # only blocks up to (and including) the diagonal contribute
+        hi = jax.lax.min(n_kblocks,
+                         (qi + 1) * block_q // block_k + 1)
+    else:
+        hi = n_kblocks
+    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc, m, l))
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 256,
+                    block_k: int = 256, scale: Optional[float] = None,
+                    interpret: bool = False):
+    """Tiled attention. q[B,Lq,H,D], k/v[B,Lk,Hkv,D] (GQA ok)."""
+    B, Lq, H, D = q.shape
+    _, Lk, Hkv, _ = k.shape
+    scale = scale if scale is not None else D ** -0.5
+    on_tpu = jax.default_backend() == "tpu"
+    if not (on_tpu or interpret) or Lq % 128 or Lk % 128 or D % 128:
+        return mha_reference(q, k, v, causal=causal, scale=scale)
+    block_q = min(block_q, Lq)
+    block_k = min(block_k, Lk)
+    if Lq % block_q or Lk % block_k or block_q % block_k:
+        return mha_reference(q, k, v, causal=causal, scale=scale)
+    if Hkv != H:
+        k = jnp.repeat(k, H // Hkv, axis=2)
+        v = jnp.repeat(v, H // Hkv, axis=2)
+    # layout: [B*H, L, D] so each grid cell works on one head's q block
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Lq, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k, Lk=Lk,
+                               causal=causal, scale=scale, block_q=block_q)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Lq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Lk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Lk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, Lq, D).transpose(0, 2, 1, 3)

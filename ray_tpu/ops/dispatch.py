@@ -1,0 +1,57 @@
+"""Attention dispatch: pick the right kernel for the current mesh.
+
+Under a multi-device mesh the attention runs as a shard_map island inside
+the jitted step — Pallas kernels and ring collectives both need per-shard
+(local) views, which GSPMD alone can't give them. On one device it's the
+Pallas flash kernel (TPU) or the XLA reference (CPU tests).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ray_tpu.ops.attention import flash_attention, mha_reference
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.parallel import mesh as mesh_lib
+from ray_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR
+
+
+def attention(q, k, v, causal: bool = True, impl: str = "auto"):
+    """q[B,L,H,D], k/v[B,L,Hkv,D] — global (logical) shapes."""
+    mesh = mesh_lib.current_mesh()
+    if impl == "auto":
+        if mesh is not None and mesh.size > 1:
+            impl = "ring"
+        elif jax.default_backend() == "tpu":
+            impl = "flash"
+        else:
+            impl = "reference"
+    if impl == "ring":
+        if mesh is None:
+            raise ValueError("ring attention needs a mesh (use_mesh(...))")
+        B, L, H, D = q.shape
+        Hkv = k.shape[2]
+        t = mesh.shape[AXIS_TENSOR]
+        s = mesh.shape[AXIS_SEQ]
+        bsz = mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
+        if L % s != 0:
+            return mha_reference(q, k, v, causal=causal)
+        batch_ax = (AXIS_DATA, AXIS_FSDP) if B % bsz == 0 else None
+        # heads shard over tensor only when q AND kv head counts divide it
+        # (keeps the GQA repeat factor consistent per shard)
+        head_ax = AXIS_TENSOR if (H % t == 0 and Hkv % t == 0) else None
+        spec = P(batch_ax, AXIS_SEQ, head_ax, None)
+        fn = shard_map(
+            functools.partial(ring_attention, axis_name=AXIS_SEQ,
+                              causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_rep=False)
+        return fn(q, k, v)
+    if impl == "flash":
+        return flash_attention(q, k, v, causal=causal)
+    return mha_reference(q, k, v, causal=causal)

@@ -1,0 +1,73 @@
+"""Ring attention: exact causal attention over a sequence-sharded axis.
+
+Context parallelism for long sequences (SURVEY.md §5.7 — absent from the
+reference, which leaves intra-model parallelism to the training framework).
+Each device holds a contiguous sequence shard of Q/K/V; K/V blocks rotate
+around the `seq` mesh axis via ppermute while every device accumulates
+attention of its local queries against each passing block with an online
+(streaming) softmax — compute overlaps the ICI transfer, memory stays
+O(L_local), and the result is bit-for-bit exact attention (blockwise /
+RingAttention construction).
+
+Use inside shard_map with q,k,v already sharded on the seq axis:
+
+    out = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="seq"),
+        mesh, in_specs=P(None, "seq", None, None), out_specs=...)(q, k, v)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True,
+                   scale: Optional[float] = None):
+    """q[B,Lq,H,D], k/v[B,Lk,Hkv,D] — local shards; returns local [B,Lq,H,D]."""
+    B, Lq, H, D = q.shape
+    _, Lk, Hkv, _ = k.shape
+    scale = scale if scale is not None else D ** -0.5
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    if Hkv != H:
+        k = jnp.repeat(k, H // Hkv, axis=2)
+        v = jnp.repeat(v, H // Hkv, axis=2)
+
+    q32 = q.astype(jnp.float32)
+
+    def step(carry, i):
+        acc, m, l, kb, vb = carry
+        # the block currently held originated on device (my_idx - i) % size
+        src = (my_idx - i) % axis_size
+        s = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                       kb.astype(jnp.float32)) * scale
+        if causal:
+            qpos = my_idx * Lq + jnp.arange(Lq)
+            kpos = src * Lk + jnp.arange(Lk)
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, vb.astype(jnp.float32))
+        acc = acc * alpha.transpose(0, 2, 1)[..., None] + pv
+        # rotate K/V around the ring for the next step
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (acc, m_new, l_new, kb, vb), None
+
+    acc0 = jnp.zeros((B, Lq, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Lq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Lq), jnp.float32)
+    (acc, m, l, _, _), _ = lax.scan(
+        step, (acc0, m0, l0, k, v), jnp.arange(axis_size))
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (acc / denom).astype(q.dtype)

@@ -1,0 +1,12 @@
+from ray_tpu.parallel.mesh import (MeshConfig, make_mesh, local_mesh,
+                                   AXIS_DATA, AXIS_FSDP, AXIS_TENSOR,
+                                   AXIS_SEQ, AXIS_EXPERT)
+from ray_tpu.parallel.sharding import (logical_to_mesh_axes, make_sharding_rules,
+                                       param_shardings, batch_sharding,
+                                       constrain)
+
+__all__ = [
+    "MeshConfig", "make_mesh", "local_mesh", "AXIS_DATA", "AXIS_FSDP",
+    "AXIS_TENSOR", "AXIS_SEQ", "AXIS_EXPERT", "logical_to_mesh_axes",
+    "make_sharding_rules", "param_shardings", "batch_sharding", "constrain",
+]

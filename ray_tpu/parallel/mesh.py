@@ -1,0 +1,105 @@
+"""Device-mesh construction for dp/fsdp/tp/sp/ep parallelism.
+
+The TPU-native replacement for the reference's process-group world
+(reference: torch.distributed init in python/ray/train/torch/config.py:153,
+NCCL groups in python/ray/util/collective/): instead of creating
+communicator objects, we build one `jax.sharding.Mesh` whose named axes ARE
+the parallelism strategies; XLA inserts the collectives (psum over `data` +
+`fsdp` for gradients, all-gather over `fsdp` for params, all-to-all /
+ppermute over `seq` for ring attention, etc.) and lays them onto ICI.
+
+Axis convention (scaling-book style):
+  data    — pure data parallel (gradient psum)
+  fsdp    — data parallel with parameter sharding (ZeRO-3 / XLA SPMD)
+  tensor  — megatron-style tensor parallel (activations all-reduce)
+  seq     — sequence/context parallel (ring attention over this axis)
+  expert  — MoE expert parallel
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_TENSOR = "tensor"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+
+MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR)
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """How many ways each parallelism axis is sharded. -1 on one axis means
+    'absorb all remaining devices'."""
+    data: int = 1
+    fsdp: int = -1
+    seq: int = 1
+    tensor: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        vals = {f.name: getattr(self, f.name)
+                for f in dataclasses.fields(self)}
+        wild = [k for k, v in vals.items() if v == -1]
+        fixed = math.prod(v for v in vals.values() if v != -1)
+        if len(wild) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {vals}")
+            vals[wild[0]] = n_devices // fixed
+        else:
+            if fixed != n_devices:
+                raise ValueError(
+                    f"mesh {vals} needs {fixed} devices, have {n_devices}")
+        return MeshConfig(**vals)
+
+    @property
+    def shape(self):
+        return (self.data, self.fsdp, self.seq, self.tensor)
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build the 4-axis mesh. Axis order puts `tensor` innermost so
+    tensor-parallel collectives ride the fastest ICI links, then `seq`,
+    then fsdp/data outermost (DCN-friendly)."""
+    devices = list(devices if devices is not None else jax.devices())
+    config = (config or MeshConfig()).resolve(len(devices))
+    arr = np.asarray(devices).reshape(config.shape)
+    return Mesh(arr, MESH_AXES)
+
+
+def local_mesh() -> Mesh:
+    """Single-host mesh over all visible devices on the fsdp axis."""
+    return make_mesh(MeshConfig(data=1, fsdp=-1, seq=1, tensor=1))
+
+
+# ---------------------------------------------------------------- context
+# The "current mesh" lets model code open shard_map islands (ring attention)
+# without threading the Mesh through every module.
+_CURRENT_MESH: list = []
+
+
+class use_mesh:
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        _CURRENT_MESH.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _CURRENT_MESH.pop()
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _CURRENT_MESH[-1] if _CURRENT_MESH else None

@@ -1,0 +1,85 @@
+"""Logical-axis sharding rules → NamedShardings.
+
+The reference leaves intra-model parallelism to torch FSDP/DeepSpeed inside
+the training loop (reference: python/ray/train/torch/train_loop_utils.py
+prepare_model); here sharding is a first-class framework layer: model code
+annotates parameters/activations with *logical* axis names, and a rule table
+maps logical axes to mesh axes per parallelism plan (flax linen
+logical-partitioning idiom, re-implemented standalone so models and the
+train step share one vocabulary).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import (AXIS_DATA, AXIS_FSDP, AXIS_SEQ,
+                                   AXIS_TENSOR)
+
+# Default rule table: logical axis -> mesh axis (or None = replicated).
+# Embeddings/MLP widths shard over tensor; the long "model dim" rows shard
+# over fsdp (ZeRO-3 resharding, all-gathered per layer by XLA).
+DEFAULT_RULES: Dict[str, Optional[object]] = {
+    "batch": (AXIS_DATA, AXIS_FSDP),   # global batch over both DP axes
+    "seq": AXIS_SEQ,                   # sequence/context parallel
+    "vocab": AXIS_TENSOR,
+    "embed": AXIS_FSDP,
+    "heads": AXIS_TENSOR,
+    "kv_heads": AXIS_TENSOR,
+    "head_dim": None,
+    "mlp": AXIS_TENSOR,
+    "experts": None,                   # remapped to expert axis when MoE
+    "layers": None,                    # scan axis; stays replicated (pp later)
+    None: None,
+}
+
+
+def make_sharding_rules(**overrides) -> Dict[str, Optional[object]]:
+    rules = dict(DEFAULT_RULES)
+    rules.update(overrides)
+    return rules
+
+
+def logical_to_mesh_axes(logical: Sequence[Optional[str]],
+                         rules: Optional[Dict] = None) -> P:
+    rules = rules or DEFAULT_RULES
+    spec = []
+    used = set()
+    for name in logical:
+        axis = rules.get(name)
+        # a mesh axis may appear at most once in a PartitionSpec
+        if axis is not None:
+            key = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+            if any(a in used for a in key):
+                axis = None
+            else:
+                used.update(key)
+        spec.append(tuple(axis) if isinstance(axis, list) else axis)
+    return P(*spec)
+
+
+def param_shardings(mesh: Mesh, logical_tree,
+                    rules: Optional[Dict] = None):
+    """Map a pytree of logical-axis tuples to NamedShardings."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, logical_to_mesh_axes(axes, rules)),
+        logical_tree, is_leaf=lambda x: isinstance(x, tuple))
+
+
+def batch_sharding(mesh: Mesh, rules: Optional[Dict] = None,
+                   with_seq: bool = True) -> NamedSharding:
+    axes = ("batch", "seq") if with_seq else ("batch",)
+    return NamedSharding(mesh, logical_to_mesh_axes(axes, rules))
+
+
+def constrain(x, logical: Sequence[Optional[str]],
+              rules: Optional[Dict] = None):
+    """with_sharding_constraint by logical axes (no-op outside jit/mesh)."""
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, logical_to_mesh_axes(logical, rules))
+    except (ValueError, RuntimeError):
+        return x

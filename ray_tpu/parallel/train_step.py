@@ -1,0 +1,194 @@
+"""Sharded training step: init + step compiled over the mesh.
+
+This is the compute core the Train library (and __graft_entry__) drives:
+- parameters/optimizer state sharded by the logical-axis rule table
+  (ZeRO-3 over `fsdp`, megatron over `tensor`) — XLA inserts all-gathers /
+  reduce-scatters; gradients sync via the shardings alone, no explicit
+  collectives (replaces the reference's torch.distributed allreduce path,
+  reference: python/ray/train/torch/config.py:153).
+- the batch is sharded over (data, fsdp) × seq; ring attention runs as a
+  shard_map island over `seq`.
+- the step donates the previous state (buffer reuse in HBM).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from flax.core import FrozenDict
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel import sharding as sharding_lib
+from ray_tpu.parallel.mesh import use_mesh
+
+
+@dataclasses.dataclass
+class TrainState:
+    step: Any
+    params: Any
+    opt_state: Any
+
+    def tree_flatten(self):
+        return (self.step, self.params, self.opt_state), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    TrainState, TrainState.tree_flatten, TrainState.tree_unflatten)
+
+
+def _translate_entry(entry, rules):
+    if entry is None:
+        return None
+    if isinstance(entry, (tuple, list)):
+        out = []
+        for e in entry:
+            m = rules.get(e)
+            if m is None:
+                continue
+            if isinstance(m, (tuple, list)):
+                out.extend(m)
+            else:
+                out.append(m)
+        return tuple(out) if out else None
+    m = rules.get(entry)
+    return tuple(m) if isinstance(m, list) else m
+
+
+def logical_pspec_to_mesh(spec, rules) -> P:
+    if not isinstance(spec, P):
+        return P()
+    used = set()
+    out = []
+    for entry in spec:
+        m = _translate_entry(entry, rules)
+        if m is not None:
+            key = m if isinstance(m, tuple) else (m,)
+            if any(a in used for a in key):
+                m = None
+            else:
+                used.update(key)
+        out.append(m)
+    return P(*out)
+
+
+def _prune_indivisible(spec: P, shape, mesh: Mesh) -> P:
+    """Replicate any dimension whose size isn't divisible by its mesh axes
+    (e.g. 2 KV heads on an 8-way tensor axis)."""
+    if shape is None or len(spec) == 0:
+        return spec
+    out = []
+    for i, entry in enumerate(spec):
+        if entry is None or i >= len(shape):
+            out.append(entry)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        size = 1
+        for a in axes:
+            size *= mesh.shape[a]
+        out.append(entry if size and shape[i] % size == 0 else None)
+    return P(*out)
+
+
+def state_shardings(abstract_state, mesh: Mesh, rules=None):
+    """Derive NamedShardings for a TrainState from flax Partitioned boxes."""
+    rules = rules or sharding_lib.DEFAULT_RULES
+    logical = nn.get_partition_spec(abstract_state)
+
+    def mk(sp, node):
+        if not isinstance(sp, P):
+            return NamedSharding(mesh, P())
+        leaves = jax.tree.leaves(node)
+        shape = leaves[0].shape if leaves else None
+        mesh_spec = _prune_indivisible(
+            logical_pspec_to_mesh(sp, rules), shape, mesh)
+        return NamedSharding(mesh, mesh_spec)
+
+    return jax.tree.map(mk, logical, abstract_state,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def cross_entropy_loss(logits, targets, mask=None):
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    if mask is None:
+        return nll.mean(), nll.size
+    mask = mask.astype(jnp.float32)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    return (nll * mask).sum() / denom, denom
+
+
+def make_train_fns(model: nn.Module, optimizer,
+                   mesh: Mesh, rules=None,
+                   batch_shape: Tuple[int, int] = (8, 512),
+                   ) -> Tuple[Callable, Callable, Any]:
+    """Returns (init_fn(rng) -> TrainState, step_fn(state, batch) ->
+    (state, metrics), state_sharding_tree). Both are jitted with explicit
+    shardings over `mesh`."""
+    rules = rules or sharding_lib.DEFAULT_RULES
+    tokens0 = jnp.zeros(batch_shape, jnp.int32)
+
+    def init_state(rng):
+        variables = model.init(rng, tokens0)
+        params = variables["params"]
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=optimizer.init(params))
+
+    abstract = jax.eval_shape(init_state, jax.random.PRNGKey(0))
+    shardings = state_shardings(abstract, mesh, rules)
+    batch_sharding = NamedSharding(
+        mesh, _prune_indivisible(
+            logical_pspec_to_mesh(P("batch", "seq"), rules),
+            batch_shape, mesh))
+
+    init_fn = jax.jit(init_state, out_shardings=shardings)
+
+    def loss_fn(params, tokens, mask):
+        inputs = tokens[:, :-1]
+        targets = tokens[:, 1:]
+        logits = model.apply({"params": params}, inputs)
+        loss, denom = cross_entropy_loss(
+            logits, targets, None if mask is None else mask[:, 1:])
+        return loss, denom
+
+    def step_fn(state: TrainState, tokens, mask=None):
+        with use_mesh(mesh):
+            (loss, denom), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params, tokens, mask)
+        updates, new_opt = optimizer.update(grads, state.opt_state,
+                                            params=state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(step=state.step + 1, params=new_params,
+                               opt_state=new_opt)
+        return new_state, {"loss": loss, "grad_norm": gnorm,
+                           "tokens": denom}
+
+    jit_step = jax.jit(
+        step_fn,
+        in_shardings=(shardings, batch_sharding, None),
+        out_shardings=(shardings, None),
+        donate_argnums=(0,))
+
+    # jit(step) traces the model outside use_mesh; wrap so tracing also sees
+    # the mesh context (shard_map islands need the concrete mesh at trace
+    # time, and trace happens at first call)
+    def step_with_mesh(state, tokens, mask=None):
+        with use_mesh(mesh):
+            return jit_step(state, tokens, mask)
+
+    def init_with_mesh(rng):
+        with use_mesh(mesh):
+            return init_fn(rng)
+
+    return init_with_mesh, step_with_mesh, shardings

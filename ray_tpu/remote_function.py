@@ -19,6 +19,12 @@ def _resources_from_options(opts: Dict[str, Any]) -> Dict[str, float]:
         res["memory"] = float(opts["memory"])
     if "CPU" not in res and "TPU" not in res and "GPU" not in res:
         res["CPU"] = 1.0
+    if "TPU" in res:
+        from ray_tpu._private.accelerators import TPUAcceleratorManager
+        ok, reason = TPUAcceleratorManager.validate_resource_request_quantity(
+            res["TPU"])
+        if not ok:
+            raise ValueError(f"invalid TPU request {res['TPU']}: {reason}")
     return res
 
 

@@ -1,0 +1,9 @@
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                  ScalingConfig)
+from ray_tpu.train.session import get_context, report
+from ray_tpu.train.trainer import JaxTrainer, Result
+
+__all__ = ["JaxTrainer", "Result", "ScalingConfig", "RunConfig",
+           "FailureConfig", "CheckpointConfig", "Checkpoint", "report",
+           "get_context"]

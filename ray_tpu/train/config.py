@@ -1,0 +1,50 @@
+"""Train config dataclasses (reference: python/ray/air/config.py —
+ScalingConfig, RunConfig, FailureConfig, CheckpointConfig). ScalingConfig
+gains TPU topology/mesh axes: the mesh is a first-class training knob here,
+compiled into NamedShardings (reference leaves this to torch FSDP inside
+the loop)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from ray_tpu.parallel.mesh import MeshConfig
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    topology: Optional[str] = None        # e.g. "v5litepod-8", "v4-32"
+    mesh: Optional[MeshConfig] = None     # per-worker device mesh axes
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        if "CPU" not in res:
+            res["CPU"] = 1.0
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = 1.0
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None

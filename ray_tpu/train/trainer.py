@@ -1,0 +1,117 @@
+"""JaxTrainer: distributed data/model-parallel training driver
+(reference shape: python/ray/train/data_parallel_trainer.py:25 — worker
+group, per-worker sessions, checkpointing, group restart on failure; the
+reference routes fit() through Tune (base_trainer.py:567) — here fit() is
+self-contained and the Tune integration wraps it instead)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.backend_executor import BackendExecutor
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                  ScalingConfig)
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    path: str
+    error: Optional[BaseException] = None
+    metrics_history: Optional[list] = None
+
+
+class JaxTrainer:
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[Dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self.train_loop = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        rc = self.run_config
+        name = rc.name or f"train_{int(time.time())}"
+        storage = rc.storage_path or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_results")
+        exp_dir = os.path.join(storage, name)
+        os.makedirs(exp_dir, exist_ok=True)
+        ckpt_cfg = rc.checkpoint_config or CheckpointConfig()
+        manager = CheckpointManager(
+            os.path.join(exp_dir, "checkpoints"),
+            num_to_keep=ckpt_cfg.num_to_keep,
+            score_attribute=ckpt_cfg.checkpoint_score_attribute,
+            order=ckpt_cfg.checkpoint_score_order)
+        failure_cfg = rc.failure_config or FailureConfig()
+        failures_left = failure_cfg.max_failures
+        resume = self.resume_from_checkpoint
+
+        history: list = []
+        last_metrics: Dict[str, Any] = {}
+        while True:
+            executor = BackendExecutor(
+                self.scaling_config,
+                use_jax_distributed=self.scaling_config.use_tpu
+                and self.scaling_config.num_workers > 1)
+            error = None
+            try:
+                executor.start()
+                if resume is not None:
+                    executor.set_resume_checkpoint(resume)
+                executor.start_training(self.train_loop,
+                                        self.train_loop_config)
+                while True:
+                    for rank, results in enumerate(executor.poll_results()):
+                        for item in results:
+                            metrics = item["metrics"]
+                            ckpt = item["checkpoint"]
+                            if rank == 0:
+                                metrics = {**metrics,
+                                           "_timestamp": time.time()}
+                                history.append(metrics)
+                                last_metrics = metrics
+                                if ckpt is not None:
+                                    manager.register(ckpt, metrics)
+                    done, error = executor.finished()
+                    if done:
+                        break
+                    time.sleep(0.25)
+                # final drain (workers may already be gone on failure)
+                try:
+                    for rank, results in enumerate(executor.poll_results()):
+                        for item in results:
+                            if rank == 0:
+                                history.append(item["metrics"])
+                                last_metrics = item["metrics"]
+                                if item["checkpoint"] is not None:
+                                    manager.register(item["checkpoint"],
+                                                     item["metrics"])
+                except Exception:
+                    pass
+            except Exception as e:
+                error = e
+            finally:
+                executor.shutdown()
+
+            if error is None:
+                return Result(metrics=last_metrics,
+                              checkpoint=manager.best_checkpoint(),
+                              path=exp_dir, metrics_history=history)
+            if failures_left == 0:
+                return Result(metrics=last_metrics,
+                              checkpoint=manager.latest_checkpoint(),
+                              path=exp_dir, error=error,
+                              metrics_history=history)
+            failures_left -= 1
+            resume = manager.latest_checkpoint() or resume
+            time.sleep(1.0)

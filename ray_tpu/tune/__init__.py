@@ -1,0 +1,9 @@
+from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.search import (choice, grid_search, loguniform, randint,
+                                 uniform)
+from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner,
+                                report)
+
+__all__ = ["Tuner", "TuneConfig", "ResultGrid", "TrialResult", "report",
+           "grid_search", "choice", "uniform", "loguniform", "randint",
+           "ASHAScheduler", "FIFOScheduler"]

@@ -1,0 +1,58 @@
+"""Trial schedulers (reference: python/ray/tune/schedulers/ —
+FIFOScheduler, ASHA async_hyperband.py). Decisions are made per reported
+result: CONTINUE or STOP."""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    """Async successive halving: at each rung (grace_period * rf^k steps),
+    a trial continues only if it's in the top 1/reduction_factor of
+    completed rung entries (reference: schedulers/async_hyperband.py)."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        self.rung_records: Dict[int, List[float]] = \
+            collections.defaultdict(list)
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        t = result.get(self.time_attr)
+        score = result.get(self.metric)
+        if t is None or score is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        for rung in self.rungs:
+            if t == rung:
+                sign = 1.0 if self.mode == "max" else -1.0
+                rec = self.rung_records[rung]
+                rec.append(sign * score)
+                rec.sort(reverse=True)
+                k = max(1, len(rec) // self.rf)
+                if sign * score < rec[k - 1]:
+                    return STOP
+        return CONTINUE

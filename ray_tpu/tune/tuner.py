@@ -1,0 +1,194 @@
+"""Tuner + trial-execution controller (reference: python/ray/tune/tuner.py:44
+Tuner and tune/execution/tune_controller.py:68 TuneController).
+
+Each trial runs a function trainable inside its own actor; the controller
+loop starts trials as resources allow, drains their reported results,
+applies scheduler decisions (ASHA early stopping kills the trial actor),
+and collects a ResultGrid. Trainables call ray_tpu.tune.report(...)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.search import BasicVariantGenerator
+
+_tune_session = None
+
+
+class _TuneSession:
+    def __init__(self):
+        self.results: List[Dict] = []
+        self.lock = threading.Lock()
+        self.iteration = 0
+
+    def report(self, metrics: Dict):
+        with self.lock:
+            self.iteration += 1
+            self.results.append({**metrics,
+                                 "training_iteration": self.iteration})
+
+    def drain(self):
+        with self.lock:
+            out = self.results
+            self.results = []
+            return out
+
+
+def report(metrics: Optional[Dict] = None, **kwargs):
+    s = _tune_session
+    if s is None:
+        raise RuntimeError("tune.report() called outside a trial")
+    s.report({**(metrics or {}), **kwargs})
+
+
+class TrialActor:
+    """Hosts one trial; max_concurrency=2 so poll() answers during run()."""
+
+    def __init__(self):
+        global _tune_session
+        _tune_session = _TuneSession()
+        self._session = _tune_session
+
+    def run(self, fn, config):
+        fn(config)
+        return True
+
+    def poll(self):
+        return self._session.drain()
+
+
+@dataclasses.dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any]
+    history: List[Dict[str, Any]]
+    error: Optional[str] = None
+
+    @property
+    def last_result(self):
+        return self.metrics
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult]):
+        self._results = results
+
+    def __len__(self):
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    def get_best_result(self, metric: str, mode: str = "max") -> TrialResult:
+        scored = [r for r in self._results if metric in (r.metrics or {})]
+        if not scored:
+            raise ValueError(f"no trial reported {metric!r}")
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return max(scored, key=key) if mode == "max" else \
+            min(scored, key=key)
+
+    def get_dataframe(self):
+        import pandas as pd
+        return pd.DataFrame([{**r.config, **(r.metrics or {}),
+                              "trial_id": r.trial_id}
+                             for r in self._results])
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Any = None
+    metric: Optional[str] = None
+    mode: str = "max"
+    seed: Optional[int] = None
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *, param_space: Dict[str, Any],
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config=None,
+                 resources_per_trial: Optional[Dict[str, float]] = None):
+        self.trainable = trainable
+        self.param_space = param_space
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config
+        self.resources_per_trial = resources_per_trial or {"CPU": 1.0}
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        variants = BasicVariantGenerator(
+            self.param_space, num_samples=tc.num_samples,
+            seed=tc.seed).variants()
+        scheduler = tc.scheduler or FIFOScheduler()
+        max_conc = tc.max_concurrent_trials or max(
+            1, int(ray_tpu.cluster_resources().get("CPU", 1)) - 1)
+
+        actor_cls = ray_tpu.remote(TrialActor)
+        pending = [(f"trial_{i:05d}", cfg) for i, cfg in enumerate(variants)]
+        running: Dict[str, Dict] = {}
+        done: List[TrialResult] = []
+
+        while pending or running:
+            while pending and len(running) < max_conc:
+                trial_id, cfg = pending.pop(0)
+                actor = actor_cls.options(
+                    max_concurrency=2,
+                    resources=dict(self.resources_per_trial)).remote()
+                run_ref = actor.run.remote(self.trainable, cfg)
+                running[trial_id] = {"actor": actor, "config": cfg,
+                                     "run_ref": run_ref, "history": [],
+                                     "stopped": False}
+            time.sleep(0.15)
+            for trial_id, t in list(running.items()):
+                try:
+                    results = ray_tpu.get(t["actor"].poll.remote(),
+                                          timeout=30)
+                except Exception:
+                    results = []
+                decision = CONTINUE
+                for r in results:
+                    t["history"].append(r)
+                    d = scheduler.on_result(trial_id, r)
+                    if d == STOP:
+                        decision = STOP
+                if decision == STOP and not t["stopped"]:
+                    t["stopped"] = True
+                    ray_tpu.kill(t["actor"])
+                    done.append(self._finish(trial_id, t, None))
+                    del running[trial_id]
+                    continue
+                ready, _ = ray_tpu.wait([t["run_ref"]], timeout=0)
+                if ready:
+                    err = None
+                    try:
+                        ray_tpu.get(t["run_ref"], timeout=5)
+                    except Exception as e:
+                        err = str(e)
+                    # final drain
+                    try:
+                        for r in ray_tpu.get(t["actor"].poll.remote(),
+                                             timeout=10):
+                            t["history"].append(r)
+                    except Exception:
+                        pass
+                    done.append(self._finish(trial_id, t, err))
+                    del running[trial_id]
+        return ResultGrid(done)
+
+    def _finish(self, trial_id, t, err) -> TrialResult:
+        hist = t["history"]
+        return TrialResult(trial_id=trial_id, config=t["config"],
+                           metrics=hist[-1] if hist else {},
+                           history=hist, error=err)

@@ -1,0 +1,23 @@
+"""Test env: force an 8-device virtual CPU mesh (multi-chip sharding is
+tested hermetically on CPU — real TPU hardware is exercised by bench.py /
+__graft_entry__.py instead).
+
+Set via jax.config (not env vars): pytest plugins may import jax before this
+conftest runs, but the backend only initializes on first device use, so the
+config route still wins."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:   # backend already initialized (env vars took effect)
+    pass

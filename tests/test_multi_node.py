@@ -1,0 +1,181 @@
+"""Multi-node scheduling / placement-group / failover tests on a local
+multi-raylet cluster (reference: python/ray/tests/test_placement_group*.py,
+test_actor_failures.py over the ray_start_cluster fixture)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import (NodeAffinitySchedulingStrategy,
+                          PlacementGroupSchedulingStrategy,
+                          placement_group, remove_placement_group)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 2,
+                                "resources": {"head": 1.0}})
+    c.add_node(num_cpus=2, resources={"worker1": 1.0, "TPU": 4.0})
+    c.add_node(num_cpus=2, resources={"worker2": 1.0})
+    ray_tpu.init(address=c.address)
+    c.wait_for_nodes()
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_cluster_resources(cluster):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 6.0
+    assert total["TPU"] == 4.0
+
+
+def test_custom_resource_scheduling(cluster):
+    @ray_tpu.remote(resources={"worker2": 1.0}, num_cpus=1)
+    def where():
+        import ray_tpu
+        return ray_tpu.get_runtime_context()["node_id"]
+
+    node_id = ray_tpu.get(where.remote())
+    w2 = [n for n in ray_tpu.nodes() if "worker2" in n["total"]][0]
+    assert node_id == w2["node_id"]
+
+
+def test_tpu_resource_task(cluster):
+    @ray_tpu.remote(num_tpus=2)
+    def tpu_task():
+        import ray_tpu
+        return ray_tpu.get_runtime_context()["node_id"]
+
+    nid = ray_tpu.get(tpu_task.remote())
+    tpu_node = [n for n in ray_tpu.nodes() if "TPU" in n["total"]][0]
+    assert nid == tpu_node["node_id"]
+
+
+def test_node_affinity(cluster):
+    target = [n for n in ray_tpu.nodes() if "worker1" in n["total"]][0]
+
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=target["node_id"]))
+    def pinned():
+        import ray_tpu
+        return ray_tpu.get_runtime_context()["node_id"]
+
+    assert ray_tpu.get(pinned.remote()) == target["node_id"]
+
+
+def test_cross_node_object_transfer(cluster):
+    @ray_tpu.remote(resources={"worker1": 0.01})
+    def produce():
+        return np.arange(500_000, dtype=np.float32)
+
+    @ray_tpu.remote(resources={"worker2": 0.01})
+    def consume(arr):
+        return float(arr.sum())
+
+    ref = produce.remote()
+    total = ray_tpu.get(consume.remote(ref))
+    assert total == float(np.arange(500_000, dtype=np.float32).sum())
+    # driver-side get pulls to the driver's node too
+    arr = ray_tpu.get(ref)
+    assert arr.shape == (500_000,)
+
+
+def test_placement_group_spread(cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}, {"CPU": 1}],
+                         strategy="STRICT_SPREAD")
+    assert pg.wait(timeout=15)
+    nodes = pg.node_ids()
+    assert len(set(nodes)) == 3
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        import ray_tpu
+        return ray_tpu.get_runtime_context()["node_id"]
+
+    refs = [where.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            pg, placement_group_bundle_index=i)).remote()
+        for i in range(3)]
+    got = ray_tpu.get(refs)
+    assert got == nodes
+    remove_placement_group(pg)
+
+
+def test_placement_group_pack_actor(cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(timeout=15)
+
+    @ray_tpu.remote
+    class A:
+        def node(self):
+            import ray_tpu
+            return ray_tpu.get_runtime_context()["node_id"]
+
+    actors = [A.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            pg, placement_group_bundle_index=i)).remote()
+        for i in range(2)]
+    got = ray_tpu.get([a.node.remote() for a in actors])
+    assert got == pg.node_ids()
+    del actors
+    remove_placement_group(pg)
+
+
+def test_spillback_when_local_full(cluster):
+    """More parallel tasks than any single node's CPUs: they must land on
+    several nodes (hybrid policy spillback)."""
+    @ray_tpu.remote(num_cpus=1)
+    def hold():
+        import time
+        import ray_tpu
+        time.sleep(1.0)
+        return ray_tpu.get_runtime_context()["node_id"]
+
+    refs = [hold.remote() for _ in range(6)]
+    got = ray_tpu.get(refs, timeout=30)
+    assert len(set(got)) >= 2
+
+
+def test_node_death_actor_restart(cluster):
+    node = cluster.add_node(num_cpus=1, resources={"doomed": 1.0})
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(max_restarts=1, resources={"doomed": 0.01}, num_cpus=0.1)
+    class Pinned:
+        def node(self):
+            import ray_tpu
+            return ray_tpu.get_runtime_context()["node_id"]
+
+    # not enough "doomed" elsewhere → after node death actor must report DEAD
+    a = Pinned.remote()
+    assert ray_tpu.get(a.node.remote(), timeout=30) == node.node_id
+    cluster.remove_node(node)
+    time.sleep(6.5)   # heartbeat timeout
+    with pytest.raises((ray_tpu.ActorDiedError, TimeoutError)):
+        ray_tpu.get(a.node.remote(), timeout=15)
+
+
+def test_actor_restart_on_other_node(cluster):
+    node = cluster.add_node(num_cpus=1, resources={"flaky": 1.0})
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(max_restarts=2, num_cpus=0.5)
+    class Roamer:
+        def node(self):
+            import ray_tpu
+            return ray_tpu.get_runtime_context()["node_id"]
+
+    # schedule with affinity to the doomed node, soft so it can move
+    a = Roamer.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=node.node_id, soft=True)).remote()
+    first = ray_tpu.get(a.node.remote(), timeout=30)
+    assert first == node.node_id
+    cluster.remove_node(node)
+    time.sleep(6.5)
+    second = ray_tpu.get(a.node.remote(), timeout=30)
+    assert second != node.node_id

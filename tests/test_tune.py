@@ -1,0 +1,82 @@
+"""Tune tests: grid/random search, best-result selection, ASHA early
+stopping (reference: python/ray/tune/tests/test_tune_* shapes)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import ASHAScheduler, TuneConfig, Tuner, grid_search
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    ctx = ray_tpu.init(num_cpus=8, object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_grid_search(ray_start):
+    def trainable(config):
+        tune.report(score=config["a"] * 10 + config["b"])
+
+    tuner = Tuner(trainable,
+                  param_space={"a": grid_search([1, 2, 3]),
+                               "b": grid_search([0, 5])})
+    results = tuner.fit()
+    assert len(results) == 6
+    best = results.get_best_result("score", mode="max")
+    assert best.config == {"a": 3, "b": 5}
+    assert best.metrics["score"] == 35
+
+
+def test_random_search(ray_start):
+    def trainable(config):
+        tune.report(val=config["x"])
+
+    tuner = Tuner(trainable,
+                  param_space={"x": tune.uniform(0, 1)},
+                  tune_config=TuneConfig(num_samples=5, seed=42))
+    results = tuner.fit()
+    assert len(results) == 5
+    vals = [r.metrics["val"] for r in results]
+    assert all(0 <= v <= 1 for v in vals)
+    assert len(set(vals)) == 5
+
+
+def test_asha_early_stopping(ray_start):
+    def trainable(config):
+        for step in range(20):
+            # bad configs plateau low; good ones improve
+            tune.report(acc=config["lr"] * (step + 1))
+            time.sleep(0.02)
+
+    tuner = Tuner(
+        trainable,
+        param_space={"lr": grid_search([0.01, 0.02, 1.0, 2.0])},
+        tune_config=TuneConfig(
+            scheduler=ASHAScheduler(metric="acc", mode="max", max_t=20,
+                                    grace_period=4, reduction_factor=2)))
+    results = tuner.fit()
+    assert len(results) == 4
+    best = results.get_best_result("acc", mode="max")
+    assert best.config["lr"] == 2.0
+    # at least one poor trial stopped early
+    iters = {r.config["lr"]: len(r.history) for r in results}
+    assert min(iters.values()) < 20
+
+
+def test_trial_error_captured(ray_start):
+    def trainable(config):
+        if config["boom"]:
+            raise RuntimeError("exploded")
+        tune.report(ok=1)
+
+    tuner = Tuner(trainable,
+                  param_space={"boom": grid_search([False, True])})
+    results = tuner.fit()
+    errs = [r for r in results if r.error]
+    oks = [r for r in results if not r.error]
+    assert len(errs) == 1 and "exploded" in errs[0].error
+    assert len(oks) == 1 and oks[0].metrics["ok"] == 1
