@@ -70,6 +70,9 @@ def init(address: Optional[str] = None, *,
     from ray_tpu._private import worker as worker_mod
     from ray_tpu._private.worker import Worker
 
+    import os
+    if address is None:
+        address = os.environ.get("RAY_TPU_ADDRESS")
     with _ctx_lock:
         if _context is not None:
             if ignore_reinit_error:

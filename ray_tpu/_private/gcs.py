@@ -62,7 +62,11 @@ class GcsServer:
         self._next_job_id = 1
         self._death_checker: Optional[asyncio.Task] = None
         self._pending_actor_queue: List[str] = []
-        self.server = Server = None
+        # task-event sink: ring buffer of merged per-task rows (reference:
+        # GcsTaskManager, src/ray/gcs/gcs_server/gcs_task_manager.h:86)
+        self.task_events: Dict[str, Dict] = {}
+        self.max_task_events = 10000
+        self.server = None
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> str:
@@ -91,6 +95,8 @@ class GcsServer:
             "remove_placement_group": self.h_remove_placement_group,
             "get_placement_group": self.h_get_placement_group,
             "get_all_placement_groups": self.h_get_all_placement_groups,
+            "add_task_events": self.h_add_task_events,
+            "list_task_events": self.h_list_task_events,
             "ping": lambda conn: "pong",
         }
         self.server = rpc.Server(handlers, name="gcs")
@@ -378,6 +384,40 @@ class GcsServer:
             except (rpc.RpcError, rpc.ConnectionLost):
                 pass
         return True
+
+    # ---------------------------------------------------------- task events
+    def h_add_task_events(self, conn, events: List[Dict]):
+        for ev in events:
+            tid = ev["task_id"]
+            row = self.task_events.get(tid)
+            if row is None:
+                if len(self.task_events) >= self.max_task_events:
+                    # drop oldest (dict preserves insertion order)
+                    self.task_events.pop(next(iter(self.task_events)))
+                row = self.task_events[tid] = {"task_id": tid,
+                                               "state_times": {}}
+            order = {"PENDING": 0, "RUNNING": 1, "FINISHED": 2, "FAILED": 2}
+            for k, v in ev.items():
+                if k == "state":
+                    row["state_times"][v] = ev.get("ts", time.time())
+                    # events from caller and executor arrive out of order;
+                    # state only moves forward
+                    if order.get(v, 0) >= order.get(row.get("state"), -1):
+                        row["state"] = v
+                elif k != "ts":
+                    row[k] = v
+        return True
+
+    def h_list_task_events(self, conn, limit: int = 1000,
+                           job_id: Optional[int] = None):
+        out = []
+        for row in reversed(list(self.task_events.values())):
+            if job_id is not None and row.get("job_id") != job_id:
+                continue
+            out.append(row)
+            if len(out) >= limit:
+                break
+        return out
 
     # --------------------------------------------------------------- pubsub
     def h_subscribe(self, conn, channel: str):

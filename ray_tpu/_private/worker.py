@@ -192,6 +192,8 @@ class CoreWorker:
         self._exec_queue = asyncio.Queue()
         self._consumers = [asyncio.ensure_future(self._exec_consumer())]
         self._lease_reaper = asyncio.ensure_future(self._reap_leases())
+        self._task_events: List[Dict] = []
+        self._event_flusher = asyncio.ensure_future(self._flush_task_events())
         self._install_ref_hooks()
         self._subscribed_actor_channel = False
 
@@ -277,6 +279,25 @@ class CoreWorker:
                                         node_id=node_id)
         except Exception:
             pass
+
+    # ------------------------------------------------------------ task events
+    def _record_task_event(self, task_id: bytes, state: str, **extra):
+        """Buffered task state transitions, flushed to the GCS task-event
+        sink (reference: TaskEventBuffer,
+        src/ray/core_worker/task_event_buffer.h:220)."""
+        self._task_events.append({"task_id": task_id.hex(), "state": state,
+                                  "ts": time.time(), **extra})
+
+    async def _flush_task_events(self):
+        while not self._shutdown:
+            await asyncio.sleep(1.0)
+            if not self._task_events or self.gcs is None or self.gcs.closed:
+                continue
+            batch, self._task_events = self._task_events, []
+            try:
+                await self.gcs.notify("add_task_events", events=batch)
+            except Exception:
+                pass
 
     # -------------------------------------------------- ownership bookkeeping
     def _register_owned(self, oid: bytes, lineage=None, complete=False):
@@ -570,6 +591,8 @@ class CoreWorker:
             if e is not None:
                 e["submitted"] = e.get("submitted", 0) + 1
         self.pending_tasks[task_id] = pt
+        self._record_task_event(task_id, "PENDING", name=spec["name"],
+                                job_id=self.job_id, type="NORMAL_TASK")
         asyncio.ensure_future(self._run_task(pt, resources, scheduling or {}))
         return refs
 
@@ -606,6 +629,7 @@ class CoreWorker:
             self.pending_tasks.pop(pt.spec["task_id"], None)
 
     def _complete_task(self, pt: PendingTask, resp: Dict):
+        self._record_task_event(pt.spec["task_id"], "FINISHED")
         for rid, ret in zip(pt.return_ids, resp["returns"]):
             entry = self.owned.get(rid)
             if ret[0] == "wire":
@@ -622,6 +646,8 @@ class CoreWorker:
         self._unpin_args(pt)
 
     def _fail_task(self, pt: PendingTask, exc: BaseException):
+        self._record_task_event(pt.spec["task_id"], "FAILED",
+                                error=f"{type(exc).__name__}: {exc}")
         s = serialization.serialize_error(exc)
         kind, pkl, bufs = s.to_wire()
         for rid in pt.return_ids:
@@ -799,6 +825,9 @@ class CoreWorker:
             e = self.owned.get(r.id)
             if e is not None:
                 e["submitted"] = e.get("submitted", 0) + 1
+        self._record_task_event(task_id, "PENDING", name=method,
+                                job_id=self.job_id, type="ACTOR_TASK",
+                                actor_id=actor_id)
         st = await self._actor_state(actor_id)
         if st.sender is None:
             st.sender = asyncio.ensure_future(self._actor_sender(actor_id, st))
@@ -933,6 +962,11 @@ class CoreWorker:
             logger.exception("failed to set accelerator visibility")
 
     async def _execute(self, spec: Dict) -> Dict:
+        self._record_task_event(
+            spec["task_id"], "RUNNING", name=spec.get("name"),
+            job_id=spec.get("job_id"), node_id=self.node_id,
+            worker_id=self.worker_id,
+            type="ACTOR_TASK" if spec.get("actor_id") else "NORMAL_TASK")
         if not spec.get("actor_id"):
             # actor workers keep the mask set at become_actor for life
             self._apply_accelerator_ids(spec)
@@ -940,8 +974,13 @@ class CoreWorker:
         if spec.get("actor_id"):
             if self.actor_instance is None:
                 raise RuntimeError("actor task on non-actor worker")
-            method = getattr(self.actor_instance, spec["method"])
-            fn = method
+            if spec["method"] == "__rt_dag_loop__":
+                # compiled-DAG execution loop (ray_tpu.dag.compiled)
+                from ray_tpu.dag.compiled import _dag_actor_loop
+                import functools
+                fn = functools.partial(_dag_actor_loop, self.actor_instance)
+            else:
+                fn = getattr(self.actor_instance, spec["method"])
         else:
             fn = await self._load_function(spec["func_id"])
         self.current_task_name = spec["name"]

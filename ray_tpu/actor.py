@@ -24,6 +24,11 @@ class ActorMethod:
         return self._handle._invoke(self._name, args, kwargs,
                                     self._handle._options)
 
+    def bind(self, *args, **kwargs):
+        """Capture a compiled-DAG node (reference: dag class_node bind)."""
+        from ray_tpu.dag.nodes import ClassMethodNode
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
+
     def options(self, **opts):
         method = ActorMethod(self._handle, self._name)
         method._call_options = opts

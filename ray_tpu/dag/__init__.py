@@ -1,0 +1,6 @@
+from ray_tpu.dag.nodes import (ClassMethodNode, DAGNode, InputNode,
+                               MultiOutputNode)
+from ray_tpu.dag.compiled import CompiledDAG
+
+__all__ = ["InputNode", "DAGNode", "ClassMethodNode", "MultiOutputNode",
+           "CompiledDAG"]

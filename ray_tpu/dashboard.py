@@ -1,0 +1,142 @@
+"""Dashboard: REST API over cluster state + jobs (reference:
+python/ray/dashboard/head.py — aiohttp REST; the web UI is not replicated,
+the API surface is). Runs as an actor on the cluster."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Dict, Optional
+
+DASHBOARD_NAME = "_DASHBOARD"
+
+
+class DashboardServer:
+    def __init__(self, port: int = 8265):
+        self.port = port
+        self._ready = False
+        from ray_tpu._private.worker import global_worker
+        asyncio.run_coroutine_threadsafe(
+            self._start(), global_worker.core.loop).result(timeout=30)
+
+    async def _start(self):
+        from aiohttp import web
+
+        app = web.Application()
+        r = app.router
+        r.add_get("/api/cluster_status", self._cluster_status)
+        r.add_get("/api/nodes", self._nodes)
+        r.add_get("/api/actors", self._actors)
+        r.add_get("/api/tasks", self._tasks)
+        r.add_get("/api/placement_groups", self._pgs)
+        r.add_get("/api/jobs", self._jobs)
+        r.add_post("/api/jobs", self._submit_job)
+        r.add_get("/api/jobs/{job_id}", self._job_status)
+        r.add_get("/api/jobs/{job_id}/logs", self._job_logs)
+        r.add_post("/api/jobs/{job_id}/stop", self._job_stop)
+        r.add_get("/api/version", self._version)
+        r.add_get("/healthz", self._healthz)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "0.0.0.0", self.port)
+        await site.start()
+        self._ready = True
+
+    def ready(self):
+        return self._ready
+
+    async def _in_thread(self, fn, *args):
+        return await asyncio.get_event_loop().run_in_executor(
+            None, fn, *args)
+
+    async def _healthz(self, request):
+        from aiohttp import web
+        return web.Response(text="ok")
+
+    async def _version(self, request):
+        from aiohttp import web
+        import ray_tpu
+        return web.json_response({"version": ray_tpu.__version__})
+
+    async def _cluster_status(self, request):
+        from aiohttp import web
+        from ray_tpu.util import state
+        return web.json_response(
+            await self._in_thread(state.cluster_summary))
+
+    async def _nodes(self, request):
+        from aiohttp import web
+        from ray_tpu.util import state
+        return web.json_response(await self._in_thread(state.list_nodes))
+
+    async def _actors(self, request):
+        from aiohttp import web
+        from ray_tpu.util import state
+        return web.json_response(await self._in_thread(state.list_actors))
+
+    async def _tasks(self, request):
+        from aiohttp import web
+        from ray_tpu.util import state
+        return web.json_response(await self._in_thread(state.list_tasks))
+
+    async def _pgs(self, request):
+        from aiohttp import web
+        from ray_tpu.util import state
+        return web.json_response(
+            await self._in_thread(state.list_placement_groups))
+
+    def _client(self):
+        from ray_tpu.job_submission import JobSubmissionClient
+        return JobSubmissionClient()
+
+    async def _jobs(self, request):
+        from aiohttp import web
+        return web.json_response(
+            await self._in_thread(lambda: self._client().list_jobs()))
+
+    async def _submit_job(self, request):
+        from aiohttp import web
+        body = await request.json()
+        job_id = await self._in_thread(
+            lambda: self._client().submit_job(
+                entrypoint=body["entrypoint"],
+                runtime_env=body.get("runtime_env"),
+                metadata=body.get("metadata")))
+        return web.json_response({"job_id": job_id})
+
+    async def _job_status(self, request):
+        from aiohttp import web
+        job_id = request.match_info["job_id"]
+        info = await self._in_thread(
+            lambda: self._client().get_job_info(job_id))
+        if info is None:
+            return web.Response(status=404)
+        return web.json_response(info)
+
+    async def _job_logs(self, request):
+        from aiohttp import web
+        job_id = request.match_info["job_id"]
+        logs = await self._in_thread(
+            lambda: self._client().get_job_logs(job_id))
+        return web.json_response({"logs": logs})
+
+    async def _job_stop(self, request):
+        from aiohttp import web
+        job_id = request.match_info["job_id"]
+        ok = await self._in_thread(
+            lambda: self._client().stop_job(job_id))
+        return web.json_response({"stopped": ok})
+
+
+def start_dashboard(port: int = 8265):
+    """Start (or find) the dashboard actor; returns its handle."""
+    import ray_tpu
+    try:
+        return ray_tpu.get_actor(DASHBOARD_NAME, namespace="_internal")
+    except ValueError:
+        cls = ray_tpu.remote(DashboardServer)
+        h = cls.options(name=DASHBOARD_NAME, namespace="_internal",
+                        lifetime="detached", max_concurrency=16,
+                        num_cpus=0.1).remote(port)
+        ray_tpu.get(h.ready.remote(), timeout=60)
+        return h

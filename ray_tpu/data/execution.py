@@ -25,27 +25,30 @@ DEFAULT_MAX_IN_FLIGHT = 8
 
 def _map_block_remote(fn_kind: str, fn, block, batch_format: str,
                       fn_args, fn_kwargs):
-    """Runs inside a worker: apply one transform to one block."""
-    import numpy as np
+    """Runs inside a worker: apply one transform to one block.
+    Returns (block, metadata) — the block stays in the executing node's
+    store; the driver only reads the metadata."""
     from ray_tpu.data import block as B
     if fn_kind == "map_batches":
         batch = B.block_to_batch(block, batch_format)
         out = fn(batch, *fn_args, **(fn_kwargs or {}))
-        return B.block_from_batch(out)
-    if fn_kind == "map":
-        rows = [fn(r, *fn_args, **(fn_kwargs or {}))
-                for r in B.block_to_rows(block)]
-        return B.block_from_rows(rows)
-    if fn_kind == "filter":
-        rows = [r for r in B.block_to_rows(block)
-                if fn(r, *fn_args, **(fn_kwargs or {}))]
-        return B.block_from_rows(rows)
-    if fn_kind == "flat_map":
+        out_block = B.block_from_batch(out)
+    elif fn_kind == "map":
+        out_block = B.block_from_rows(
+            [fn(r, *fn_args, **(fn_kwargs or {}))
+             for r in B.block_to_rows(block)])
+    elif fn_kind == "filter":
+        out_block = B.block_from_rows(
+            [r for r in B.block_to_rows(block)
+             if fn(r, *fn_args, **(fn_kwargs or {}))])
+    elif fn_kind == "flat_map":
         rows = []
         for r in B.block_to_rows(block):
             rows.extend(fn(r, *fn_args, **(fn_kwargs or {})))
-        return B.block_from_rows(rows)
-    raise ValueError(fn_kind)
+        out_block = B.block_from_rows(rows)
+    else:
+        raise ValueError(fn_kind)
+    return out_block, B.block_metadata(out_block)
 
 
 class Stage:
@@ -73,7 +76,9 @@ class ReadStage(Stage):
                               or DEFAULT_MAX_IN_FLIGHT)
 
     def execute(self, upstream):
-        remote_read = ray_tpu.remote(
+        # two returns: the block ref is yielded WITHOUT fetching its bytes
+        # to the driver; only the small metadata ref is materialized
+        remote_read = ray_tpu.remote(num_returns=2)(
             lambda fn: _with_meta(fn()))
         window = collections.deque()
         fns = iter(self.read_fns)
@@ -87,10 +92,8 @@ class ReadStage(Stage):
                 window.append(remote_read.remote(fn))
             if not window:
                 return
-            ref = window.popleft()
-            block, meta = ray_tpu.get(ref)
-            blk_ref = ray_tpu.put(block)
-            yield (blk_ref, meta)
+            block_ref, meta_ref = window.popleft()
+            yield (block_ref, ray_tpu.get(meta_ref))
 
 
 def _with_meta(block):
@@ -110,7 +113,7 @@ class MapStage(Stage):
                               or DEFAULT_MAX_IN_FLIGHT)
 
     def execute(self, upstream):
-        remote_map = ray_tpu.remote(_map_block_remote)
+        remote_map = ray_tpu.remote(num_returns=2)(_map_block_remote)
         window = collections.deque()
         upstream = iter(upstream)
         exhausted = False
@@ -126,11 +129,10 @@ class MapStage(Stage):
                     self.fn_args, self.fn_kwargs))
             if not window:
                 return
-            out_ref = window.popleft()
-            # block until this output is ready (keeps order; later tasks
-            # keep running in the window)
-            block = ray_tpu.get(out_ref)
-            yield (ray_tpu.put(block), block_lib.block_metadata(block))
+            block_ref, meta_ref = window.popleft()
+            # block until this output's metadata is ready (keeps order;
+            # later tasks keep running in the window); bytes stay put
+            yield (block_ref, ray_tpu.get(meta_ref))
 
 
 class AllToAllStage(Stage):

@@ -1,0 +1,3 @@
+from ray_tpu.experimental.channel import Channel, ReaderView
+
+__all__ = ["Channel", "ReaderView"]

@@ -17,6 +17,7 @@ _LOCK = threading.Lock()
 
 _SOURCES = {
     "shm_store": ["shm_store.cpp"],
+    "mutable_channel": ["mutable_channel.cpp"],
 }
 
 

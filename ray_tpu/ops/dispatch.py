@@ -24,33 +24,43 @@ from ray_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR
 def attention(q, k, v, causal: bool = True, impl: str = "auto"):
     """q[B,L,H,D], k/v[B,L,Hkv,D] — global (logical) shapes."""
     mesh = mesh_lib.current_mesh()
+    multi = mesh is not None and mesh.size > 1
+    seq_sharded = multi and mesh.shape[AXIS_SEQ] > 1
     if impl == "auto":
-        if mesh is not None and mesh.size > 1:
+        if seq_sharded:
             impl = "ring"
+        elif multi:
+            impl = "sharded_local"   # per-shard flash/ref under shard_map
         elif jax.default_backend() == "tpu":
             impl = "flash"
         else:
             impl = "reference"
-    if impl == "ring":
+    if impl in ("ring", "sharded_local"):
         if mesh is None:
-            raise ValueError("ring attention needs a mesh (use_mesh(...))")
+            raise ValueError("sharded attention needs a mesh (use_mesh(...))")
         B, L, H, D = q.shape
         Hkv = k.shape[2]
         t = mesh.shape[AXIS_TENSOR]
         s = mesh.shape[AXIS_SEQ]
         bsz = mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
-        if L % s != 0:
+        if impl == "ring" and L % s != 0:
             return mha_reference(q, k, v, causal=causal)
         batch_ax = (AXIS_DATA, AXIS_FSDP) if B % bsz == 0 else None
         # heads shard over tensor only when q AND kv head counts divide it
         # (keeps the GQA repeat factor consistent per shard)
         head_ax = AXIS_TENSOR if (H % t == 0 and Hkv % t == 0) else None
-        spec = P(batch_ax, AXIS_SEQ, head_ax, None)
-        fn = shard_map(
-            functools.partial(ring_attention, axis_name=AXIS_SEQ,
-                              causal=causal),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_rep=False)
+        if impl == "ring":
+            spec = P(batch_ax, AXIS_SEQ, head_ax, None)
+            body = functools.partial(ring_attention, axis_name=AXIS_SEQ,
+                                     causal=causal)
+        else:
+            # seq axis unsharded: each (batch, head) shard holds the full
+            # sequence — run the flash kernel (or XLA ref on CPU) locally;
+            # pallas can't be auto-partitioned by GSPMD, hence shard_map
+            spec = P(batch_ax, None, head_ax, None)
+            body = functools.partial(flash_attention, causal=causal)
+        fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_rep=False)
         return fn(q, k, v)
     if impl == "flash":
         return flash_attention(q, k, v, causal=causal)

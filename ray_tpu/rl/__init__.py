@@ -1,0 +1,4 @@
+from ray_tpu.rl.algorithm import PPO, Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+
+__all__ = ["Algorithm", "PPO", "AlgorithmConfig"]

@@ -1,0 +1,92 @@
+"""Algorithm: the RL training driver (reference:
+rllib/algorithms/algorithm.py:228 — step() :881; PPO training_step
+rllib/algorithms/ppo/ppo.py:403: parallel EnvRunner.sample() →
+LearnerGroup.update → weight sync → metrics)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rl.config import AlgorithmConfig
+
+
+class Algorithm:
+    def __init__(self, config: AlgorithmConfig):
+        import gymnasium as gym
+        import ray_tpu
+        from ray_tpu.rl.env_runner import EnvRunner
+        from ray_tpu.rl.learner import LearnerGroup
+
+        self.config = config
+        probe = gym.make(config.env, **config.env_config)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        action_dim = probe.action_space.n
+        probe.close()
+
+        cfg_dict = dataclasses.asdict(config)
+        runner_cls = ray_tpu.remote(EnvRunner)
+        self.env_runners = [
+            runner_cls.remote({**cfg_dict, "runner_index": i})
+            for i in range(config.num_env_runners)]
+        self.learner_group = LearnerGroup(cfg_dict, obs_dim, action_dim)
+        self.iteration = 0
+        self._sync_weights()
+
+    def _sync_weights(self):
+        import ray_tpu
+        weights_ref = ray_tpu.put(self.learner_group.get_weights())
+        ray_tpu.get([r.set_weights.remote(weights_ref)
+                     for r in self.env_runners], timeout=300)
+
+    def training_step(self) -> Dict:
+        import ray_tpu
+        t0 = time.perf_counter()
+        batches = ray_tpu.get(
+            [r.sample.remote() for r in self.env_runners], timeout=600)
+        sample_time = time.perf_counter() - t0
+        batch = {k: np.concatenate([b[k] for b in batches])
+                 for k in batches[0]}
+        t1 = time.perf_counter()
+        learn_metrics = self.learner_group.update_from_batch(batch)
+        learn_time = time.perf_counter() - t1
+        self._sync_weights()
+        runner_metrics = ray_tpu.get(
+            [r.get_metrics.remote() for r in self.env_runners], timeout=120)
+        returns = [m["episode_return_mean"] for m in runner_metrics
+                   if m["episode_return_mean"] is not None]
+        steps = len(batch["obs"])
+        return {
+            "episode_return_mean":
+                float(np.mean(returns)) if returns else None,
+            "num_env_steps_sampled": steps,
+            "env_steps_per_s": steps / max(1e-9, sample_time),
+            "sample_time_s": sample_time,
+            "learn_time_s": learn_time,
+            **learn_metrics,
+        }
+
+    def train(self) -> Dict:
+        self.iteration += 1
+        out = self.training_step()
+        out["training_iteration"] = self.iteration
+        return out
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def stop(self):
+        import ray_tpu
+        for r in self.env_runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self.env_runners = []
+
+
+class PPO(Algorithm):
+    """Clipped-surrogate PPO with GAE (the loss lives in JaxLearner)."""

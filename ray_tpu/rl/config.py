@@ -1,0 +1,64 @@
+"""AlgorithmConfig: fluent builder (reference:
+rllib/algorithms/algorithm_config.py — .environment().env_runners()
+.training() chaining, new API stack)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class AlgorithmConfig:
+    env: Optional[str] = None
+    env_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    num_env_runners: int = 2
+    num_envs_per_env_runner: int = 4
+    rollout_fragment_length: int = 64
+    train_batch_size: int = 512
+    minibatch_size: int = 128
+    num_epochs: int = 4
+    lr: float = 3e-4
+    gamma: float = 0.99
+    lambda_: float = 0.95
+    clip_param: float = 0.2
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    grad_clip: float = 0.5
+    hidden_sizes: tuple = (64, 64)
+    num_learners: int = 1
+    seed: int = 0
+
+    # fluent builder API (reference: AlgorithmConfig chaining)
+    def environment(self, env: str, env_config: Optional[Dict] = None):
+        self.env = env
+        if env_config is not None:
+            self.env_config = env_config
+        return self
+
+    def env_runners(self, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def learners(self, num_learners: Optional[int] = None):
+        if num_learners is not None:
+            self.num_learners = num_learners
+        return self
+
+    def build(self):
+        from ray_tpu.rl.algorithm import PPO
+        return PPO(self)

@@ -1,0 +1,102 @@
+"""EnvRunner: actor sampling episodes from gymnasium vector envs
+(reference: rllib/env/single_agent_env_runner.py:63 — sample :133; module
+forward for action selection runs inside the runner; GAE advantages are
+computed here at fragment end so the learner gets ready batches, the role
+the reference's learner connector pipeline plays)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class EnvRunner:
+    def __init__(self, config: Dict):
+        import gymnasium as gym
+        import jax
+        self.cfg = config
+        self.n_envs = config["num_envs_per_env_runner"]
+        self.envs = gym.vector.SyncVectorEnv(
+            [lambda: gym.make(config["env"], **config.get("env_config", {}))
+             for _ in range(self.n_envs)])
+        obs_dim = int(np.prod(self.envs.single_observation_space.shape))
+        action_dim = self.envs.single_action_space.n
+        from ray_tpu.rl.rl_module import DiscreteRLModule
+        self.module = DiscreteRLModule(obs_dim, action_dim,
+                                       config.get("hidden_sizes", (64, 64)),
+                                       seed=config.get("seed", 0))
+        self.rng = jax.random.PRNGKey(config.get("seed", 0)
+                                      + config.get("runner_index", 0) * 1000)
+        self.obs, _ = self.envs.reset(seed=config.get("seed", 0)
+                                      + config.get("runner_index", 0))
+        self.gamma = config["gamma"]
+        self.lam = config["lambda_"]
+        self._episode_returns = []
+        self._running_returns = np.zeros(self.n_envs)
+
+    def set_weights(self, weights):
+        self.module.set_weights(weights)
+        return True
+
+    def sample(self, num_steps: Optional[int] = None) -> Dict[str, np.ndarray]:
+        """Collect a fragment of num_steps per env; returns flat batch with
+        GAE advantages and value targets."""
+        import jax
+        T = num_steps or self.cfg["rollout_fragment_length"]
+        N = self.n_envs
+        obs_buf = np.zeros((T, N) + self.obs.shape[1:], np.float32)
+        act_buf = np.zeros((T, N), np.int64)
+        logp_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+
+        obs = self.obs
+        for t in range(T):
+            self.rng, key = jax.random.split(self.rng)
+            action, logp, value = self.module.sample_actions(
+                self.module.params, obs.astype(np.float32), key)
+            nxt, rew, term, trunc, _ = self.envs.step(action)
+            done = np.logical_or(term, trunc)
+            obs_buf[t] = obs
+            act_buf[t] = action
+            logp_buf[t] = logp
+            rew_buf[t] = rew
+            done_buf[t] = done.astype(np.float32)
+            val_buf[t] = value
+            self._running_returns += rew
+            for i, d in enumerate(done):
+                if d:
+                    self._episode_returns.append(self._running_returns[i])
+                    self._running_returns[i] = 0.0
+            obs = nxt
+        self.obs = obs
+
+        # bootstrap value for the final obs
+        _, last_val = self.module.forward(self.module.params,
+                                          obs.astype(np.float32))
+        last_val = np.asarray(last_val)
+        adv = np.zeros((T, N), np.float32)
+        lastgaelam = np.zeros(N, np.float32)
+        for t in reversed(range(T)):
+            nonterminal = 1.0 - done_buf[t]
+            next_value = val_buf[t + 1] if t + 1 < T else last_val
+            delta = rew_buf[t] + self.gamma * next_value * nonterminal \
+                - val_buf[t]
+            lastgaelam = delta + self.gamma * self.lam * nonterminal \
+                * lastgaelam
+            adv[t] = lastgaelam
+        targets = adv + val_buf
+
+        flat = lambda a: a.reshape((T * N,) + a.shape[2:])  # noqa: E731
+        return {"obs": flat(obs_buf), "actions": flat(act_buf),
+                "logp": flat(logp_buf), "advantages": flat(adv),
+                "value_targets": flat(targets)}
+
+    def get_metrics(self) -> Dict:
+        out = {"episode_return_mean":
+               float(np.mean(self._episode_returns[-20:]))
+               if self._episode_returns else None,
+               "num_episodes": len(self._episode_returns)}
+        return out

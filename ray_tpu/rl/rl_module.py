@@ -1,0 +1,60 @@
+"""RLModule: the framework-agnostic model API, jax/flax implementation
+(reference: rllib/core/rl_module/ — here a flax policy+value module with
+pure-function forward passes so env runners and learners share one
+parameter pytree)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+class PolicyValueNet(nn.Module):
+    action_dim: int
+    hidden_sizes: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for h in self.hidden_sizes:
+            x = nn.tanh(nn.Dense(h)(x))
+        logits = nn.Dense(self.action_dim)(x)
+        v = x
+        for h in self.hidden_sizes:
+            v = nn.tanh(nn.Dense(h)(v))
+        value = nn.Dense(1)(v)[..., 0]
+        return logits, value
+
+
+class DiscreteRLModule:
+    """Policy/value module for discrete action spaces."""
+
+    def __init__(self, obs_dim: int, action_dim: int,
+                 hidden_sizes: Sequence[int] = (64, 64), seed: int = 0):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.net = PolicyValueNet(action_dim, tuple(hidden_sizes))
+        self.params = self.net.init(
+            jax.random.PRNGKey(seed), jnp.zeros((1, obs_dim)))["params"]
+        self._forward = jax.jit(
+            lambda p, o: self.net.apply({"params": p}, o))
+
+    def forward(self, params, obs):
+        return self._forward(params, obs)
+
+    def sample_actions(self, params, obs, rng):
+        logits, value = self._forward(params, obs)
+        action = jax.random.categorical(rng, logits)
+        logp = jax.nn.log_softmax(logits)
+        logp_a = jnp.take_along_axis(logp, action[:, None], axis=1)[:, 0]
+        return (np.asarray(action), np.asarray(logp_a), np.asarray(value))
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.device_put(weights)

@@ -1,0 +1,157 @@
+"""CLI: `python -m ray_tpu.scripts.cli <cmd>` (reference:
+python/ray/scripts/scripts.py — ray start/stop/status/submit/list)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+HEAD_FILE = "/tmp/raytpu/latest_head.json"
+
+
+def _save_head(info):
+    os.makedirs(os.path.dirname(HEAD_FILE), exist_ok=True)
+    with open(HEAD_FILE, "w") as f:
+        json.dump(info, f)
+
+
+def _load_address(args) -> str:
+    addr = getattr(args, "address", None) or os.environ.get("RAY_TPU_ADDRESS")
+    if addr:
+        return addr
+    try:
+        with open(HEAD_FILE) as f:
+            return json.load(f)["gcs_address"]
+    except OSError:
+        print("no running cluster found (ray_tpu start --head first)",
+              file=sys.stderr)
+        sys.exit(1)
+
+
+def cmd_start(args):
+    from ray_tpu._private import node as node_mod
+    if args.head:
+        head = node_mod.start_head(
+            num_cpus=args.num_cpus,
+            resources=json.loads(args.resources),
+            object_store_memory=args.object_store_memory or None)
+        _save_head({"gcs_address": head.gcs_address,
+                    "node_id": head.node_id,
+                    "session": head.session_name})
+        print(f"head started; GCS at {head.gcs_address}")
+        print(f"connect with: ray_tpu.init(address={head.gcs_address!r})")
+        if args.dashboard:
+            import ray_tpu
+            from ray_tpu.dashboard import start_dashboard
+            ray_tpu.init(address=head.gcs_address)
+            start_dashboard(args.dashboard_port)
+            print(f"dashboard at http://127.0.0.1:{args.dashboard_port}")
+    else:
+        addr = _load_address(args)
+        node = node_mod.start_node(
+            addr, num_cpus=args.num_cpus,
+            resources=json.loads(args.resources),
+            object_store_memory=args.object_store_memory or None)
+        print(f"node {node.node_id[:12]} joined {addr}")
+
+
+def cmd_stop(args):
+    import signal
+    import subprocess
+    out = subprocess.run(["ps", "-eo", "pid,args"], capture_output=True,
+                         text=True).stdout
+    killed = 0
+    for line in out.splitlines():
+        parts = line.split(None, 1)
+        if len(parts) != 2:
+            continue
+        pid, cmd = parts
+        if ("ray_tpu._private.gcs" in cmd
+                or "ray_tpu._private.node_manager" in cmd
+                or "ray_tpu._private.worker_main" in cmd):
+            try:
+                os.kill(int(pid), signal.SIGTERM)
+                killed += 1
+            except OSError:
+                pass
+    print(f"stopped {killed} processes")
+    try:
+        os.unlink(HEAD_FILE)
+    except OSError:
+        pass
+
+
+def cmd_status(args):
+    import ray_tpu
+    from ray_tpu.util import state
+    ray_tpu.init(address=_load_address(args))
+    print(json.dumps(state.cluster_summary(), indent=2, default=str))
+
+
+def cmd_list(args):
+    import ray_tpu
+    from ray_tpu.util import state
+    ray_tpu.init(address=_load_address(args))
+    fn = {"nodes": state.list_nodes, "actors": state.list_actors,
+          "tasks": state.list_tasks, "jobs": state.list_jobs,
+          "placement-groups": state.list_placement_groups}[args.kind]
+    print(json.dumps(fn(), indent=2, default=str))
+
+
+def cmd_submit(args):
+    import ray_tpu
+    from ray_tpu.job_submission import JobSubmissionClient
+    ray_tpu.init(address=_load_address(args))
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=" ".join(args.entrypoint))
+    print(f"submitted {job_id}")
+    if args.wait:
+        status = client.wait_until_finished(job_id, timeout=args.timeout)
+        print(client.get_job_logs(job_id))
+        print(f"job {job_id}: {status}")
+        sys.exit(0 if status == "SUCCEEDED" else 1)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ps = sub.add_parser("start")
+    ps.add_argument("--head", action="store_true")
+    ps.add_argument("--address", default=None)
+    ps.add_argument("--num-cpus", type=float, default=None)
+    ps.add_argument("--resources", default="{}")
+    ps.add_argument("--object-store-memory", type=int, default=0)
+    ps.add_argument("--dashboard", action="store_true")
+    ps.add_argument("--dashboard-port", type=int, default=8265)
+    ps.set_defaults(fn=cmd_start)
+
+    pstop = sub.add_parser("stop")
+    pstop.set_defaults(fn=cmd_stop)
+
+    pst = sub.add_parser("status")
+    pst.add_argument("--address", default=None)
+    pst.set_defaults(fn=cmd_status)
+
+    pl = sub.add_parser("list")
+    pl.add_argument("kind", choices=["nodes", "actors", "tasks", "jobs",
+                                     "placement-groups"])
+    pl.add_argument("--address", default=None)
+    pl.set_defaults(fn=cmd_list)
+
+    pj = sub.add_parser("submit")
+    pj.add_argument("--address", default=None)
+    pj.add_argument("--wait", action="store_true")
+    pj.add_argument("--timeout", type=float, default=600)
+    pj.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    pj.set_defaults(fn=cmd_submit)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+from ray_tpu.serve.api import (batch, deployment, get_app_handle, run,
+                               shutdown, status)
+from ray_tpu.serve.deployment import Application, Deployment
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+__all__ = ["deployment", "run", "shutdown", "status", "batch",
+           "get_app_handle", "Deployment", "Application",
+           "DeploymentHandle", "DeploymentResponse"]

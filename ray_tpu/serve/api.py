@@ -1,0 +1,217 @@
+"""serve public API (reference: python/ray/serve/api.py — @deployment :240,
+run :463; batching: python/ray/serve/batching.py)."""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.deployment import (Application, AutoscalingConfig,
+                                      Deployment, DeploymentConfig)
+from ray_tpu.serve.handle import DeploymentHandle
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+_controller_handle = None
+_proxy_handle = None
+
+
+def deployment(_func_or_class=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_ongoing_requests: int = 16,
+               ray_actor_options: Optional[Dict] = None,
+               autoscaling_config=None, **_ignored):
+    def wrap(target):
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            ray_actor_options=ray_actor_options)
+        if autoscaling_config is not None:
+            cfg.autoscaling_config = (
+                AutoscalingConfig(**autoscaling_config)
+                if isinstance(autoscaling_config, dict)
+                else autoscaling_config)
+        return Deployment(target, name or target.__name__, cfg)
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap
+
+
+def _get_controller():
+    global _controller_handle
+    if _controller_handle is not None:
+        return _controller_handle
+    try:
+        _controller_handle = ray_tpu.get_actor(CONTROLLER_NAME,
+                                               namespace="serve")
+    except ValueError:
+        from ray_tpu.serve.controller import ServeController
+        actor_cls = ray_tpu.remote(ServeController)
+        _controller_handle = actor_cls.options(
+            name=CONTROLLER_NAME, namespace="serve", lifetime="detached",
+            max_concurrency=8, num_cpus=0.1).remote()
+    return _controller_handle
+
+
+def _app_to_specs(app: Application, app_name: str) -> List[Dict]:
+    import cloudpickle
+    import dataclasses
+    specs = []
+    for node in app.flatten():
+        dep = node.deployment
+        cfg = dataclasses.asdict(dep.config)
+
+        def materialize(v):
+            if isinstance(v, Application):
+                return DeploymentHandle(v.deployment.name, app_name)
+            return v
+
+        specs.append({
+            "name": dep.name,
+            "callable": cloudpickle.dumps(dep.func_or_class),
+            "is_function": not isinstance(dep.func_or_class, type),
+            "init_args": [materialize(a) for a in node.args],
+            "init_kwargs": {k: materialize(v)
+                            for k, v in node.kwargs.items()},
+            "config": cfg,
+        })
+    return specs
+
+
+_ingress: Dict[str, str] = {}          # app_name -> ingress deployment
+_routes: Dict[str, str] = {}           # route_prefix -> app_name
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = "/", blocking: bool = False,
+        _http: bool = False, http_port: int = 8000) -> DeploymentHandle:
+    controller = _get_controller()
+    specs = _app_to_specs(app, name)
+    ray_tpu.get(controller.deploy_application.remote(name, specs),
+                timeout=120)
+    _ingress[name] = app.deployment.name
+    if route_prefix:
+        _routes[route_prefix] = name
+    handle = DeploymentHandle(app.deployment.name, name)
+    # wait for replicas
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        st = ray_tpu.get(controller.get_status.remote(), timeout=30)
+        dep = st.get(name, {}).get(app.deployment.name, {})
+        if dep.get("running", 0) >= 1:
+            break
+        time.sleep(0.2)
+    if _http:
+        _ensure_proxy(http_port)
+    return handle
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    ingress = _ingress.get(name)
+    if ingress is None:
+        st = status()
+        deps = st.get(name)
+        if not deps:
+            raise ValueError(f"no application {name!r}")
+        ingress = list(deps)[0]
+    return DeploymentHandle(ingress, name)
+
+
+def status() -> Dict:
+    return ray_tpu.get(_get_controller().get_status.remote(), timeout=30)
+
+
+def delete(name: str = "default"):
+    ray_tpu.get(_get_controller().delete_application.remote(name),
+                timeout=60)
+
+
+def shutdown():
+    global _controller_handle, _proxy_handle
+    try:
+        if _proxy_handle is not None:
+            ray_tpu.kill(_proxy_handle)
+    except Exception:
+        pass
+    try:
+        ctrl = _get_controller()
+        for app in ray_tpu.get(ctrl.list_applications.remote(), timeout=30):
+            ray_tpu.get(ctrl.delete_application.remote(app), timeout=60)
+        ray_tpu.kill(ctrl)
+    except Exception:
+        pass
+    _controller_handle = None
+    _proxy_handle = None
+    _ingress.clear()
+    _routes.clear()
+
+
+def _ensure_proxy(port: int):
+    global _proxy_handle
+    if _proxy_handle is not None:
+        return
+    from ray_tpu.serve.proxy import HttpProxy
+    actor_cls = ray_tpu.remote(HttpProxy)
+    _proxy_handle = actor_cls.options(
+        name="SERVE_PROXY", namespace="serve", max_concurrency=64,
+        num_cpus=0.1).remote(port, dict(_routes), dict(_ingress))
+    ray_tpu.get(_proxy_handle.ready.remote(), timeout=60)
+
+
+# ------------------------------------------------------------------ batching
+def batch(_func=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Dynamic request batching for async methods (reference:
+    python/ray/serve/batching.py @serve.batch). Calls buffer until the
+    batch fills or the wait timeout fires, then the wrapped function runs
+    once on the list of requests."""
+
+    def wrap(fn):
+        state = {"queue": None, "task": None}
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            self_arg = args[0] if len(args) == 2 else None
+            item = args[-1]
+            loop = asyncio.get_event_loop()
+            if state["queue"] is None:
+                state["queue"] = []
+                state["cond"] = asyncio.Condition()
+
+            fut = loop.create_future()
+            state["queue"].append((item, fut))
+            if state["task"] is None or state["task"].done():
+                state["task"] = asyncio.ensure_future(
+                    _flusher(self_arg, fn, state, max_batch_size,
+                             batch_wait_timeout_s))
+            return await fut
+
+        return wrapper
+
+    if _func is not None:
+        return wrap(_func)
+    return wrap
+
+
+async def _flusher(self_arg, fn, state, max_batch_size, wait_s):
+    await asyncio.sleep(wait_s)
+    while state["queue"]:
+        batch_items = state["queue"][:max_batch_size]
+        del state["queue"][:max_batch_size]
+        items = [b[0] for b in batch_items]
+        futs = [b[1] for b in batch_items]
+        try:
+            if self_arg is not None:
+                results = await fn(self_arg, items)
+            else:
+                results = await fn(items)
+            for f, r in zip(futs, results):
+                if not f.done():
+                    f.set_result(r)
+        except Exception as e:
+            for f in futs:
+                if not f.done():
+                    f.set_exception(e)

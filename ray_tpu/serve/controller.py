@@ -1,0 +1,188 @@
+"""ServeController: the serve control plane actor (reference:
+python/ray/serve/_private/controller.py:84, deployment_state.py:1232
+replica reconciliation, autoscaling_state.py). Holds per-application
+deployment state, creates/kills replica actors, reconciles health and
+autoscaling on a background thread, and serves routing tables to handles
+(the reference pushes config via long-poll; here handles poll with a
+version number over the same actor RPC path).
+
+Methods are sync (they run on actor executor threads; the worker's event
+loop must stay free for RPC)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class ServeController:
+    def __init__(self):
+        # apps[app][dep] = {spec, replicas: [handle], version, target}
+        self.apps: Dict[str, Dict[str, Dict]] = {}
+        self._lock = threading.RLock()
+        self._load_ema: Dict[tuple, float] = {}
+        self._scale_marks: Dict[tuple, float] = {}
+        self._stop = False
+        self._thread = threading.Thread(target=self._reconcile_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def deploy_application(self, app_name: str, specs: List[Dict]):
+        """specs: dependencies-first list of deployment specs."""
+        with self._lock:
+            app = self.apps.setdefault(app_name, {})
+            for spec in specs:
+                name = spec["name"]
+                dep = app.get(name)
+                if dep is None:
+                    dep = {"spec": spec, "replicas": [], "version": 0,
+                           "target": spec["config"]["num_replicas"]}
+                    app[name] = dep
+                else:
+                    dep["spec"] = spec
+                    dep["target"] = spec["config"]["num_replicas"]
+                    self._replace_replicas(dep)   # code/config change
+                auto = spec["config"].get("autoscaling_config")
+                if auto:
+                    dep["target"] = max(auto["min_replicas"],
+                                        min(dep["target"],
+                                            auto["max_replicas"]))
+                self._reconcile_deployment(dep)
+        return True
+
+    def _make_replica(self, spec: Dict):
+        import ray_tpu
+        from ray_tpu.serve.replica import Replica
+        opts = dict(spec["config"].get("ray_actor_options") or {})
+        max_ongoing = spec["config"].get("max_ongoing_requests", 16)
+        actor_cls = ray_tpu.remote(Replica)
+        return actor_cls.options(
+            max_concurrency=max_ongoing + 2,
+            num_cpus=opts.get("num_cpus", 0.25),
+            num_tpus=opts.get("num_tpus"),
+            resources=opts.get("resources"),
+        ).remote(spec["callable"], tuple(spec["init_args"]),
+                 spec["init_kwargs"], spec["is_function"])
+
+    def _reconcile_deployment(self, dep: Dict):
+        import ray_tpu
+        changed = False
+        while len(dep["replicas"]) < dep["target"]:
+            dep["replicas"].append(self._make_replica(dep["spec"]))
+            changed = True
+        while len(dep["replicas"]) > dep["target"]:
+            victim = dep["replicas"].pop()
+            try:
+                ray_tpu.kill(victim)
+            except Exception:
+                pass
+            changed = True
+        if changed:
+            dep["version"] += 1
+
+    def _replace_replicas(self, dep: Dict):
+        import ray_tpu
+        for v in dep["replicas"]:
+            try:
+                ray_tpu.kill(v)
+            except Exception:
+                pass
+        dep["replicas"] = []
+        dep["version"] += 1
+
+    def _reconcile_loop(self):
+        import ray_tpu
+        while not self._stop:
+            time.sleep(2.0)
+            try:
+                with self._lock:
+                    items = [(a, n, dep) for a, app in self.apps.items()
+                             for n, dep in app.items()]
+                for app_name, name, dep in items:
+                    alive = []
+                    for r in dep["replicas"]:
+                        try:
+                            # generous timeout: a slow box must not read as
+                            # death (kills would cascade); real deaths also
+                            # surface as ActorDiedError immediately
+                            ray_tpu.get(r.check_health.remote(), timeout=30)
+                            alive.append(r)
+                        except ray_tpu.ActorDiedError:
+                            logger.warning("replica of %s/%s died; "
+                                           "replacing", app_name, name)
+                        except Exception:
+                            alive.append(r)   # slow ≠ dead
+                    with self._lock:
+                        if len(alive) != len(dep["replicas"]):
+                            dep["replicas"] = alive
+                            dep["version"] += 1
+                        self._autoscale(app_name, name, dep)
+                        self._reconcile_deployment(dep)
+            except Exception:
+                logger.exception("reconcile loop iteration failed")
+
+    def _autoscale(self, app_name, name, dep):
+        import ray_tpu
+        auto = dep["spec"]["config"].get("autoscaling_config")
+        if not auto or not dep["replicas"]:
+            return
+        try:
+            lens = ray_tpu.get([r.get_queue_len.remote()
+                                for r in dep["replicas"]], timeout=5)
+        except Exception:
+            return
+        key = (app_name, name)
+        load = sum(lens) / max(1, len(dep["replicas"]))
+        ema = 0.6 * self._load_ema.get(key, load) + 0.4 * load
+        self._load_ema[key] = ema
+        target = dep["target"]
+        now = time.monotonic()
+        mark = self._scale_marks.get(key, 0)
+        if ema > auto["target_ongoing_requests"] and \
+                target < auto["max_replicas"] and \
+                now - mark > auto["upscale_delay_s"]:
+            dep["target"] = target + 1
+            self._scale_marks[key] = now
+        elif ema < auto["target_ongoing_requests"] * 0.3 and \
+                target > auto["min_replicas"] and \
+                now - mark > auto["downscale_delay_s"]:
+            dep["target"] = target - 1
+            self._scale_marks[key] = now
+
+    def get_deployment_info(self, app_name: str, name: str) -> Dict:
+        with self._lock:
+            dep = self.apps.get(app_name, {}).get(name)
+            if dep is None:
+                return {"version": -1, "replicas": []}
+            return {"version": dep["version"],
+                    "replicas": list(dep["replicas"])}
+
+    def get_status(self) -> Dict:
+        with self._lock:
+            return {
+                app_name: {
+                    name: {"target": dep["target"],
+                           "running": len(dep["replicas"]),
+                           "version": dep["version"]}
+                    for name, dep in app.items()}
+                for app_name, app in self.apps.items()}
+
+    def list_applications(self):
+        with self._lock:
+            return list(self.apps.keys())
+
+    def delete_application(self, app_name: str):
+        import ray_tpu
+        with self._lock:
+            app = self.apps.pop(app_name, {})
+        for dep in app.values():
+            for r in dep["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+        return True

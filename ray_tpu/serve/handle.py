@@ -1,0 +1,151 @@
+"""DeploymentHandle + client-side power-of-two-choices routing.
+
+Reference: python/ray/serve/handle.py:745 (DeploymentHandle),
+_private/replica_scheduler/pow_2_scheduler.py:52. The router here is
+embedded in the handle (no proxy hop for handle calls): it tracks its own
+in-flight count per replica and picks the less-loaded of two random
+replicas — the cached-queue-length variant of P2C."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future for one deployment call."""
+
+    def __init__(self, ref, router=None, replica_id=None):
+        self._ref = ref
+        self._router = router
+        self._replica_id = replica_id
+        self._done = False
+
+    def result(self, timeout: Optional[float] = None):
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            self._settle()
+
+    def _settle(self):
+        if not self._done and self._router is not None:
+            self._done = True
+            self._router._dec(self._replica_id)
+
+    def __await__(self):
+        async def _get():
+            try:
+                from ray_tpu._private.worker import global_worker
+                return await global_worker.core.get_async(self._ref)
+            finally:
+                self._settle()
+        return _get().__await__()
+
+    @property
+    def _object_ref(self):
+        return self._ref
+
+    def __del__(self):
+        self._settle()
+
+
+class _Router:
+    def __init__(self, deployment_name: str, app_name: str):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self.replicas: List = []        # actor handles
+        self.inflight: Dict[int, int] = {}
+        self.version = -1
+        self.lock = threading.Lock()
+        self._last_refresh = 0.0
+
+    def _controller(self):
+        from ray_tpu.serve.api import _get_controller
+        return _get_controller()
+
+    def refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and self.replicas and now - self._last_refresh < 2.0:
+            return
+        info = ray_tpu.get(self._controller().get_deployment_info.remote(
+            self.app_name, self.deployment_name), timeout=30)
+        with self.lock:
+            self._last_refresh = now
+            if info["version"] != self.version:
+                self.version = info["version"]
+                self.replicas = info["replicas"]
+                self.inflight = {i: 0 for i in range(len(self.replicas))}
+
+    def pick(self):
+        self.refresh()
+        with self.lock:
+            n = len(self.replicas)
+            if n == 0:
+                raise RuntimeError(
+                    f"deployment {self.deployment_name} has no replicas")
+            if n == 1:
+                idx = 0
+            else:
+                a, b = random.sample(range(n), 2)
+                idx = a if self.inflight.get(a, 0) <= \
+                    self.inflight.get(b, 0) else b
+            self.inflight[idx] = self.inflight.get(idx, 0) + 1
+            return idx, self.replicas[idx]
+
+    def _dec(self, idx):
+        with self.lock:
+            if idx in self.inflight and self.inflight[idx] > 0:
+                self.inflight[idx] -= 1
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._invoke(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str = "default"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._router = _Router(deployment_name, app_name)
+
+    def _invoke(self, method: str, args, kwargs,
+                retry: int = 2) -> DeploymentResponse:
+        # unwrap nested responses so replicas receive resolved values
+        args = tuple(a._object_ref if isinstance(a, DeploymentResponse)
+                     else a for a in args)
+        kwargs = {k: (v._object_ref if isinstance(v, DeploymentResponse)
+                      else v) for k, v in kwargs.items()}
+        last_err = None
+        for _ in range(retry + 1):
+            idx, replica = self._router.pick()
+            try:
+                ref = replica.handle_request.remote(method, args, kwargs)
+                return DeploymentResponse(ref, self._router, idx)
+            except Exception as e:
+                self._router._dec(idx)
+                self._router.refresh(force=True)
+                last_err = e
+        raise last_err
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._invoke("__call__", args, kwargs)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_") or name in ("deployment_name", "app_name"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+    def options(self, **_kw) -> "DeploymentHandle":
+        return self
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name, self.app_name))

@@ -1,0 +1,81 @@
+"""HTTP ingress proxy actor (reference: python/ray/serve/_private/proxy.py
+HTTPProxy :779 — uvicorn/ASGI there; aiohttp here, same role: terminate
+HTTP, route by prefix, forward to the ingress deployment handle)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Dict
+
+
+class HttpProxy:
+    def __init__(self, port: int, routes: Dict[str, str],
+                 ingress: Dict[str, str]):
+        self.port = port
+        self.routes = routes          # route_prefix -> app_name
+        self.ingress = ingress        # app_name -> deployment name
+        self._handles = {}
+        self._ready = False
+        from ray_tpu._private.worker import global_worker
+        asyncio.run_coroutine_threadsafe(
+            self._start(), global_worker.core.loop).result(timeout=30)
+
+    async def _start(self):
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "0.0.0.0", self.port)
+        await site.start()
+        self._ready = True
+
+    def ready(self):
+        return self._ready
+
+    def update_routes(self, routes: Dict[str, str],
+                      ingress: Dict[str, str]):
+        self.routes = routes
+        self.ingress = ingress
+        return True
+
+    def _handle_for(self, app_name: str):
+        h = self._handles.get(app_name)
+        if h is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+            h = DeploymentHandle(self.ingress[app_name], app_name)
+            self._handles[app_name] = h
+        return h
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        path = "/" + request.match_info["tail"]
+        app_name = None
+        for prefix, name in sorted(self.routes.items(),
+                                   key=lambda kv: -len(kv[0])):
+            if path.startswith(prefix):
+                app_name = name
+                break
+        if app_name is None:
+            return web.Response(status=404, text="no route")
+        if request.content_type == "application/json":
+            try:
+                payload = await request.json()
+            except json.JSONDecodeError:
+                payload = await request.text()
+        else:
+            payload = await request.text()
+        handle = self._handle_for(app_name)
+        loop = asyncio.get_event_loop()
+        try:
+            # routing + submit use the sync API; keep them off this loop
+            result = await loop.run_in_executor(
+                None, lambda: handle.remote(payload).result(timeout=60))
+        except Exception as e:
+            return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        if isinstance(result, (dict, list)):
+            return web.json_response(result)
+        return web.Response(text=str(result))

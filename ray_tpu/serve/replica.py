@@ -1,0 +1,68 @@
+"""Replica actor: hosts one copy of a deployment's callable (reference:
+python/ray/serve/_private/replica.py:233 ReplicaActor + UserCallableWrapper
+:810). Runs with max_concurrency = max_ongoing_requests so requests overlap
+and health probes are never stuck behind user code; tracks its ongoing
+count for autoscaling."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Tuple
+
+
+class Replica:
+    def __init__(self, serialized_callable: bytes, init_args: Tuple,
+                 init_kwargs: Dict, is_function: bool):
+        import cloudpickle
+        target = cloudpickle.loads(serialized_callable)
+        self._is_function = is_function
+        if is_function:
+            self._callable = target
+        else:
+            self._callable = target(*init_args, **init_kwargs)
+        self._ongoing = 0
+        self._lock = threading.Lock()
+
+    def handle_request(self, method: str, args: Tuple, kwargs: Dict):
+        import ray_tpu
+        from ray_tpu import ObjectRef
+        # composed calls pass upstream DeploymentResponses as refs; resolve
+        # to values before invoking user code (reference: handle.py resolves
+        # nested DeploymentResponses)
+        args = tuple(ray_tpu.get(a) if isinstance(a, ObjectRef) else a
+                     for a in args)
+        kwargs = {k: (ray_tpu.get(v) if isinstance(v, ObjectRef) else v)
+                  for k, v in kwargs.items()}
+        with self._lock:
+            self._ongoing += 1
+        try:
+            if self._is_function:
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method)
+            import asyncio
+            import inspect
+            if inspect.iscoroutinefunction(fn):
+                # we're on an executor thread; hop onto the worker loop
+                from ray_tpu._private.worker import global_worker
+                return asyncio.run_coroutine_threadsafe(
+                    fn(*args, **kwargs), global_worker.core.loop).result()
+            return fn(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def get_queue_len(self) -> int:
+        return self._ongoing
+
+    def check_health(self) -> bool:
+        fn = getattr(self._callable, "check_health", None)
+        if fn is not None:
+            fn()
+        return True
+
+    def reconfigure(self, user_config):
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+        return True

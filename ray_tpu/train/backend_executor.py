@@ -46,13 +46,12 @@ class TrainWorker:
         from ray_tpu._private.rpc import node_ip_address
         return node_ip_address()
 
-    def setup_jax_distributed(self, coordinator: str, world_size: int,
+    def setup_jax_distributed(self, group_name: str, world_size: int,
                               rank: int):
-        import jax
-        if world_size > 1:
-            jax.distributed.initialize(coordinator_address=coordinator,
-                                       num_processes=world_size,
-                                       process_id=rank)
+        # rank 0 binds a free port on ITS host and publishes via GCS KV
+        # (the collective rendezvous helper), so no port guessing
+        from ray_tpu.util.collective import _init_jax_distributed
+        _init_jax_distributed(world_size, rank, group_name)
         return True
 
     def run(self, fn, config):
@@ -117,11 +116,9 @@ class BackendExecutor:
             setups.append(w.setup.remote(n, rank, local_rank, node_rank))
         ray_tpu.get(setups, timeout=120)
         if self.use_jax_distributed and n > 1:
-            import socket
-            coord_ip = ips[0]
-            port = 20000 + (int(time.time()) % 10000)
-            coordinator = f"{coord_ip}:{port}"
-            ray_tpu.get([w.setup_jax_distributed.remote(coordinator, n, r)
+            import uuid
+            group = f"train-{uuid.uuid4().hex[:8]}"
+            ray_tpu.get([w.setup_jax_distributed.remote(group, n, r)
                          for r, w in enumerate(self.workers)], timeout=300)
 
     def set_resume_checkpoint(self, ckpt):

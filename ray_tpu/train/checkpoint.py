@@ -101,10 +101,21 @@ class CheckpointManager:
                 len(self.checkpoints) <= self.num_to_keep:
             return
         if self.score_attribute:
-            reverse = self.order == "max"
-            ranked = sorted(self.checkpoints,
-                            key=lambda t: (t[0] is None, t[0]),
-                            reverse=reverse)
+            # unscored checkpoints must rank BELOW every scored one in
+            # either direction
+            if self.order == "max":
+                ranked = sorted(
+                    self.checkpoints,
+                    key=lambda t: (t[0] is not None,
+                                   t[0] if t[0] is not None
+                                   else float("-inf")),
+                    reverse=True)
+            else:
+                ranked = sorted(
+                    self.checkpoints,
+                    key=lambda t: (t[0] is None,
+                                   t[0] if t[0] is not None
+                                   else float("inf")))
         else:
             ranked = list(self.checkpoints)   # FIFO: oldest dropped
             ranked = ranked[::-1]

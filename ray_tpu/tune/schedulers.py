@@ -38,6 +38,7 @@ class ASHAScheduler:
             t *= reduction_factor
         self.rung_records: Dict[int, List[float]] = \
             collections.defaultdict(list)
+        self._evaluated: Dict[str, set] = collections.defaultdict(set)
 
     def on_result(self, trial_id: str, result: Dict) -> str:
         t = result.get(self.time_attr)
@@ -46,8 +47,11 @@ class ASHAScheduler:
             return CONTINUE
         if t >= self.max_t:
             return STOP
+        # evaluate at the first result AT OR PAST each rung (trials may
+        # report on a stride that skips the exact rung value)
         for rung in self.rungs:
-            if t == rung:
+            if t >= rung and rung not in self._evaluated[trial_id]:
+                self._evaluated[trial_id].add(rung)
                 sign = 1.0 if self.mode == "max" else -1.0
                 rec = self.rung_records[rung]
                 rec.append(sign * score)

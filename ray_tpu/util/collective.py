@@ -155,11 +155,17 @@ def _xla_allreduce(tensor, op: str):
     devs = np.array(jax.devices())
     mesh = Mesh(devs, ("all",))
     red = {"sum": "psum", "max": "pmax", "min": "pmin"}[op]
+    n_local = jax.local_device_count()
 
     def f(x):
         import jax.lax as lax
-        fn = getattr(lax, red)
-        return fn(x, "all")
+        out = getattr(lax, red)(x, "all")
+        if red == "psum":
+            # P() replicates each process's tensor onto all of its local
+            # devices; psum then counts every local copy, so divide the
+            # per-process multiplicity back out (homogeneous hosts)
+            out = out / n_local
+        return out
 
     g = shard_map(f, mesh=mesh, in_specs=P(), out_specs=P(),
                   check_rep=False)

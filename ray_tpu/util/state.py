@@ -1,0 +1,76 @@
+"""State API: cluster introspection (reference: python/ray/util/state/api.py
+— ray list tasks/actors/nodes/objects/jobs, summaries; backed by the GCS
+tables and task-event sink instead of a dashboard aggregator)."""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional
+
+
+def _w():
+    from ray_tpu import _get_worker
+    return _get_worker()
+
+
+def list_nodes() -> List[Dict]:
+    return _w().gcs_call("get_all_nodes")
+
+
+def list_actors() -> List[Dict]:
+    return _w().gcs_call("get_all_actors")
+
+
+def list_jobs() -> List[Dict]:
+    return _w().gcs_call("get_all_jobs")
+
+
+def list_placement_groups() -> List[Dict]:
+    return _w().gcs_call("get_all_placement_groups")
+
+
+def list_tasks(limit: int = 1000, job_id: Optional[int] = None) -> List[Dict]:
+    return _w().gcs_call("list_task_events", limit=limit, job_id=job_id)
+
+
+def list_named_actors(namespace: Optional[str] = None) -> List[Dict]:
+    return _w().gcs_call("list_named_actors", namespace=namespace)
+
+
+def list_objects(limit: int = 1000) -> List[Dict]:
+    """Objects in this node's shared-memory store."""
+    core = _w().core
+    if core.store is None:
+        return []
+    out = []
+    for oid in core.store.list_objects(max_n=limit):
+        out.append({"object_id": oid.hex(), "node_id": core.node_id})
+    return out
+
+
+def summarize_tasks() -> Dict[str, Dict[str, int]]:
+    """{task_name: {state: count}} (reference: ray summary tasks)."""
+    summary: Dict[str, Dict[str, int]] = collections.defaultdict(
+        lambda: collections.defaultdict(int))
+    for t in list_tasks(limit=10000):
+        summary[t.get("name", "?")][t.get("state", "?")] += 1
+    return {k: dict(v) for k, v in summary.items()}
+
+
+def summarize_actors() -> Dict[str, int]:
+    summary: Dict[str, int] = collections.defaultdict(int)
+    for a in list_actors():
+        summary[a["state"]] += 1
+    return dict(summary)
+
+
+def cluster_summary() -> Dict:
+    import ray_tpu
+    nodes = list_nodes()
+    return {
+        "nodes_alive": sum(1 for n in nodes if n["alive"]),
+        "nodes_dead": sum(1 for n in nodes if not n["alive"]),
+        "total_resources": ray_tpu.cluster_resources(),
+        "available_resources": ray_tpu.available_resources(),
+        "actors": summarize_actors(),
+    }

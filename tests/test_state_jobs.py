@@ -1,0 +1,105 @@
+"""State API + job submission + dashboard REST tests (reference:
+python/ray/tests/test_state_api.py, dashboard/modules/job/tests)."""
+
+import json
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    ctx = ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_list_nodes(ray_start):
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+
+
+def test_task_events(ray_start):
+    @ray_tpu.remote
+    def traced_task():
+        return 1
+
+    ray_tpu.get([traced_task.remote() for _ in range(3)])
+    time.sleep(1.5)   # event flush interval
+    tasks = state.list_tasks()
+    mine = [t for t in tasks if t.get("name") == "traced_task"]
+    assert len(mine) == 3
+    assert all(t["state"] == "FINISHED" for t in mine)
+    summ = state.summarize_tasks()
+    assert summ.get("traced_task", {}).get("FINISHED") == 3
+
+
+def test_list_actors(ray_start):
+    @ray_tpu.remote
+    class Tracked:
+        def ping(self):
+            return 1
+
+    a = Tracked.remote()
+    ray_tpu.get(a.ping.remote())
+    actors = state.list_actors()
+    assert any(x["state"] == "ALIVE" for x in actors)
+    assert state.summarize_actors().get("ALIVE", 0) >= 1
+
+
+def test_job_submission(ray_start):
+    from ray_tpu.job_submission import JobSubmissionClient
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('job says hi')\"")
+    status = client.wait_until_finished(job_id, timeout=60)
+    assert status == "SUCCEEDED"
+    assert "job says hi" in client.get_job_logs(job_id)
+    jobs = client.list_jobs()
+    assert any(j["job_id"] == job_id for j in jobs)
+
+
+def test_job_failure_status(ray_start):
+    from ray_tpu.job_submission import JobSubmissionClient
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import sys; sys.exit(3)\"")
+    status = client.wait_until_finished(job_id, timeout=60)
+    assert status == "FAILED"
+
+
+def test_dashboard_rest(ray_start):
+    from ray_tpu.dashboard import start_dashboard
+    start_dashboard(port=18266)
+
+    def get(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:18266{path}", timeout=30) as r:
+            return json.loads(r.read())
+
+    nodes = get("/api/nodes")
+    assert len(nodes) == 1
+    st = get("/api/cluster_status")
+    assert st["nodes_alive"] == 1
+    # submit a job over REST
+    req = urllib.request.Request(
+        "http://127.0.0.1:18266/api/jobs",
+        data=json.dumps({"entrypoint":
+                         f"{sys.executable} -c \"print('rest job')\""}
+                        ).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        job_id = json.loads(r.read())["job_id"]
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        info = get(f"/api/jobs/{job_id}")
+        if info["status"] in ("SUCCEEDED", "FAILED"):
+            break
+        time.sleep(0.5)
+    assert info["status"] == "SUCCEEDED"
+    assert "rest job" in get(f"/api/jobs/{job_id}/logs")["logs"]

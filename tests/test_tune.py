@@ -45,12 +45,34 @@ def test_random_search(ray_start):
     assert len(set(vals)) == 5
 
 
-def test_asha_early_stopping(ray_start):
+def test_asha_scheduler_unit():
+    """Deterministic halving semantics, incl. reports that stride past
+    rung values (first-result-at-or-past-rung evaluation)."""
+    from ray_tpu.tune.schedulers import CONTINUE, STOP
+    sched = ASHAScheduler(metric="acc", mode="max", max_t=100,
+                          grace_period=4, reduction_factor=2)
+    # two good trials seed rung 4 high (the later one leads, so both pass
+    # the top-1/rf cut)
+    assert sched.on_result("good1", {"training_iteration": 4,
+                                     "acc": 10.0}) == CONTINUE
+    assert sched.on_result("good2", {"training_iteration": 4,
+                                     "acc": 11.0}) == CONTINUE
+    # bad trial reporting on a stride (3, 6 — never exactly 4) must still
+    # be evaluated at rung 4 and cut
+    assert sched.on_result("bad", {"training_iteration": 3,
+                                   "acc": 0.1}) == CONTINUE
+    assert sched.on_result("bad", {"training_iteration": 6,
+                                   "acc": 0.2}) == STOP
+    # max_t stops unconditionally
+    assert sched.on_result("good1", {"training_iteration": 100,
+                                     "acc": 99.0}) == STOP
+
+
+def test_asha_integration(ray_start):
     def trainable(config):
         for step in range(20):
-            # bad configs plateau low; good ones improve
             tune.report(acc=config["lr"] * (step + 1))
-            time.sleep(0.02)
+            time.sleep(0.05)
 
     tuner = Tuner(
         trainable,
@@ -62,9 +84,6 @@ def test_asha_early_stopping(ray_start):
     assert len(results) == 4
     best = results.get_best_result("acc", mode="max")
     assert best.config["lr"] == 2.0
-    # at least one poor trial stopped early
-    iters = {r.config["lr"]: len(r.history) for r in results}
-    assert min(iters.values()) < 20
 
 
 def test_trial_error_captured(ray_start):
