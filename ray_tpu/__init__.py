@@ -13,7 +13,8 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.serialization import (ActorDiedError, ObjectLostError,
-                                            TaskError, WorkerCrashedError)
+                                            TaskCancelledError, TaskError,
+                                            WorkerCrashedError)
 from ray_tpu.actor import ActorClass, ActorHandle
 from ray_tpu.remote_function import RemoteFunction
 
@@ -186,6 +187,41 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
     _get_worker().kill_actor(actor._id, no_restart=no_restart)
 
 
+def cancel(ref: ObjectRef, *, force: bool = False):
+    """Cancel a pending/queued task (reference: ray.cancel — queued tasks
+    drop with TaskCancelledError; force also kills a running worker)."""
+    return _get_worker().cancel(ref, force=force)
+
+
+def timeline(filename: Optional[str] = None):
+    """Export task events as a chrome://tracing JSON (reference:
+    `ray timeline`, python/ray/_private/state.py chrome trace export)."""
+    import json
+    events = []
+    for row in _get_worker().gcs_call("list_task_events", limit=10000):
+        times = row.get("state_times", {})
+        start = times.get("RUNNING")
+        end = times.get("FINISHED") or times.get("FAILED")
+        if start is None:
+            continue
+        end = end if end and end >= start else start
+        events.append({
+            "name": row.get("name", "task"),
+            "cat": row.get("type", "task"),
+            "ph": "X",
+            "ts": start * 1e6,
+            "dur": max(1.0, (end - start) * 1e6),
+            "pid": (row.get("node_id") or "node")[:8],
+            "tid": (row.get("worker_id") or "worker")[:8],
+            "args": {"task_id": row["task_id"], "state": row.get("state")},
+        })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+        return filename
+    return events
+
+
 def get_actor(name: str, namespace: str = "default") -> ActorHandle:
     info = _get_worker().gcs_call("get_named_actor", name=name,
                                   namespace=namespace)
@@ -236,8 +272,9 @@ import ray_tpu.util as util  # noqa: E402  (public subpackage)
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-    "kill", "get_actor", "nodes", "cluster_resources", "available_resources",
-    "ObjectRef", "ActorHandle", "ActorClass", "RemoteFunction",
-    "TaskError", "ActorDiedError", "ObjectLostError", "WorkerCrashedError",
-    "util", "get_runtime_context", "get_gcs_address",
+    "kill", "cancel", "timeline", "get_actor", "nodes", "cluster_resources",
+    "available_resources", "ObjectRef", "ActorHandle", "ActorClass",
+    "RemoteFunction", "TaskError", "ActorDiedError", "ObjectLostError",
+    "WorkerCrashedError", "TaskCancelledError", "util",
+    "get_runtime_context", "get_gcs_address",
 ]

@@ -46,9 +46,11 @@ DEAD = "DEAD"
 
 
 class GcsServer:
-    def __init__(self, port: int = 0, session_name: str = "session"):
+    def __init__(self, port: int = 0, session_name: str = "session",
+                 persist_path: Optional[str] = None):
         self.port = port
         self.session_name = session_name
+        self.persist_path = persist_path
         self.address: Optional[str] = None
 
         self.kv: Dict[str, Dict[bytes, bytes]] = {}          # namespace -> {k: v}
@@ -101,14 +103,90 @@ class GcsServer:
         }
         self.server = rpc.Server(handlers, name="gcs")
         self.server.on_disconnect = self._on_disconnect
+        self._load_snapshot()
         self.address = await self.server.listen_tcp("0.0.0.0", self.port)
         self._death_checker = asyncio.ensure_future(self._check_node_deaths())
+        self._snapshot_task = None
+        if self.persist_path:
+            self._snapshot_task = asyncio.ensure_future(self._snapshot_loop())
         logger.info("GCS listening at %s", self.address)
         return self.address
+
+    # ------------------------------------------------------- persistence
+    # File-backed snapshot instead of the reference's Redis store client
+    # (reference: RedisStoreClient redis_store_client.h:106, gcs_init_data
+    # rebuild on restart). Nodes re-register via their heartbeat reconnect
+    # path; KV / jobs / named actors / PGs / actor specs survive.
+    def _snapshot_state(self) -> Dict:
+        return {
+            "kv": {ns: list(t.items()) for ns, t in self.kv.items()},
+            "jobs": self.jobs,
+            "next_job_id": self._next_job_id,
+            "named_actors": [[ns, name, aid] for (ns, name), aid
+                             in self.named_actors.items()],
+            "actors": {aid: dict(row) for aid, row in self.actors.items()},
+            "placement_groups": self.placement_groups,
+        }
+
+    def _save_snapshot(self):
+        if not self.persist_path:
+            return
+        import os
+
+        import msgpack
+        tmp = f"{self.persist_path}.tmp"
+        os.makedirs(os.path.dirname(self.persist_path) or ".", exist_ok=True)
+        # msgpack, not json: actor specs and KV entries embed raw bytes
+        # (function-table ids, pickled args) that json would stringify
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb(self._snapshot_state(), use_bin_type=True))
+        os.replace(tmp, self.persist_path)
+
+    def _load_snapshot(self):
+        if not self.persist_path:
+            return
+        import os
+
+        import msgpack
+        if not os.path.exists(self.persist_path):
+            return
+        try:
+            with open(self.persist_path, "rb") as f:
+                snap = msgpack.unpackb(f.read(), raw=False,
+                                       strict_map_key=False)
+        except Exception:
+            logger.exception("snapshot load failed; starting fresh")
+            return
+        for ns, pairs in snap.get("kv", {}).items():
+            self.kv[ns] = {k: v for k, v in pairs}
+        self.jobs = {int(k): v for k, v in snap.get("jobs", {}).items()}
+        self._next_job_id = snap.get("next_job_id", 1)
+        for ns, name, aid in snap.get("named_actors", []):
+            self.named_actors[(ns, name)] = aid
+        self.actors.update(snap.get("actors", {}))
+        self.placement_groups.update(snap.get("placement_groups", {}))
+        logger.info("restored GCS snapshot from %s (%d kv namespaces, "
+                    "%d actors)", self.persist_path, len(self.kv),
+                    len(self.actors))
+
+    async def _snapshot_loop(self):
+        while True:
+            await asyncio.sleep(2.0)
+            try:
+                self._save_snapshot()
+            except Exception:
+                logger.exception("snapshot save failed")
 
     async def stop(self):
         if self._death_checker:
             self._death_checker.cancel()
+        if getattr(self, "_snapshot_task", None):
+            self._snapshot_task.cancel()
+            self._snapshot_task = None
+            try:
+                self._save_snapshot()   # final flush of acknowledged state
+            except Exception:
+                logger.exception("final snapshot failed")
         await self.server.close()
 
     def _on_disconnect(self, conn: rpc.Connection):
@@ -167,12 +245,14 @@ class GcsServer:
         return {"node_id": node_id, "cluster_view": self._cluster_view()}
 
     def h_heartbeat(self, conn, node_id: str, available: Dict[str, float],
-                    total: Optional[Dict[str, float]] = None):
+                    total: Optional[Dict[str, float]] = None,
+                    pending: Optional[List[Dict[str, float]]] = None):
         info = self.nodes.get(node_id)
         if info is None or not info["alive"]:
             return {"ok": False, "reason": "unknown or dead node"}
         info["last_heartbeat"] = time.monotonic()
         info["available"] = available
+        info["pending_demand"] = pending or []
         if total is not None:
             info["total"] = total
         return {"ok": True}
@@ -526,9 +606,11 @@ class GcsServer:
 
 
 def _node_public(n: Dict) -> Dict:
-    return {k: n[k] for k in ("node_id", "address", "object_store_address",
-                              "node_ip", "total", "available", "labels",
-                              "alive")}
+    out = {k: n[k] for k in ("node_id", "address", "object_store_address",
+                             "node_ip", "total", "available", "labels",
+                             "alive")}
+    out["pending_demand"] = n.get("pending_demand", [])
+    return out
 
 
 def _actor_public(row: Dict) -> Dict:
@@ -547,12 +629,14 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--session-name", default="session")
+    parser.add_argument("--persist-path", default=None)
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO,
                         format="[gcs] %(asctime)s %(levelname)s %(message)s")
 
     async def run():
-        gcs = GcsServer(port=args.port, session_name=args.session_name)
+        gcs = GcsServer(port=args.port, session_name=args.session_name,
+                        persist_path=args.persist_path)
         addr = await gcs.start()
         # announce the bound address on stdout for the supervisor
         print(f"GCS_ADDRESS={addr}", flush=True)

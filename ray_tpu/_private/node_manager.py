@@ -24,6 +24,7 @@ import logging
 import os
 import subprocess
 import sys
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -115,6 +116,9 @@ class NodeManager:
         self._tasks: List[asyncio.Task] = []
         self._draining = False
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
+        # queued lease demand, reported in heartbeats for the autoscaler
+        self._pending_demand: List[Dict[str, float]] = []
+        self._spill_mutex = threading.Lock()
 
     # -------------------------------------------------------------- lifecycle
     async def start(self) -> str:
@@ -131,6 +135,8 @@ class NodeManager:
             "return_bundle": self.h_return_bundle,
             "pull_object": self.h_pull_object,
             "fetch_object": self.h_fetch_object,
+            "restore_object": self.h_restore_object,
+            "spill_now": self.h_spill_now,
             "free_object": self.h_free_object,
             "free_remote_object": self.h_free_remote_object,
             "get_node_info": self.h_get_node_info,
@@ -157,10 +163,13 @@ class NodeManager:
             node_ip=rpc.node_ip_address())
         self.cluster_view = resp["cluster_view"]
         await self.gcs.call("subscribe", channel="NODE")
+        self.spill_dir = f"/tmp/raytpu/{self.session_name}/spill_{self.node_id[:8]}"
+        self.spilled: Dict[bytes, str] = {}
         self._tasks = [
             asyncio.ensure_future(self._heartbeat_loop()),
             asyncio.ensure_future(self._view_refresh_loop()),
             asyncio.ensure_future(self._reap_children_loop()),
+            asyncio.ensure_future(self._spill_loop()),
         ]
         logger.info("node manager %s at %s (store %s, %s)",
                     self.node_id[:12], self.address, self.store_path,
@@ -194,7 +203,8 @@ class NodeManager:
         while True:
             try:
                 await self.gcs.call("heartbeat", node_id=self.node_id,
-                                    available=self._reported_available())
+                                    available=self._reported_available(),
+                                    pending=list(self._pending_demand))
             except (rpc.RpcError, rpc.ConnectionLost):
                 logger.warning("heartbeat failed; reconnecting to GCS")
                 try:
@@ -444,10 +454,16 @@ class NodeManager:
                 return {"status": "error", "reason": "lease wait timed out"}
             fut = asyncio.get_event_loop().create_future()
             self._lease_waiters.append(fut)
+            self._pending_demand.append(dict(resources))
             try:
                 await asyncio.wait_for(fut, timeout=1.0)
             except asyncio.TimeoutError:
                 pass
+            finally:
+                try:
+                    self._pending_demand.remove(resources)
+                except ValueError:
+                    pass
 
     def _live_view(self) -> Dict[str, Dict]:
         view = {nid: v for nid, v in self.cluster_view.items()
@@ -558,7 +574,10 @@ class NodeManager:
             return False
         w.state = "dead"
         if w.lease_id is not None:
+            # releases the actor's resource reservation (lease id is the
+            # actor-scoped key set in h_create_actor)
             self._release_lease(w.lease_id, worker_dead=True)
+            w.lease_id = None
         if w.conn is not None and not w.conn.closed:
             try:
                 await w.conn.call("exit", reason=reason, timeout=1.0)
@@ -644,9 +663,12 @@ class NodeManager:
             if not fut.done():
                 fut.cancel()
 
-    def h_fetch_object(self, conn, oid: bytes, part: str = "meta",
-                       offset: int = 0, length: int = 0):
+    async def h_fetch_object(self, conn, oid: bytes, part: str = "meta",
+                             offset: int = 0, length: int = 0):
         buf = self.store.get(oid)
+        if buf is None and oid in self.spilled:
+            await self.h_restore_object(conn, oid)
+            buf = self.store.get(oid)
         if buf is None:
             return None
         try:
@@ -656,11 +678,126 @@ class NodeManager:
         finally:
             buf.close()
 
+    # --------------------------------------------------------------- spilling
+    async def _spill_loop(self):
+        """Spill LRU sealed objects to disk under memory pressure
+        (reference: LocalObjectManager spill through IO workers,
+        src/ray/raylet/local_object_manager.h:110; here the daemon itself
+        writes — the store is directly mapped, a read is a memcpy)."""
+        loop = asyncio.get_event_loop()
+        while True:
+            await asyncio.sleep(2.0)
+            try:
+                # disk writes run in a thread: a multi-hundred-MB pass must
+                # not stall heartbeats (reference: dedicated IO workers,
+                # local_object_manager.h)
+                await loop.run_in_executor(
+                    None, self._spill_pass, 0.8, 0.6)
+            except Exception:
+                logger.exception("spill iteration failed")
+
+    def _spill_pass(self, trigger_frac: float = 0.8,
+                    target_frac: float = 0.6) -> int:
+        """One spill pass (runs on an executor thread): write sealed
+        objects to disk and delete them from the store until usage drops
+        below target_frac. Returns the number of objects spilled."""
+        with self._spill_mutex:
+            return self._spill_pass_locked(trigger_frac, target_frac)
+
+    def _spill_pass_locked(self, trigger_frac: float,
+                           target_frac: float) -> int:
+        import os as _os
+        st = self.store.stats()
+        cap = st["capacity"] or 1
+        if st["bytes_in_use"] < trigger_frac * cap:
+            return 0
+        _os.makedirs(self.spill_dir, exist_ok=True)
+        n = 0
+        for oid in self.store.list_objects():
+            if oid in self.spilled:
+                # already on disk (a restored copy) — just drop the resident
+                # copy; the native store defers the delete if clients pin it
+                self.store.delete(oid)
+                n += 1
+                st = self.store.stats()
+                if st["bytes_in_use"] < target_frac * cap:
+                    break
+                continue
+            buf = self.store.get(oid)
+            if buf is None:
+                continue
+            path = _os.path.join(self.spill_dir, oid.hex())
+            try:
+                with open(path, "wb") as f:
+                    meta = buf.metadata
+                    f.write(len(meta).to_bytes(8, "little"))
+                    f.write(meta)
+                    f.write(buf.data)
+            finally:
+                buf.close()
+            self.spilled[oid] = path
+            self.store.delete(oid)
+            n += 1
+            st = self.store.stats()
+            if st["bytes_in_use"] < target_frac * cap:
+                break
+        return n
+
+    async def h_spill_now(self, conn):
+        """Spill under client-side memory pressure: a worker about to
+        create a large object calls this so sealed LRU objects move to
+        disk instead of being evicted (reference: plasma's
+        CreateRequestQueue blocks creates while LocalObjectManager spills,
+        create_request_queue.h)."""
+        return await asyncio.get_event_loop().run_in_executor(
+            None, self._spill_pass, 0.7, 0.5)
+
+    async def h_restore_object(self, conn, oid: bytes):
+        """Restore a spilled object into the store (reference:
+        spilled_object_reader.cc restore path). File IO runs on an
+        executor thread."""
+        return await asyncio.get_event_loop().run_in_executor(
+            None, self._restore_sync, oid)
+
+    def _restore_sync(self, oid: bytes):
+        if self.store.contains(oid):
+            return True
+        path = self.spilled.get(oid)
+        if path is None:
+            return False
+        try:
+            with open(path, "rb") as f:
+                mlen = int.from_bytes(f.read(8), "little")
+                meta = f.read(mlen)
+                data = f.read()
+            # make room by spilling, not by evicting un-spilled objects
+            self._spill_pass(trigger_frac=0.7, target_frac=0.5)
+            bufs = self.store.create(oid, len(data), len(meta))
+            if bufs is None:
+                return False
+            dview, mview = bufs
+            import numpy as np
+            np.frombuffer(dview, np.uint8)[:] = np.frombuffer(
+                data, np.uint8)
+            if meta:
+                mview[:] = meta
+            self.store.seal(oid)
+            return True
+        except Exception:
+            logger.exception("restore of %s failed", oid.hex()[:16])
+            return False
+
     def h_free_object(self, conn, oid: bytes):
         try:
             self.store.delete(oid)
         except Exception:
             pass
+        path = self.spilled.pop(oid, None)
+        if path is not None:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         return True
 
     async def h_free_remote_object(self, conn, oid: bytes, node_id: str):

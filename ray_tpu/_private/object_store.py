@@ -70,28 +70,52 @@ def store_path(session_name: str, node_id_hex: str) -> str:
     return f"/dev/shm/raytpu_{session_name}_{node_id_hex[:12]}"
 
 
-class SharedBuffer:
-    """A pinned view of an object's payload in the shared arena.
+class _PinnedRegion:
+    """Buffer exporter for one pinned object in the shared arena.
 
-    Holds the pin until ``close`` or garbage collection; slicing the
-    memoryview is zero-copy.
+    Every view derived from ``memoryview(region)`` — slices, PickleBuffers,
+    numpy arrays reconstructed from them — keeps this object alive through
+    the CPython buffer protocol (PEP 688: the exported Py_buffer's ``obj``
+    is this region). The store pin is released only when the last such view
+    dies, so zero-copy reads can never be reclaimed under live user views
+    (the same guarantee plasma gives by tying the pin to the client buffer,
+    reference: src/ray/object_manager/plasma/client.cc).
     """
 
-    __slots__ = ("data", "metadata", "_client", "_oid", "_closed")
+    __slots__ = ("_client", "_oid", "_mv")
 
-    def __init__(self, client: "ObjectStoreClient", oid: bytes,
-                 data: memoryview, metadata: bytes):
+    def __init__(self, client: "ObjectStoreClient", oid: bytes, mv: memoryview):
         self._client = client
         self._oid = oid
+        self._mv = mv
+
+    def __buffer__(self, flags):
+        return self._mv[:]
+
+    def __del__(self):
+        try:
+            self._client._release(self._oid)
+        except Exception:
+            pass
+
+
+class SharedBuffer:
+    """A pinned zero-copy read of an object's payload.
+
+    ``close`` drops this handle's references; the underlying pin lives until
+    the last view derived from ``data`` is garbage-collected.
+    """
+
+    __slots__ = ("data", "metadata", "_region")
+
+    def __init__(self, region: _PinnedRegion, data: memoryview, metadata: bytes):
+        self._region = region
         self.data = data
         self.metadata = metadata
-        self._closed = False
 
     def close(self):
-        if not self._closed:
-            self._closed = True
-            self.data = None
-            self._client._release(self._oid)
+        self.data = None
+        self._region = None
 
     def __del__(self):
         try:
@@ -162,9 +186,9 @@ class ObjectStoreClient:
         if off < 0:
             return None
         self._pins[oid] = self._pins.get(oid, 0) + 1
-        data = self._view[off:off + dsize.value]
+        region = _PinnedRegion(self, oid, self._view[off:off + dsize.value])
         meta = bytes(self._view[off + dsize.value:off + dsize.value + msize.value])
-        return SharedBuffer(self, oid, data, meta)
+        return SharedBuffer(region, memoryview(region), meta)
 
     def _release(self, oid: bytes) -> None:
         if self._h and self._pins.get(oid):

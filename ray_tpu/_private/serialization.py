@@ -163,3 +163,7 @@ class ObjectLostError(Exception):
 
 class WorkerCrashedError(Exception):
     pass
+
+
+class TaskCancelledError(Exception):
+    pass

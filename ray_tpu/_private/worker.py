@@ -39,7 +39,8 @@ from ray_tpu._private import ids, rpc, serialization
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.object_store import ObjectStoreClient
 from ray_tpu._private.serialization import (ActorDiedError, ObjectLostError,
-                                            TaskError, WorkerCrashedError)
+                                            TaskCancelledError, TaskError,
+                                            WorkerCrashedError)
 
 logger = logging.getLogger(__name__)
 
@@ -61,7 +62,8 @@ def _encode_arg(arg, ref_hook) -> list:
 
 
 class PendingTask:
-    __slots__ = ("spec", "return_ids", "retries_left", "arg_refs", "done")
+    __slots__ = ("spec", "return_ids", "retries_left", "arg_refs", "done",
+                 "cancelled", "current_worker")
 
     def __init__(self, spec, return_ids, retries_left, arg_refs):
         self.spec = spec
@@ -69,6 +71,8 @@ class PendingTask:
         self.retries_left = retries_left
         self.arg_refs = arg_refs
         self.done = False
+        self.cancelled = False
+        self.current_worker = None
 
 
 class Lease:
@@ -123,7 +127,6 @@ class CoreWorker:
         self.object_events: Dict[bytes, asyncio.Event] = {}
         self.owned: Dict[bytes, Dict] = {}
         self.borrowed_counts: Dict[bytes, int] = {}
-        self._shm_pins: Dict[bytes, Any] = {}   # oid -> SharedBuffer (1 pin)
         self._local_refs: Dict[bytes, int] = {}
         self._pending_unrefs: List[bytes] = []
 
@@ -149,8 +152,10 @@ class CoreWorker:
         self.actor_id: Optional[str] = None
         self.actor_spec: Optional[Dict] = None
         self.current_task_name: Optional[str] = None
+        self.current_task_id: Optional[bytes] = None
         self._orig_visible: Dict[str, Optional[str]] = {}
         self._visible_dirty: set = set()
+        self._cancelled_tasks: set = set()
         self._shutdown = False
 
     # -------------------------------------------------------------- startup
@@ -159,6 +164,7 @@ class CoreWorker:
             "push_task": self.h_push_task,
             "become_actor": self.h_become_actor,
             "wait_object": self.h_wait_object,
+            "cancel_task": self.h_cancel_task,
             "add_borrow": self.h_add_borrow,
             "remove_borrow": self.h_remove_borrow,
             "object_located": self.h_object_located,
@@ -244,7 +250,6 @@ class CoreWorker:
             if cnt > 0:
                 asyncio.ensure_future(self._send_remove_borrow(oid, owner_address))
             self.memory_store.pop(oid, None)
-            self._release_shm_pin(oid)
 
     async def _send_remove_borrow(self, oid, owner_address):
         try:
@@ -262,7 +267,6 @@ class CoreWorker:
             self.owned.pop(oid, None)
             self.memory_store.pop(oid, None)
             self.object_events.pop(oid, None)
-            self._release_shm_pin(oid)
             entry.pop("contained", None)  # drops nested refs -> their unrefs
             loc = entry.get("location")
             if loc == self.node_id and self.store is not None:
@@ -341,6 +345,18 @@ class CoreWorker:
         # value's lifetime (the reference pins nested refs the same way,
         # reference_count.h AddNestedObjectIds)
         self.owned[oid]["contained"] = list(s.contained_refs)
+        if (not s.is_inline() and self.store is not None
+                and self.node_conn is not None):
+            # under memory pressure, spill sealed objects to disk before
+            # this create LRU-evicts them irrecoverably (reference: plasma
+            # creates wait on spilling, create_request_queue.h)
+            try:
+                st = self.store.stats()
+                cap = st["capacity"]
+                if cap and st["bytes_in_use"] + s.data_size() > 0.7 * cap:
+                    await self.node_conn.call("spill_now")
+            except Exception:
+                pass
         self._store_serialized(oid, s)
         return ref
 
@@ -388,6 +404,7 @@ class CoreWorker:
     async def _resolve(self, ref: ObjectRef) -> Tuple[Any, bool]:
         """Returns (value, is_exception)."""
         oid = ref.id
+        tried_restore = False
         while True:
             entry = self.memory_store.get(oid)
             if entry is not None:
@@ -395,7 +412,22 @@ class CoreWorker:
                 if kind == "wire":
                     return self._deser_wire(entry[1], entry[2], entry[3])
                 if kind == "shm":
-                    return self._deser_shm(oid)
+                    val, is_exc = self._deser_shm(oid)
+                    if (is_exc and isinstance(val, ObjectLostError)
+                            and not tried_restore
+                            and self.node_conn is not None):
+                        # evicted locally — maybe spilled to disk by the
+                        # node manager; restore once and retry
+                        tried_restore = True
+                        try:
+                            ok = await self.node_conn.call(
+                                "restore_object", oid=oid)
+                        except Exception:
+                            ok = False
+                        if ok:
+                            self.memory_store[oid] = ("shm",)
+                            continue
+                    return val, is_exc
                 if kind == "loc":
                     node_id = entry[1]
                     if node_id == self.node_id:
@@ -447,28 +479,19 @@ class CoreWorker:
         if buf is None:
             self.memory_store.pop(oid, None)
             return ObjectLostError(f"{oid.hex()[:16]} evicted"), True
-        # Keep one pin per oid for as long as this process holds refs to the
-        # object, so zero-copy views returned to user code aren't evicted
-        # under them (released in _release_shm_pin on free).
-        if oid not in self._shm_pins:
-            self._shm_pins[oid] = buf
-            buf = None
+        # Zero-copy views embedded in the value keep the store pin alive
+        # through the buffer-protocol chain (see _PinnedRegion): the pin is
+        # released when the last derived view is collected, so dropping the
+        # value frees arena space even while the ObjectRef is still held —
+        # a later re-get re-reads or restores from spill.
         try:
-            pinned = self._shm_pins[oid]
-            val = serialization.deserialize_from_store(pinned.data,
-                                                       pinned.metadata)
+            val = serialization.deserialize_from_store(buf.data, buf.metadata)
             return val, False
         except TaskError as e:
             return e.cause if isinstance(e.cause, BaseException) else e, True
         except BaseException as e:
             return e, True
         finally:
-            if buf is not None:
-                buf.close()
-
-    def _release_shm_pin(self, oid: bytes):
-        buf = self._shm_pins.pop(oid, None)
-        if buf is not None:
             buf.close()
 
     async def _pull_to_local(self, oid: bytes, node_id: str):
@@ -554,15 +577,16 @@ class CoreWorker:
     # ------------------------------------------------------ task submission
     def submit_task(self, func, args, kwargs, num_returns=1, resources=None,
                     max_retries=DEFAULT_MAX_RETRIES, scheduling=None,
-                    name=None) -> List[ObjectRef]:
+                    name=None, runtime_env=None) -> List[ObjectRef]:
         return asyncio.run_coroutine_threadsafe(
             self.submit_task_async(func, args, kwargs, num_returns, resources,
-                                   max_retries, scheduling, name),
+                                   max_retries, scheduling, name, runtime_env),
             self.loop).result()
 
     async def submit_task_async(self, func, args, kwargs, num_returns=1,
                                 resources=None, max_retries=DEFAULT_MAX_RETRIES,
-                                scheduling=None, name=None) -> List[ObjectRef]:
+                                scheduling=None, name=None,
+                                runtime_env=None) -> List[ObjectRef]:
         task_id = ids.new_task_id(ids.job_id_from_int(self.job_id))
         return_ids = [ids.object_id_for_return(task_id, i)
                       for i in range(1, num_returns + 1)]
@@ -581,6 +605,8 @@ class CoreWorker:
             "return_ids": return_ids, "owner_address": self.address,
             "owner_node": self.node_id,
         }
+        if runtime_env:
+            spec["runtime_env"] = runtime_env
         refs = [ObjectRef(rid, self.address) for rid in return_ids]
         for rid in return_ids:
             self._register_owned(rid, lineage=None, complete=False)
@@ -599,14 +625,24 @@ class CoreWorker:
     async def _run_task(self, pt: PendingTask, resources, scheduling):
         try:
             while True:
+                if pt.cancelled:
+                    self._fail_task(pt, TaskCancelledError(pt.spec["name"]))
+                    return
                 try:
                     lease = await self._acquire_lease(resources, scheduling)
                 except Exception as e:
                     self._fail_task(pt, RuntimeError(f"lease failed: {e}"))
                     return
+                if pt.cancelled:
+                    # cancel arrived while queued for a lease (reference:
+                    # CoreWorker::CancelTask drops queued tasks)
+                    await self._return_lease(lease)
+                    self._fail_task(pt, TaskCancelledError(pt.spec["name"]))
+                    return
                 try:
                     if lease.resource_ids:
                         pt.spec["accelerator_ids"] = lease.resource_ids
+                    pt.current_worker = lease.worker_address
                     conn = await self.pool.get(lease.worker_address)
                     resp = await conn.call("push_task", spec=pt.spec)
                 except (rpc.ConnectionLost, ConnectionError, rpc.RpcError) as e:
@@ -669,6 +705,20 @@ class CoreWorker:
             if e is not None:
                 e["submitted"] = max(0, e.get("submitted", 0) - 1)
                 self._maybe_free(r.id)
+
+    async def cancel_task_async(self, ref: ObjectRef, force: bool = False):
+        task_id = ids.task_id_of_object(ref.id)
+        pt = self.pending_tasks.get(task_id)
+        if pt is None:
+            return False       # already finished (or not ours)
+        pt.cancelled = True
+        if pt.current_worker:
+            try:
+                await self.pool.call(pt.current_worker, "cancel_task",
+                                     task_id=task_id, force=force)
+            except Exception:
+                pass
+        return True
 
     # ---------------------------------------------------------------- leases
     def _lease_sig(self, resources: Dict, scheduling: Dict) -> tuple:
@@ -733,7 +783,8 @@ class CoreWorker:
                                  num_returns=1, resources=None, name=None,
                                  namespace=None, max_restarts=0,
                                  max_concurrency=1, scheduling=None,
-                                 lifetime=None, method_names=None) -> str:
+                                 lifetime=None, method_names=None,
+                                 runtime_env=None) -> str:
         actor_id = ids.new_actor_id(ids.job_id_from_int(self.job_id)).hex()
         cid = await self._ship_function(cls)
         arg_refs: List[ObjectRef] = []
@@ -752,6 +803,8 @@ class CoreWorker:
             "lifetime": lifetime,
             "method_names": list(method_names or []),
         }
+        if runtime_env:
+            spec["runtime_env"] = runtime_env
         st = ActorHandleState(actor_id)
         self.actor_handles[actor_id] = st
         await self._ensure_actor_subscription()
@@ -920,9 +973,27 @@ class CoreWorker:
         await self._exec_queue.put((spec, fut))
         return await fut
 
+    def h_cancel_task(self, conn, task_id: bytes, force: bool = False):
+        """Cancel a queued (not yet started) task on this worker
+        (reference: CoreWorker::CancelTask — queued tasks are dropped;
+        force-cancel of running tasks kills the worker)."""
+        self._cancelled_tasks.add(task_id)
+        # force-kill only if the task being cancelled is the one running —
+        # never take down an unrelated task sharing this worker
+        if force and self.current_task_id == task_id:
+            asyncio.get_event_loop().call_later(0.05, os._exit, 1)
+        return True
+
     async def _exec_consumer(self):
         while not self._shutdown:
             spec, fut = await self._exec_queue.get()
+            if spec["task_id"] in self._cancelled_tasks:
+                self._cancelled_tasks.discard(spec["task_id"])
+                result = self._encode_error(
+                    spec, TaskCancelledError(spec.get("name", "task")))
+                if not fut.done():
+                    fut.set_result(result)
+                continue
             try:
                 result = await self._execute(spec)
             except asyncio.CancelledError:
@@ -961,6 +1032,36 @@ class CoreWorker:
         except Exception:
             logger.exception("failed to set accelerator visibility")
 
+    def _apply_runtime_env(self, spec: Dict):
+        """env_vars / working_dir for this execution (reference:
+        python/ray/runtime_env/runtime_env.py:152; conda/pip/container
+        materialization is a later round)."""
+        renv = spec.get("runtime_env")
+        if not renv:
+            return None
+        saved: Dict[str, Optional[str]] = {}
+        for k, v in (renv.get("env_vars") or {}).items():
+            saved[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        saved_cwd = None
+        wd = renv.get("working_dir")
+        if wd:
+            saved_cwd = os.getcwd()
+            os.chdir(wd)
+        return (saved, saved_cwd)
+
+    def _restore_runtime_env(self, token):
+        if token is None:
+            return
+        saved, saved_cwd = token
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if saved_cwd is not None:
+            os.chdir(saved_cwd)
+
     async def _execute(self, spec: Dict) -> Dict:
         self._record_task_event(
             spec["task_id"], "RUNNING", name=spec.get("name"),
@@ -984,13 +1085,20 @@ class CoreWorker:
         else:
             fn = await self._load_function(spec["func_id"])
         self.current_task_name = spec["name"]
+        self.current_task_id = spec["task_id"]
         if asyncio.iscoroutinefunction(getattr(fn, "__call__", fn)) or \
                 asyncio.iscoroutinefunction(fn):
             value = await fn(*args, **kwargs)
         else:
-            value = await self.loop.run_in_executor(
-                self.executor, lambda: fn(*args, **kwargs))
+            def _call():
+                token = self._apply_runtime_env(spec)
+                try:
+                    return fn(*args, **kwargs)
+                finally:
+                    self._restore_runtime_env(token)
+            value = await self.loop.run_in_executor(self.executor, _call)
         self.current_task_name = None
+        self.current_task_id = None
         nret = len(spec["return_ids"])
         if nret == 1:
             values = [value]
@@ -1042,6 +1150,7 @@ class CoreWorker:
 
     async def h_become_actor(self, conn, spec: Dict):
         self._apply_accelerator_ids(spec)
+        self._apply_runtime_env(spec)   # permanent for the actor's life
         cls = await self._load_function(spec["class_id"])
         args, kwargs = await self._resolve_args(
             {"args": spec["init_args"], "kwargs": spec["init_kwargs"]})
@@ -1156,6 +1265,9 @@ class Worker:
 
     def kill_actor(self, actor_id, no_restart=True):
         return self._run(self.core.kill_actor_async(actor_id, no_restart))
+
+    def cancel(self, ref, force=False):
+        return self._run(self.core.cancel_task_async(ref, force))
 
     def gcs_call(self, method, **kw):
         return self._run(self.core.gcs.call(method, **kw))

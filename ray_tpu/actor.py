@@ -123,7 +123,8 @@ class ActorClass:
             max_concurrency=opts.get("max_concurrency", 1),
             scheduling=_scheduling_from_options(opts),
             lifetime=opts.get("lifetime"),
-            method_names=_public_methods(self._cls))
+            method_names=_public_methods(self._cls),
+            runtime_env=opts.get("runtime_env"))
         return ActorHandle(actor_id, _public_methods(self._cls),
                            {"max_task_retries": opts.get("max_task_retries", 0)},
                            is_owner=opts.get("lifetime") != "detached")

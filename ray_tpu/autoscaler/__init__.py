@@ -1,0 +1,6 @@
+from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig
+from ray_tpu.autoscaler.node_provider import (FakeMultiNodeProvider,
+                                              NodeProvider)
+
+__all__ = ["Autoscaler", "AutoscalerConfig", "NodeProvider",
+           "FakeMultiNodeProvider"]

@@ -1,0 +1,128 @@
+"""Autoscaler: reconcile cluster size against pending resource demand
+(reference: the v2 architecture — python/ray/autoscaler/v2/autoscaler.py:42,
+instance_manager, scheduler.py binpacking against ClusterResourceState).
+Demand comes from node-manager heartbeats (queued lease requests) through
+the GCS node table; the provider launches/terminates nodes."""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private import scheduling
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class NodeTypeConfig:
+    resources: Dict[str, float]
+    max_workers: int = 10
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    node_types: Dict[str, NodeTypeConfig]
+    idle_timeout_s: float = 30.0
+    upscale_interval_s: float = 2.0
+
+
+class Autoscaler:
+    def __init__(self, config: AutoscalerConfig, provider,
+                 protected_node_ids: Optional[List[str]] = None):
+        self.config = config
+        self.provider = provider
+        self.protected = set(protected_node_ids or [])
+        self._launched: Dict[str, str] = {}   # node_id -> node_type
+        # launched but not yet registered in the node table; counted as
+        # capacity during binpacking so a slow-booting node (minutes for a
+        # TPU-VM) isn't re-launched every step for the same demand
+        self._inflight: Dict[str, str] = {}   # node_id -> node_type
+        self._idle_since: Dict[str, float] = {}
+
+    def _cluster_nodes(self) -> List[Dict]:
+        import ray_tpu
+        return ray_tpu.nodes()
+
+    def step(self) -> Dict:
+        """One reconcile iteration; returns a summary of actions."""
+        nodes = self._cluster_nodes()
+        alive = [n for n in nodes if n["alive"]]
+        demand: List[Dict[str, float]] = []
+        for n in alive:
+            demand.extend(n.get("pending_demand") or [])
+        actions = {"launched": [], "terminated": []}
+
+        # reconcile in-flight launches: once a launched node registers it
+        # counts through the real node table instead
+        alive_ids = {n["node_id"] for n in alive}
+        for nid in list(self._inflight):
+            if nid in alive_ids:
+                del self._inflight[nid]
+
+        # --- scale up: binpack unmet demand onto live + in-flight +
+        # hypothetical new nodes (one launch can absorb many requests)
+        if demand:
+            shadow = {n["node_id"]: {"total": dict(n["total"]),
+                                     "available": dict(n["available"]),
+                                     "alive": True}
+                      for n in alive}
+            for nid, tname in self._inflight.items():
+                res = dict(self.config.node_types[tname].resources)
+                shadow[nid] = {"total": dict(res), "available": res,
+                               "alive": True}
+            per_type_count: Dict[str, int] = {}
+            for tname in self._launched.values():
+                per_type_count[tname] = per_type_count.get(tname, 0) + 1
+            for req in demand:
+                nid = scheduling.hybrid_policy(shadow, req)
+                if nid is not None:
+                    scheduling.subtract(shadow[nid]["available"], req)
+                    continue
+                for tname, tcfg in self.config.node_types.items():
+                    if per_type_count.get(tname, 0) >= tcfg.max_workers:
+                        continue
+                    if scheduling.feasible(tcfg.resources, req):
+                        nid = self.provider.create_node(
+                            tname, tcfg.resources, tcfg.labels)
+                        self._launched[nid] = tname
+                        self._inflight[nid] = tname
+                        per_type_count[tname] = \
+                            per_type_count.get(tname, 0) + 1
+                        actions["launched"].append(tname)
+                        res = dict(tcfg.resources)
+                        scheduling.subtract(res, req)
+                        shadow[nid] = {"total": dict(tcfg.resources),
+                                       "available": res, "alive": True}
+                        break
+
+        # --- scale down: terminate launched nodes idle past the timeout
+        now = time.monotonic()
+        for n in alive:
+            nid = n["node_id"]
+            if nid not in self._launched or nid in self.protected:
+                continue
+            busy = any(n["available"].get(k, 0) < n["total"].get(k, 0) - 1e-9
+                       for k in n["total"]
+                       if k != "object_store_memory")
+            if busy or (n.get("pending_demand") or []):
+                self._idle_since.pop(nid, None)
+                continue
+            first_idle = self._idle_since.setdefault(nid, now)
+            if now - first_idle > self.config.idle_timeout_s:
+                self.provider.terminate_node(nid)
+                self._launched.pop(nid, None)
+                self._idle_since.pop(nid, None)
+                actions["terminated"].append(nid)
+        return actions
+
+    def run(self, stop_event=None):
+        while stop_event is None or not stop_event.is_set():
+            try:
+                self.step()
+            except Exception:
+                logger.exception("autoscaler step failed")
+            time.sleep(self.config.upscale_interval_s)

@@ -69,7 +69,8 @@ class RemoteFunction:
             resources=_resources_from_options(opts),
             max_retries=opts.get("max_retries", 3),
             scheduling=_scheduling_from_options(opts),
-            name=opts.get("name") or self._function.__name__)
+            name=opts.get("name") or self._function.__name__,
+            runtime_env=opts.get("runtime_env"))
         return refs[0] if num_returns == 1 else refs
 
     def options(self, **new_options) -> "RemoteFunction":

@@ -1,0 +1,101 @@
+"""ActorPool: load-balance tasks over a fixed set of actors (reference:
+python/ray/util/actor_pool.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+
+class ActorPool:
+    def __init__(self, actors: List):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._pending = []          # (fn, value) waiting for an actor
+        self._results_order = []    # submission-ordered futures
+
+    def submit(self, fn: Callable, value: Any):
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = actor
+            self._results_order.append(ref)
+        else:
+            self._pending.append((fn, value))
+
+    def _dispatch_pending(self):
+        while self._pending and self._idle:
+            fn, value = self._pending.pop(0)
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = actor
+            self._results_order.append(ref)
+
+    def has_next(self) -> bool:
+        return bool(self._results_order or self._pending)
+
+    def get_next(self, timeout=None):
+        import ray_tpu
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        self._dispatch_pending()
+        ref = self._results_order[0]
+        # a timeout must leave the future retrievable and the actor busy
+        # (reference behavior: ray.util.ActorPool keeps the future on
+        # TimeoutError); a task error consumes the future like a result
+        try:
+            value = ray_tpu.get(ref, timeout=timeout)
+        except TimeoutError:
+            raise
+        except Exception:
+            self._consume(ref)
+            raise
+        self._consume(ref)
+        return value
+
+    def _consume(self, ref):
+        if ref in self._results_order:
+            self._results_order.remove(ref)
+        actor = self._future_to_actor.pop(ref, None)
+        if actor is not None:
+            self._idle.append(actor)
+        self._dispatch_pending()
+
+    def get_next_unordered(self, timeout=None):
+        import ray_tpu
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        self._dispatch_pending()
+        ready, _ = ray_tpu.wait(list(self._results_order), num_returns=1,
+                                timeout=timeout)
+        if not ready:
+            raise TimeoutError("no result ready within timeout")
+        ref = ready[0]
+        try:
+            value = ray_tpu.get(ref)
+        except Exception:
+            self._consume(ref)
+            raise
+        self._consume(ref)
+        return value
+
+    def map(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def push(self, actor):
+        self._idle.append(actor)
+        self._dispatch_pending()
+
+    def pop_idle(self):
+        return self._idle.pop() if self._idle else None

@@ -1,0 +1,96 @@
+"""Distributed FIFO queue backed by an actor (reference:
+python/ray/util/queue.py)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        import collections
+        self.maxsize = maxsize
+        self.items = collections.deque()
+
+    def put(self, item) -> bool:
+        if self.maxsize > 0 and len(self.items) >= self.maxsize:
+            return False
+        self.items.append(item)
+        return True
+
+    def get(self):
+        if not self.items:
+            return False, None
+        return True, self.items.popleft()
+
+    def qsize(self) -> int:
+        return len(self.items)
+
+    def empty(self) -> bool:
+        return not self.items
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and len(self.items) >= self.maxsize
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        import ray_tpu
+        cls = ray_tpu.remote(_QueueActor)
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0.1)
+        opts.setdefault("max_concurrency", 4)
+        self.actor = cls.options(**opts).remote(maxsize)
+
+    def put(self, item, block: bool = True, timeout: Optional[float] = None):
+        import ray_tpu
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if ray_tpu.get(self.actor.put.remote(item), timeout=30):
+                return
+            if not block or (deadline and time.monotonic() > deadline):
+                raise Full()
+            time.sleep(0.02)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        import ray_tpu
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = ray_tpu.get(self.actor.get.remote(), timeout=30)
+            if ok:
+                return item
+            if not block or (deadline and time.monotonic() > deadline):
+                raise Empty()
+            time.sleep(0.02)
+
+    def put_nowait(self, item):
+        self.put(item, block=False)
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        import ray_tpu
+        return ray_tpu.get(self.actor.qsize.remote(), timeout=30)
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def __reduce__(self):
+        q = object.__new__(Queue)
+        return (_rebuild_queue, (self.actor,))
+
+
+def _rebuild_queue(actor):
+    q = object.__new__(Queue)
+    q.actor = actor
+    return q

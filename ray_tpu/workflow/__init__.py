@@ -1,0 +1,108 @@
+"""Workflow: durable DAG execution with step-level checkpointing
+(reference: python/ray/workflow/ — workflow_executor.py,
+workflow_storage.py). Steps run as tasks; each step's result persists
+under the workflow's storage dir, so a resumed run skips completed steps
+and continues where it crashed."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Any, Callable, Dict, List, Optional
+
+DEFAULT_STORAGE = os.path.expanduser("~/.ray_tpu_workflows")
+
+
+class StepNode:
+    def __init__(self, fn: Callable, args, kwargs, name: Optional[str] = None):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.name = name or fn.__name__
+
+    def _upstream(self):
+        return ([a for a in self.args if isinstance(a, StepNode)]
+                + [v for v in self.kwargs.values()
+                   if isinstance(v, StepNode)])
+
+
+class StepFunction:
+    def __init__(self, fn: Callable, name: Optional[str] = None):
+        self.fn = fn
+        self.name = name or fn.__name__
+
+    def bind(self, *args, **kwargs) -> StepNode:
+        return StepNode(self.fn, args, kwargs, self.name)
+
+    def options(self, name: Optional[str] = None) -> "StepFunction":
+        return StepFunction(self.fn, name or self.name)
+
+
+def step(fn: Callable = None, *, name: Optional[str] = None):
+    if fn is not None:
+        return StepFunction(fn)
+    return lambda f: StepFunction(f, name)
+
+
+def _topo(root: StepNode) -> List[StepNode]:
+    order, seen = [], set()
+
+    def visit(n):
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        for up in n._upstream():
+            visit(up)
+        order.append(n)
+
+    visit(root)
+    return order
+
+
+def _step_key(node: StepNode, index: int) -> str:
+    return f"{index:04d}_{node.name}"
+
+
+def run(root: StepNode, *, workflow_id: str,
+        storage: str = DEFAULT_STORAGE) -> Any:
+    """Execute the DAG durably; completed steps are skipped on re-run
+    (call run() again with the same workflow_id to resume)."""
+    import ray_tpu
+
+    wf_dir = os.path.join(storage, workflow_id)
+    os.makedirs(wf_dir, exist_ok=True)
+    order = _topo(root)
+    results: Dict[int, Any] = {}
+    for i, node in enumerate(order):
+        key = _step_key(node, i)
+        done_path = os.path.join(wf_dir, key + ".pkl")
+        if os.path.exists(done_path):
+            with open(done_path, "rb") as f:
+                results[id(node)] = pickle.load(f)
+            continue
+
+        def resolve(a):
+            return results[id(a)] if isinstance(a, StepNode) else a
+
+        args = [resolve(a) for a in node.args]
+        kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+        remote_fn = ray_tpu.remote(node.fn)
+        value = ray_tpu.get(remote_fn.remote(*args, **kwargs))
+        tmp = done_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, done_path)
+        results[id(node)] = value
+    return results[id(root)]
+
+
+def list_workflows(storage: str = DEFAULT_STORAGE) -> List[str]:
+    if not os.path.isdir(storage):
+        return []
+    return sorted(os.listdir(storage))
+
+
+def delete(workflow_id: str, storage: str = DEFAULT_STORAGE):
+    import shutil
+    shutil.rmtree(os.path.join(storage, workflow_id), ignore_errors=True)
