@@ -14,6 +14,13 @@ MODEL_REGISTRY = {
     "llama-7b": TransformerConfig(
         vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=32,
         d_ff=11008, max_seq_len=4096),
+    # MoE family (models/moe.py): expert-parallel over the mesh `expert` axis
+    "moe-debug": TransformerConfig(
+        vocab_size=1024, d_model=256, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=512, max_seq_len=512, n_experts=4, expert_top_k=2),
+    "mixtral-8x7b": TransformerConfig(
+        vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        d_ff=14336, max_seq_len=4096, n_experts=8, expert_top_k=2),
 }
 
 __all__ = ["TransformerConfig", "TransformerLM", "MODEL_REGISTRY",

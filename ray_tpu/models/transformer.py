@@ -46,6 +46,13 @@ class TransformerConfig:
     # "auto": flash kernel on 1 seq shard, ring attention when seq axis > 1
     attention_impl: str = "auto"
     seq_axis: str = "seq"
+    # Mixture-of-Experts: n_experts=0 means dense MLP in every block;
+    # n_experts>0 replaces every MLP with a top-k-routed expert layer
+    # (models/moe.py) sharded over the mesh's `expert` axis
+    n_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -134,18 +141,24 @@ class Block(nn.Module):
         cfg = self.cfg
         h = x + Attention(cfg, name="attn")(
             RMSNorm(cfg.norm_eps, cfg.dtype, name="attn_norm")(x), positions)
-        out = h + MLP(cfg, name="mlp")(
-            RMSNorm(cfg.norm_eps, cfg.dtype, name="mlp_norm")(h))
-        return out
+        normed = RMSNorm(cfg.norm_eps, cfg.dtype, name="mlp_norm")(h)
+        if cfg.n_experts > 0:
+            from ray_tpu.models.moe import MoEMLP
+            y, aux = MoEMLP(cfg, name="moe")(normed)
+        else:
+            y, aux = MLP(cfg, name="mlp")(normed), jnp.zeros((), jnp.float32)
+        return h + y, aux
 
 
 class ScanBlock(nn.Module):
-    """Block with a scan-compatible (carry, ys) signature."""
+    """Block with a scan-compatible (carry, ys) signature; ys carries the
+    per-layer MoE aux loss."""
     cfg: TransformerConfig
 
     @nn.compact
     def __call__(self, x, positions):
-        return Block(self.cfg, name="block")(x, positions), None
+        out, aux = Block(self.cfg, name="block")(x, positions)
+        return out, aux
 
 
 class TransformerLM(nn.Module):
@@ -176,15 +189,24 @@ class TransformerLM(nn.Module):
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="layers")
-            x, _ = stack(x, positions)
+            x, aux_per_layer = stack(x, positions)
+            aux_total = jnp.sum(aux_per_layer)
         else:
             block = Block
             if cfg.remat:
                 block = nn.remat(
                     Block, prevent_cse=False,
                     policy=jax.checkpoint_policies.nothing_saveable)
+            aux_total = jnp.zeros((), jnp.float32)
             for i in range(cfg.n_layers):
-                x = block(cfg, name=f"layer_{i}")(x, positions)
+                x, aux_i = block(cfg, name=f"layer_{i}")(x, positions)
+                aux_total = aux_total + aux_i
+        if cfg.n_experts > 0:
+            # surfaced to the train step via mutable=["losses"]; a no-op
+            # for callers that apply without that collection
+            self.sow("losses", "moe_aux", aux_total,
+                     reduce_fn=lambda a, b: a + b,
+                     init_fn=lambda: jnp.zeros((), jnp.float32))
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
         if cfg.tie_embeddings:
             logits = jnp.einsum("bld,vd->blv", x, embed.astype(cfg.dtype))

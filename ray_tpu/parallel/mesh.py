@@ -32,7 +32,7 @@ AXIS_TENSOR = "tensor"
 AXIS_SEQ = "seq"
 AXIS_EXPERT = "expert"
 
-MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR)
+MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSOR)
 
 
 @dataclasses.dataclass
@@ -41,6 +41,7 @@ class MeshConfig:
     'absorb all remaining devices'."""
     data: int = 1
     fsdp: int = -1
+    expert: int = 1
     seq: int = 1
     tensor: int = 1
 
@@ -64,7 +65,7 @@ class MeshConfig:
 
     @property
     def shape(self):
-        return (self.data, self.fsdp, self.seq, self.tensor)
+        return (self.data, self.fsdp, self.expert, self.seq, self.tensor)
 
 
 def make_mesh(config: Optional[MeshConfig] = None,
@@ -80,7 +81,7 @@ def make_mesh(config: Optional[MeshConfig] = None,
 
 def local_mesh() -> Mesh:
     """Single-host mesh over all visible devices on the fsdp axis."""
-    return make_mesh(MeshConfig(data=1, fsdp=-1, seq=1, tensor=1))
+    return make_mesh(MeshConfig(data=1, fsdp=-1, expert=1, seq=1, tensor=1))
 
 
 # ---------------------------------------------------------------- context

@@ -16,8 +16,8 @@ from typing import Dict, Optional, Sequence, Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ray_tpu.parallel.mesh import (AXIS_DATA, AXIS_FSDP, AXIS_SEQ,
-                                   AXIS_TENSOR)
+from ray_tpu.parallel.mesh import (AXIS_DATA, AXIS_EXPERT, AXIS_FSDP,
+                                   AXIS_SEQ, AXIS_TENSOR)
 
 # Default rule table: logical axis -> mesh axis (or None = replicated).
 # Embeddings/MLP widths shard over tensor; the long "model dim" rows shard
@@ -31,7 +31,7 @@ DEFAULT_RULES: Dict[str, Optional[object]] = {
     "kv_heads": AXIS_TENSOR,
     "head_dim": None,
     "mlp": AXIS_TENSOR,
-    "experts": None,                   # remapped to expert axis when MoE
+    "experts": AXIS_EXPERT,            # MoE expert-parallel axis
     "layers": None,                    # scan axis; stays replicated (pp later)
     None: None,
 }

@@ -153,17 +153,28 @@ def make_train_fns(model: nn.Module, optimizer,
 
     init_fn = jax.jit(init_state, out_shardings=shardings)
 
+    model_cfg = getattr(model, "cfg", None)
+    is_moe = bool(getattr(model_cfg, "n_experts", 0))
+    aux_coef = float(getattr(model_cfg, "router_aux_coef", 0.0) or 0.0)
+
     def loss_fn(params, tokens, mask):
         inputs = tokens[:, :-1]
         targets = tokens[:, 1:]
-        logits = model.apply({"params": params}, inputs)
-        loss, denom = cross_entropy_loss(
+        if is_moe:
+            logits, var = model.apply({"params": params}, inputs,
+                                      mutable=["losses"])
+            aux = sum(jax.tree.leaves(var.get("losses", {})),
+                      jnp.zeros((), jnp.float32))
+        else:
+            logits = model.apply({"params": params}, inputs)
+            aux = jnp.zeros((), jnp.float32)
+        ce, denom = cross_entropy_loss(
             logits, targets, None if mask is None else mask[:, 1:])
-        return loss, denom
+        return ce + aux_coef * aux, (denom, ce, aux)
 
     def step_fn(state: TrainState, tokens, mask=None):
         with use_mesh(mesh):
-            (loss, denom), grads = jax.value_and_grad(
+            (loss, (denom, ce, aux)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state.params, tokens, mask)
         updates, new_opt = optimizer.update(grads, state.opt_state,
                                             params=state.params)
@@ -171,8 +182,8 @@ def make_train_fns(model: nn.Module, optimizer,
         gnorm = optax.global_norm(grads)
         new_state = TrainState(step=state.step + 1, params=new_params,
                                opt_state=new_opt)
-        return new_state, {"loss": loss, "grad_norm": gnorm,
-                           "tokens": denom}
+        return new_state, {"loss": ce, "total_loss": loss, "moe_aux": aux,
+                           "grad_norm": gnorm, "tokens": denom}
 
     jit_step = jax.jit(
         step_fn,
