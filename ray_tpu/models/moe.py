@@ -25,11 +25,8 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from ray_tpu.models.transformer import _p
 from ray_tpu.parallel.sharding import constrain
-
-
-def _p(init, *logical_axes):
-    return nn.with_partitioning(init, logical_axes)
 
 
 class MoEMLP(nn.Module):

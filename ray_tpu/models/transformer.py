@@ -59,9 +59,29 @@ class TransformerConfig:
         return self.d_model // self.n_heads
 
 
+_PARTITION_OFF = __import__("threading").local()
+
+
 def _p(init, *logical_axes):
-    """Attach logical-axis metadata to a param initializer."""
+    """Attach logical-axis metadata to a param initializer (suppressed
+    inside `unpartitioned_params`, e.g. for shard_map pipeline stages
+    where logical names must not reach the physical mesh)."""
+    if getattr(_PARTITION_OFF, "off", False):
+        return init
     return nn.with_partitioning(init, logical_axes)
+
+
+class unpartitioned_params:
+    """Context: create/apply model params without flax partitioning boxes.
+    Used by pipeline-parallel stages (parallel/pipeline.py), whose params
+    are sharded explicitly over the `stage` axis by shard_map in_specs."""
+
+    def __enter__(self):
+        _PARTITION_OFF.off = True
+        return self
+
+    def __exit__(self, *exc):
+        _PARTITION_OFF.off = False
 
 
 class RMSNorm(nn.Module):

@@ -14,6 +14,7 @@ Axis convention (scaling-book style):
   tensor  — megatron-style tensor parallel (activations all-reduce)
   seq     — sequence/context parallel (ring attention over this axis)
   expert  — MoE expert parallel
+  stage   — pipeline parallel (GPipe microbatch schedule, parallel/pipeline.py)
 """
 
 from __future__ import annotations
@@ -31,8 +32,9 @@ AXIS_FSDP = "fsdp"
 AXIS_TENSOR = "tensor"
 AXIS_SEQ = "seq"
 AXIS_EXPERT = "expert"
+AXIS_STAGE = "stage"
 
-MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSOR)
+MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_STAGE, AXIS_SEQ, AXIS_TENSOR)
 
 
 @dataclasses.dataclass
@@ -42,6 +44,7 @@ class MeshConfig:
     data: int = 1
     fsdp: int = -1
     expert: int = 1
+    stage: int = 1
     seq: int = 1
     tensor: int = 1
 
@@ -65,7 +68,7 @@ class MeshConfig:
 
     @property
     def shape(self):
-        return (self.data, self.fsdp, self.expert, self.seq, self.tensor)
+        return (self.data, self.fsdp, self.expert, self.stage, self.seq, self.tensor)
 
 
 def make_mesh(config: Optional[MeshConfig] = None,
@@ -81,7 +84,7 @@ def make_mesh(config: Optional[MeshConfig] = None,
 
 def local_mesh() -> Mesh:
     """Single-host mesh over all visible devices on the fsdp axis."""
-    return make_mesh(MeshConfig(data=1, fsdp=-1, expert=1, seq=1, tensor=1))
+    return make_mesh(MeshConfig(data=1, fsdp=-1))
 
 
 # ---------------------------------------------------------------- context

@@ -1,0 +1,107 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the `stage` mesh axis.
+
+The reference expresses pipeline parallelism only through compiled DAGs —
+multi-actor pipelines wired with NCCL P2P channels and a static execution
+schedule (reference: python/ray/dag/compiled_dag_node.py:549,
+experimental/channel/torch_tensor_nccl_channel.py, schedule in
+dag/dag_node_operation.py). The TPU-native equivalent keeps the whole
+pipeline inside ONE jitted SPMD program: stage weights are sharded over the
+`stage` mesh axis, activations hop stage→stage via `lax.ppermute` (ICI
+neighbor transfers), and the GPipe tick loop is a `lax.scan`. XLA overlaps
+the ppermute with the next tick's compute; there are no per-hop host round
+trips to hide, which is precisely why the µs-scale channel machinery of the
+reference is unnecessary here.
+
+Schedule (S stages, M microbatches, T = M + S - 1 ticks):
+
+    tick t: stage s computes microbatch (t - s) if 0 <= t - s < M
+            then shifts its output to stage s+1
+
+Bubble fraction = (S-1)/T, the classic GPipe overhead; amortize with M >> S.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import AXIS_STAGE
+
+
+def stack_stage_params(per_stage_params: list):
+    """Stack a list of per-stage param pytrees into one tree with a leading
+    stage dim (shard it over `stage` with stage_param_specs)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def stage_param_specs(stacked_params, stage_axis: str = AXIS_STAGE):
+    """PartitionSpecs sharding the leading (stage) dim of every leaf."""
+    return jax.tree.map(
+        lambda a: P(stage_axis, *([None] * (a.ndim - 1))), stacked_params)
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stacked_params,
+                   microbatches: jax.Array,
+                   mesh: Mesh,
+                   stage_axis: str = AXIS_STAGE) -> jax.Array:
+    """Run `stage_fn` as an S-stage GPipe pipeline.
+
+    stage_fn(params_s, x) -> y must preserve the activation shape (the
+    classic homogeneous-stage pipeline; embed/unembed live outside).
+
+    stacked_params: pytree with leading dim S (see stack_stage_params),
+        sharded over `stage_axis`.
+    microbatches: [M, mb, ...] — M microbatches.
+    Returns [M, mb, ...] outputs of the final stage.
+
+    Differentiable: grads flow back through the ppermute chain (XLA emits
+    the reverse permutes), so this composes with jax.grad/value_and_grad.
+    """
+    S = mesh.shape[stage_axis]
+    M = microbatches.shape[0]
+    T = M + S - 1
+
+    p_specs = stage_param_specs(stacked_params, stage_axis)
+    x_spec = P(*([None] * microbatches.ndim))
+
+    def per_stage(params, xs):
+        # params leaves arrive as [1, ...] (their stage shard); drop the dim
+        params = jax.tree.map(lambda a: a[0], params)
+        s = lax.axis_index(stage_axis)
+
+        def tick(carry, t):
+            prev_out = carry                       # activation shifted in
+            mb_idx = jnp.clip(t, 0, M - 1)
+            fresh = lax.dynamic_index_in_dim(xs, mb_idx, 0, keepdims=False)
+            my_in = jnp.where(s == 0, fresh, prev_out)
+            out = stage_fn(params, my_in)
+            shifted = lax.ppermute(
+                out, stage_axis, [(i, (i + 1) % S) for i in range(S)])
+            return shifted, out
+
+        _, outs = lax.scan(tick, jnp.zeros_like(xs[0]), jnp.arange(T))
+        # outs[t] on stage s is microbatch (t - s): slice my M valid ticks
+        mine = lax.dynamic_slice_in_dim(outs, s, M, axis=0)
+        return mine[None]                          # [1, M, mb, ...]
+
+    y = shard_map(per_stage, mesh=mesh,
+                  in_specs=(p_specs, x_spec),
+                  out_specs=P(stage_axis),
+                  check_rep=False)(stacked_params, microbatches)
+    # y: [S, M, mb, ...]; the final stage's row is the pipeline output
+    return y[-1]
+
+
+def make_pipeline_fns(stage_fn: Callable, mesh: Mesh,
+                      stage_axis: str = AXIS_STAGE):
+    """Convenience: returns apply(params, microbatches) closed over mesh."""
+    def apply(stacked_params, microbatches):
+        return pipeline_apply(stage_fn, stacked_params, microbatches,
+                              mesh, stage_axis)
+    return apply

@@ -1,0 +1,116 @@
+"""Pipeline-parallel tests on the 8-device virtual CPU mesh: GPipe
+schedule exactness vs sequential execution, gradient equivalence, and a
+pipelined transformer-block stack with a training step (reference
+counterpart: compiled-DAG pipelines, python/ray/dag/compiled_dag_node.py:549;
+here the schedule is a lax.scan + ppermute inside one SPMD program)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from ray_tpu.models.transformer import (Block, TransformerConfig,
+                                        unpartitioned_params)
+from ray_tpu.parallel import MeshConfig, make_mesh
+from ray_tpu.parallel.pipeline import (pipeline_apply, stack_stage_params,
+                                       stage_param_specs)
+
+S, M, MB, D = 4, 8, 2, 16
+
+
+def _mlp_stage(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _mlp_params():
+    ks = jax.random.split(jax.random.PRNGKey(0), S)
+    return [{"w": jax.random.normal(k, (D, D)) * 0.5, "b": jnp.zeros((D,))}
+            for k in ks]
+
+
+def test_pipeline_forward_matches_sequential():
+    mesh = make_mesh(MeshConfig(data=1, fsdp=2, stage=S))
+    per_stage = _mlp_params()
+    x = jax.random.normal(jax.random.PRNGKey(1), (M, MB, D))
+    y = pipeline_apply(_mlp_stage, stack_stage_params(per_stage), x, mesh)
+    ref = x
+    for p in per_stage:
+        ref = jax.vmap(lambda xx, p=p: _mlp_stage(p, xx))(ref)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_grads_match_sequential():
+    mesh = make_mesh(MeshConfig(data=1, fsdp=2, stage=S))
+    per_stage = _mlp_params()
+    stacked = stack_stage_params(per_stage)
+    x = jax.random.normal(jax.random.PRNGKey(1), (M, MB, D))
+
+    g_pp = jax.grad(
+        lambda p: pipeline_apply(_mlp_stage, p, x, mesh).sum())(stacked)
+
+    def seq_loss(params_list):
+        r = x
+        for p in params_list:
+            r = jax.vmap(lambda xx, p=p: _mlp_stage(p, xx))(r)
+        return r.sum()
+
+    g_seq = stack_stage_params(jax.grad(seq_loss)(per_stage))
+    for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_pipelined_transformer_blocks_train_step():
+    """2-stage pipeline of real transformer Blocks + embed/unembed outside;
+    one adamw step must run and reduce loss over a few iterations."""
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+        d_ff=64, dtype=jnp.float32, param_dtype=jnp.float32,
+        scan_layers=False, remat=False)
+    n_stage, n_mb, mb, L = 2, 4, 2, 16
+    mesh = make_mesh(MeshConfig(data=1, fsdp=4, stage=n_stage))
+    block = Block(cfg)
+    positions = jnp.broadcast_to(jnp.arange(L)[None, :], (mb, L))
+
+    def stage_fn(p, x):
+        with unpartitioned_params():   # trace-time: no logical-axis boxes
+            out, _aux = block.apply({"params": p}, x, positions)
+        return out
+
+    x0 = jnp.zeros((mb, L, cfg.d_model), jnp.float32)
+    with unpartitioned_params():
+        stages = [block.init(jax.random.PRNGKey(i), x0, positions)["params"]
+                  for i in range(n_stage)]
+    params = {
+        "embed": jax.random.normal(jax.random.PRNGKey(9),
+                                   (cfg.vocab_size, cfg.d_model)) * 0.02,
+        "stages": stack_stage_params(stages),
+    }
+    tokens = jax.random.randint(jax.random.PRNGKey(10),
+                                (n_mb, mb, L + 1), 0, cfg.vocab_size)
+    opt = optax.adamw(3e-3)
+    opt_state = opt.init(params)
+
+    def loss_fn(params):
+        inp, tgt = tokens[..., :-1], tokens[..., 1:]
+        h = params["embed"][inp]                       # [M, mb, L, D]
+        h = pipeline_apply(stage_fn, params["stages"], h, mesh)
+        logits = jnp.einsum("mbld,vd->mblv", h, params["embed"])
+        logz = jax.nn.logsumexp(logits, -1)
+        gold = jnp.take_along_axis(logits, tgt[..., None], -1)[..., 0]
+        return (logz - gold).mean()
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params=params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
