@@ -32,9 +32,13 @@ class Algorithm:
         self.env_runners = [
             runner_cls.remote({**cfg_dict, "runner_index": i})
             for i in range(config.num_env_runners)]
-        self.learner_group = LearnerGroup(cfg_dict, obs_dim, action_dim)
+        self._build_learner(cfg_dict, obs_dim, action_dim)
         self.iteration = 0
         self._sync_weights()
+
+    def _build_learner(self, cfg_dict, obs_dim, action_dim):
+        from ray_tpu.rl.learner import LearnerGroup
+        self.learner_group = LearnerGroup(cfg_dict, obs_dim, action_dim)
 
     def _sync_weights(self):
         import ray_tpu

@@ -1,0 +1,224 @@
+"""DQN: off-policy Q-learning with replay and a target network
+(reference: rllib/algorithms/dqn/ — double-DQN target, epsilon-greedy
+exploration; the Q update is one jitted function, target sync by period).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.replay_buffer import ReplayBuffer
+
+
+class QEnvRunner:
+    """Epsilon-greedy transition collector over gym vector envs."""
+
+    def __init__(self, config: Dict):
+        import gymnasium as gym
+        self.cfg = config
+        self.n_envs = config["num_envs_per_env_runner"]
+        self.envs = gym.vector.SyncVectorEnv(
+            [lambda: gym.make(config["env"], **config.get("env_config", {}))
+             for _ in range(self.n_envs)])
+        obs_dim = int(np.prod(self.envs.single_observation_space.shape))
+        self.action_dim = self.envs.single_action_space.n
+        from ray_tpu.rl.dqn import QNet   # self-import for actor pickling
+        import jax
+        import jax.numpy as jnp
+        self.net = QNet(self.action_dim,
+                        tuple(config.get("hidden_sizes", (64, 64))))
+        self.params = self.net.init(
+            jax.random.PRNGKey(config.get("seed", 0)),
+            jnp.zeros((1, obs_dim)))["params"]
+        self._q = jax.jit(lambda p, o: self.net.apply({"params": p}, o))
+        self.rng = np.random.default_rng(
+            config.get("seed", 0) + config.get("runner_index", 0) * 1000)
+        self.obs, _ = self.envs.reset(
+            seed=config.get("seed", 0) + config.get("runner_index", 0))
+        self._episode_returns = []
+        self._running_returns = np.zeros(self.n_envs)
+
+    def set_weights(self, weights):
+        import jax
+        self.params = jax.device_put(weights)
+        return True
+
+    def sample(self, num_steps: Optional[int] = None,
+               epsilon: float = 0.1) -> Dict[str, np.ndarray]:
+        T = num_steps or self.cfg["rollout_fragment_length"]
+        N = self.n_envs
+        obs_b, act_b, rew_b, done_b, next_b = [], [], [], [], []
+        obs = self.obs
+        for _ in range(T):
+            q = np.asarray(self._q(self.params, obs.astype(np.float32)))
+            greedy = q.argmax(-1)
+            random_a = self.rng.integers(0, self.action_dim, N)
+            explore = self.rng.random(N) < epsilon
+            action = np.where(explore, random_a, greedy)
+            nxt, rew, term, trunc, _ = self.envs.step(action)
+            done = np.logical_or(term, trunc)
+            obs_b.append(obs.copy())
+            act_b.append(action)
+            rew_b.append(rew)
+            # bootstrap through time-limit truncation, not termination
+            done_b.append(term.astype(np.float32))
+            next_b.append(nxt.copy())
+            self._running_returns += rew
+            for i, d in enumerate(done):
+                if d:
+                    self._episode_returns.append(self._running_returns[i])
+                    self._running_returns[i] = 0.0
+            obs = nxt
+        self.obs = obs
+        cat = lambda xs: np.concatenate(xs, 0)  # noqa: E731
+        return {"obs": cat(obs_b).astype(np.float32),
+                "actions": cat(act_b).astype(np.int64),
+                "rewards": cat(rew_b).astype(np.float32),
+                "dones": cat(done_b).astype(np.float32),
+                "next_obs": cat(next_b).astype(np.float32)}
+
+    def get_metrics(self) -> Dict:
+        return {"episode_return_mean":
+                float(np.mean(self._episode_returns[-20:]))
+                if self._episode_returns else None,
+                "num_episodes": len(self._episode_returns)}
+
+
+def QNet(action_dim: int, hidden_sizes: Sequence[int]):
+    from flax import linen as nn
+
+    class _QNet(nn.Module):
+        @nn.compact
+        def __call__(self, obs):
+            x = obs
+            for h in hidden_sizes:
+                x = nn.relu(nn.Dense(h)(x))
+            return nn.Dense(action_dim)(x)
+
+    return _QNet()
+
+
+class DQN:
+    """Driver: epsilon-annealed sampling into a replay buffer, double-DQN
+    updates, periodic target sync."""
+
+    def __init__(self, config: AlgorithmConfig):
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        import optax
+        import ray_tpu
+
+        self.config = config
+        cfg = dataclasses.asdict(config)
+        probe = gym.make(config.env, **config.env_config)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        action_dim = probe.action_space.n
+        probe.close()
+
+        runner_cls = ray_tpu.remote(QEnvRunner)
+        self.env_runners = [runner_cls.remote({**cfg, "runner_index": i})
+                            for i in range(config.num_env_runners)]
+        self.buffer = ReplayBuffer(cfg.get("replay_capacity", 50_000),
+                                   seed=config.seed)
+        self.net = QNet(action_dim, tuple(config.hidden_sizes))
+        self.params = self.net.init(jax.random.PRNGKey(config.seed),
+                                    jnp.zeros((1, obs_dim)))["params"]
+        self.target_params = self.params
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        gamma = config.gamma
+        net = self.net
+
+        def loss_fn(params, target_params, batch):
+            q = net.apply({"params": params}, batch["obs"])
+            q_a = jnp.take_along_axis(
+                q, batch["actions"][:, None], 1)[:, 0]
+            q_next_online = net.apply({"params": params}, batch["next_obs"])
+            best = q_next_online.argmax(-1)
+            q_next_tgt = net.apply({"params": target_params},
+                                   batch["next_obs"])
+            q_best = jnp.take_along_axis(q_next_tgt, best[:, None], 1)[:, 0]
+            target = batch["rewards"] + gamma * (1 - batch["dones"]) \
+                * jax.lax.stop_gradient(q_best)
+            td = q_a - target
+            return (td ** 2).mean()
+
+        @jax.jit
+        def update(params, target_params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, target_params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._update = update
+        self.iteration = 0
+        self._grad_steps = 0
+        self.epsilon = 1.0
+        self._sync_runner_weights()
+
+    def _sync_runner_weights(self):
+        import jax
+        import ray_tpu
+        ref = ray_tpu.put(jax.device_get(self.params))
+        ray_tpu.get([r.set_weights.remote(ref) for r in self.env_runners],
+                    timeout=300)
+
+    def training_step(self) -> Dict:
+        import jax.numpy as jnp
+        import ray_tpu
+        cfg = self.config
+        t0 = time.perf_counter()
+        batches = ray_tpu.get(
+            [r.sample.remote(epsilon=self.epsilon)
+             for r in self.env_runners], timeout=600)
+        steps = 0
+        for b in batches:
+            self.buffer.add(b)
+            steps += len(b["obs"])
+        self.epsilon = max(0.05, self.epsilon * 0.95)
+
+        loss = float("nan")
+        if len(self.buffer) >= cfg.minibatch_size:
+            for _ in range(cfg.num_epochs * 4):
+                mb = self.buffer.sample(cfg.minibatch_size)
+                mb = {k: jnp.asarray(v) for k, v in mb.items()}
+                self.params, self.opt_state, loss = self._update(
+                    self.params, self.target_params, self.opt_state, mb)
+                self._grad_steps += 1
+                if self._grad_steps % 100 == 0:
+                    self.target_params = self.params
+            loss = float(loss)
+        self._sync_runner_weights()
+        wall = time.perf_counter() - t0
+        runner_metrics = ray_tpu.get(
+            [r.get_metrics.remote() for r in self.env_runners], timeout=120)
+        returns = [m["episode_return_mean"] for m in runner_metrics
+                   if m["episode_return_mean"] is not None]
+        return {"episode_return_mean":
+                float(np.mean(returns)) if returns else None,
+                "num_env_steps_sampled": steps,
+                "env_steps_per_s": steps / max(1e-9, wall),
+                "td_loss": loss, "epsilon": self.epsilon,
+                "replay_size": len(self.buffer)}
+
+    def train(self) -> Dict:
+        self.iteration += 1
+        out = self.training_step()
+        out["training_iteration"] = self.iteration
+        return out
+
+    def stop(self):
+        import ray_tpu
+        for r in self.env_runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self.env_runners = []

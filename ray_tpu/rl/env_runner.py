@@ -94,6 +94,48 @@ class EnvRunner:
                 "logp": flat(logp_buf), "advantages": flat(adv),
                 "value_targets": flat(targets)}
 
+    def sample_trajectory(self, num_steps: Optional[int] = None
+                          ) -> Dict[str, np.ndarray]:
+        """Time-major fragment [T, N, ...] with behavior log-probs and a
+        bootstrap value — the shape V-trace consumes (IMPALA path; the
+        reference's equivalent is the env-runner → aggregator episode flow,
+        rllib/algorithms/impala/impala.py)."""
+        import jax
+        T = num_steps or self.cfg["rollout_fragment_length"]
+        N = self.n_envs
+        obs_buf = np.zeros((T, N) + self.obs.shape[1:], np.float32)
+        act_buf = np.zeros((T, N), np.int64)
+        logp_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+
+        obs = self.obs
+        for t in range(T):
+            self.rng, key = jax.random.split(self.rng)
+            action, logp, _value = self.module.sample_actions(
+                self.module.params, obs.astype(np.float32), key)
+            nxt, rew, term, trunc, _ = self.envs.step(action)
+            done = np.logical_or(term, trunc)
+            obs_buf[t] = obs
+            act_buf[t] = action
+            logp_buf[t] = logp
+            rew_buf[t] = rew
+            done_buf[t] = done.astype(np.float32)
+            self._running_returns += rew
+            for i, d in enumerate(done):
+                if d:
+                    self._episode_returns.append(self._running_returns[i])
+                    self._running_returns[i] = 0.0
+            obs = nxt
+        self.obs = obs
+        _, last_val = self.module.forward(self.module.params,
+                                          obs.astype(np.float32))
+        return {"obs": obs_buf, "actions": act_buf,
+                "behavior_logp": logp_buf, "rewards": rew_buf,
+                "dones": done_buf,
+                "bootstrap_obs": np.asarray(obs, np.float32),
+                "bootstrap_value": np.asarray(last_val, np.float32)}
+
     def get_metrics(self) -> Dict:
         out = {"episode_return_mean":
                float(np.mean(self._episode_returns[-20:]))

@@ -1,0 +1,141 @@
+"""IMPALA: async actor-learner RL with V-trace off-policy correction
+(reference: rllib/algorithms/impala/impala.py — async EnvRunner sampling
+with aggregator-style batching :617, vtrace loss; re-designed: the learner
+update is one jitted function and asynchrony comes from `ray_tpu.wait`
+over in-flight sample futures rather than dedicated aggregator actors —
+re-issue a runner's next fragment before learning on its last one)."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+
+
+class ImpalaLearner:
+    """Policy-gradient learner with a V-trace-corrected baseline."""
+
+    def __init__(self, config: Dict, obs_dim: int, action_dim: int):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from ray_tpu.rl.rl_module import DiscreteRLModule
+        from ray_tpu.rl.vtrace import vtrace
+
+        self.cfg = config
+        self.module = DiscreteRLModule(obs_dim, action_dim,
+                                       config.get("hidden_sizes", (64, 64)),
+                                       seed=config.get("seed", 0))
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(config.get("grad_clip", 40.0)),
+            optax.rmsprop(config["lr"], decay=0.99, eps=0.1))
+        self.opt_state = self.optimizer.init(self.module.params)
+        gamma = config["gamma"]
+        vf_coeff = config["vf_loss_coeff"]
+        ent_coeff = config["entropy_coeff"]
+        net = self.module.net
+
+        def loss_fn(params, batch):
+            T, B = batch["actions"].shape
+            obs = batch["obs"].reshape((T * B,) + batch["obs"].shape[2:])
+            logits, values = net.apply({"params": params}, obs)
+            logits = logits.reshape(T, B, -1)
+            values = values.reshape(T, B)
+            logp_all = jax.nn.log_softmax(logits)
+            tgt_logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+            discounts = gamma * (1.0 - batch["dones"])
+            vt = vtrace(batch["behavior_logp"], tgt_logp,
+                        batch["rewards"], discounts, values,
+                        batch["bootstrap_value"])
+            pg_loss = -(tgt_logp * vt.pg_advantages).mean()
+            vf_loss = ((values - vt.vs) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, new_opt = self.optimizer.update(grads, opt_state,
+                                                     params)
+            return optax.apply_updates(params, updates), new_opt, loss, aux
+
+        self._update = update
+
+    def update_from_trajectory(self, traj: Dict[str, np.ndarray]) -> Dict:
+        import jax.numpy as jnp
+        batch = {k: jnp.asarray(v) for k, v in traj.items()
+                 if k != "bootstrap_obs"}
+        self.module.params, self.opt_state, loss, aux = self._update(
+            self.module.params, self.opt_state, batch)
+        out = {k: float(v) for k, v in aux.items()}
+        out["total_loss"] = float(loss)
+        return out
+
+    def get_weights(self):
+        return self.module.get_weights()
+
+
+class IMPALA(Algorithm):
+    """Async training_step: learn on fragments as they complete, re-issue
+    sampling immediately, sync weights after every learner step."""
+
+    def __init__(self, config: AlgorithmConfig):
+        self._inflight: Dict = {}
+        super().__init__(config)
+
+    def _build_learner(self, cfg_dict, obs_dim, action_dim):
+        self.learner = ImpalaLearner(cfg_dict, obs_dim, action_dim)
+
+    def _sync_weights(self):
+        import ray_tpu
+        ref = ray_tpu.put(self.learner.get_weights())
+        ray_tpu.get([r.set_weights.remote(ref) for r in self.env_runners],
+                    timeout=300)
+
+    def training_step(self) -> Dict:
+        import ray_tpu
+        t0 = time.perf_counter()
+        if not self._inflight:
+            for r in self.env_runners:
+                self._inflight[r.sample_trajectory.remote()] = r
+
+        n_updates = 0
+        steps = 0
+        metrics: Dict = {}
+        # learn on a full round of fragments, keeping the pipe full
+        target = len(self.env_runners)
+        while n_updates < target:
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                    timeout=600)
+            ref = ready[0]
+            runner = self._inflight.pop(ref)
+            traj = ray_tpu.get(ref)
+            # re-issue before learning: sampling overlaps the update
+            self._inflight[runner.sample_trajectory.remote()] = runner
+            metrics = self.learner.update_from_trajectory(traj)
+            steps += traj["actions"].size
+            n_updates += 1
+        self._sync_weights()
+        wall = time.perf_counter() - t0
+        runner_metrics = ray_tpu.get(
+            [r.get_metrics.remote() for r in self.env_runners], timeout=120)
+        returns = [m["episode_return_mean"] for m in runner_metrics
+                   if m["episode_return_mean"] is not None]
+        return {
+            "episode_return_mean":
+                float(np.mean(returns)) if returns else None,
+            "num_env_steps_sampled": steps,
+            "env_steps_per_s": steps / max(1e-9, wall),
+            **metrics,
+        }
+
+    def get_weights(self):
+        return self.learner.get_weights()

@@ -1,0 +1,44 @@
+"""Uniform transition replay buffer (reference:
+rllib/utils/replay_buffers/ — the new-stack EpisodeReplayBuffer role,
+simplified to flat transition storage in preallocated numpy rings)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = capacity
+        self._store: Optional[Dict[str, np.ndarray]] = None
+        self._idx = 0
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add(self, batch: Dict[str, np.ndarray]) -> None:
+        """Add a batch of transitions {key: [N, ...]}."""
+        n = len(next(iter(batch.values())))
+        if self._store is None:
+            self._store = {
+                k: np.zeros((self.capacity,) + v.shape[1:], v.dtype)
+                for k, v in batch.items()}
+        i = self._idx
+        if i + n <= self.capacity:
+            for k, v in batch.items():
+                self._store[k][i:i + n] = v
+        else:
+            first = self.capacity - i
+            for k, v in batch.items():
+                self._store[k][i:] = v[:first]
+                self._store[k][:n - first] = v[first:]
+        self._idx = (i + n) % self.capacity
+        self._size = min(self.capacity, self._size + n)
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self._size, batch_size)
+        return {k: v[idx] for k, v in self._store.items()}
