@@ -85,8 +85,15 @@ class ActorHandle:
         try:
             import ray_tpu
             if ray_tpu.is_initialized():
-                ray_tpu._get_worker().kill_actor(self._actor_id,
-                                                 no_restart=True)
+                # fire-and-forget: __del__ can run via GC on ANY thread —
+                # including the worker's own event-loop thread (e.g. during
+                # cloudpickle of a task argument) — so a blocking bridge
+                # here deadlocks the loop on itself
+                w = ray_tpu._get_worker()
+                import asyncio
+                asyncio.run_coroutine_threadsafe(
+                    w.core.kill_actor_async(self._actor_id, no_restart=True),
+                    w.core.loop)
         except Exception:
             pass
 

@@ -1,9 +1,12 @@
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     MedianStoppingRule,
+                                     PopulationBasedTraining)
 from ray_tpu.tune.search import (choice, grid_search, loguniform, randint,
                                  uniform)
 from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner,
-                                report)
+                                get_checkpoint, report)
 
 __all__ = ["Tuner", "TuneConfig", "ResultGrid", "TrialResult", "report",
-           "grid_search", "choice", "uniform", "loguniform", "randint",
-           "ASHAScheduler", "FIFOScheduler"]
+           "get_checkpoint", "grid_search", "choice", "uniform",
+           "loguniform", "randint", "ASHAScheduler", "FIFOScheduler",
+           "MedianStoppingRule", "PopulationBasedTraining"]

@@ -16,21 +16,25 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
-from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.schedulers import CONTINUE, EXPLOIT, STOP, FIFOScheduler
 from ray_tpu.tune.search import BasicVariantGenerator
 
 _tune_session = None
 
 
 class _TuneSession:
-    def __init__(self):
+    def __init__(self, checkpoint=None, start_iteration: int = 0):
         self.results: List[Dict] = []
         self.lock = threading.Lock()
-        self.iteration = 0
+        self.iteration = start_iteration
+        self.incoming_checkpoint = checkpoint   # restore source (PBT/resume)
+        self.latest_checkpoint = checkpoint
 
-    def report(self, metrics: Dict):
+    def report(self, metrics: Dict, checkpoint=None):
         with self.lock:
             self.iteration += 1
+            if checkpoint is not None:
+                self.latest_checkpoint = checkpoint
             self.results.append({**metrics,
                                  "training_iteration": self.iteration})
 
@@ -41,19 +45,27 @@ class _TuneSession:
             return out
 
 
-def report(metrics: Optional[Dict] = None, **kwargs):
+def report(metrics: Optional[Dict] = None, checkpoint=None, **kwargs):
     s = _tune_session
     if s is None:
         raise RuntimeError("tune.report() called outside a trial")
-    s.report({**(metrics or {}), **kwargs})
+    s.report({**(metrics or {}), **kwargs}, checkpoint=checkpoint)
+
+
+def get_checkpoint():
+    """Inside a trial: the checkpoint this trial was (re)started from —
+    set when PBT exploits another trial or on restore (reference:
+    ray.tune.get_checkpoint)."""
+    s = _tune_session
+    return s.incoming_checkpoint if s is not None else None
 
 
 class TrialActor:
     """Hosts one trial; max_concurrency=2 so poll() answers during run()."""
 
-    def __init__(self):
+    def __init__(self, checkpoint=None, start_iteration: int = 0):
         global _tune_session
-        _tune_session = _TuneSession()
+        _tune_session = _TuneSession(checkpoint, start_iteration)
         self._session = _tune_session
 
     def run(self, fn, config):
@@ -62,6 +74,9 @@ class TrialActor:
 
     def poll(self):
         return self._session.drain()
+
+    def get_checkpoint(self):
+        return self._session.latest_checkpoint
 
 
 @dataclasses.dataclass
@@ -150,6 +165,23 @@ class Tuner:
                 running[trial_id] = {"actor": actor, "config": cfg,
                                      "run_ref": run_ref, "history": [],
                                      "stopped": False}
+
+            def restart_trial(trial_id, t, new_config, checkpoint):
+                """PBT exploit: replace the trial's actor, resuming from
+                `checkpoint` with the mutated config."""
+                try:
+                    ray_tpu.kill(t["actor"])
+                except Exception:
+                    pass
+                it = t["history"][-1]["training_iteration"] \
+                    if t["history"] else 0
+                actor = actor_cls.options(
+                    max_concurrency=2,
+                    resources=dict(self.resources_per_trial)).remote(
+                        checkpoint=checkpoint, start_iteration=it)
+                t["actor"] = actor
+                t["config"] = new_config
+                t["run_ref"] = actor.run.remote(self.trainable, new_config)
             time.sleep(0.15)
             for trial_id, t in list(running.items()):
                 try:
@@ -163,6 +195,21 @@ class Tuner:
                     d = scheduler.on_result(trial_id, r)
                     if d == STOP:
                         decision = STOP
+                    elif isinstance(d, tuple) and d and d[0] == EXPLOIT:
+                        decision = d
+                if (isinstance(decision, tuple) and decision[0] == EXPLOIT
+                        and decision[1] in running):
+                    src = running[decision[1]]
+                    try:
+                        ckpt = ray_tpu.get(
+                            src["actor"].get_checkpoint.remote(), timeout=30)
+                    except Exception:
+                        ckpt = None
+                    new_cfg = scheduler.explore(dict(src["config"])) \
+                        if hasattr(scheduler, "explore") \
+                        else dict(src["config"])
+                    restart_trial(trial_id, t, new_cfg, ckpt)
+                    continue
                 if decision == STOP and not t["stopped"]:
                     t["stopped"] = True
                     ray_tpu.kill(t["actor"])
@@ -181,6 +228,13 @@ class Tuner:
                         for r in ray_tpu.get(t["actor"].poll.remote(),
                                              timeout=10):
                             t["history"].append(r)
+                    except Exception:
+                        pass
+                    # release the trial's CPU reservation promptly — GC of
+                    # the handle would get there eventually, but later
+                    # trials in this fit() need the slot now
+                    try:
+                        ray_tpu.kill(t["actor"])
                     except Exception:
                         pass
                     done.append(self._finish(trial_id, t, err))

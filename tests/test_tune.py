@@ -99,3 +99,62 @@ def test_trial_error_captured(ray_start):
     oks = [r for r in results if not r.error]
     assert len(errs) == 1 and "exploded" in errs[0].error
     assert len(oks) == 1 and oks[0].metrics["ok"] == 1
+
+
+def test_median_stopping_rule(ray_start):
+    """Bad trials stop early under the median rule."""
+    from ray_tpu import tune
+    from ray_tpu.tune import MedianStoppingRule
+
+    def trainable(config):
+        import time as _t
+        for i in range(12):
+            tune.report({"score": config["quality"] * (i + 1)})
+            _t.sleep(0.05)
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"quality": tune.grid_search([0.1, 0.2, 1.0, 2.0])},
+        tune_config=tune.TuneConfig(
+            scheduler=MedianStoppingRule(metric="score", grace_period=3),
+            max_concurrent_trials=4),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result("score")
+    assert best.config["quality"] == 2.0
+    # a bottom trial must have been cut before finishing all 12 reports
+    shortest = min(len(r.history) for r in grid)
+    assert shortest < 12
+
+
+def test_pbt_exploit_and_checkpoint(ray_start):
+    """A weak PBT trial adopts a strong trial's checkpointed weight and a
+    mutated config."""
+    from ray_tpu import tune
+    from ray_tpu.tune import PopulationBasedTraining
+
+    def trainable(config):
+        import time as _t
+        ckpt = tune.get_checkpoint()
+        weight = ckpt["weight"] if ckpt else 0.0
+        for _ in range(20):
+            weight += config["lr"]
+            tune.report({"score": weight}, checkpoint={"weight": weight})
+            _t.sleep(0.25)
+
+    pbt = PopulationBasedTraining(
+        metric="score", perturbation_interval=4, quantile_fraction=0.5,
+        hyperparam_mutations={"lr": [0.1, 0.5, 1.0, 2.0]})
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.01, 0.02, 1.0, 2.0])},
+        tune_config=tune.TuneConfig(scheduler=pbt,
+                                    max_concurrent_trials=4),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result("score")
+    assert best.metrics["score"] > 10.0   # strong configs dominate
+    # every trial ends with a meaningful score: weak ones exploited into
+    # high-weight checkpoints or kept compounding a strong lr
+    final_scores = sorted(r.metrics.get("score", 0.0) for r in grid)
+    assert final_scores[0] > 1.0, final_scores
