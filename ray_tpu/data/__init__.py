@@ -1,8 +1,9 @@
-from ray_tpu.data.dataset import Dataset
+from ray_tpu.data import preprocessors
+from ray_tpu.data.dataset import Dataset, GroupedData
 from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
                                    from_pandas, range, read_csv, read_json,
                                    read_parquet, read_text)
 
-__all__ = ["Dataset", "range", "from_items", "from_numpy", "from_pandas",
-           "from_arrow", "read_parquet", "read_csv", "read_json",
-           "read_text"]
+__all__ = ["Dataset", "GroupedData", "range", "from_items", "from_numpy",
+           "from_pandas", "from_arrow", "read_parquet", "read_csv",
+           "read_json", "read_text", "preprocessors"]

@@ -56,6 +56,109 @@ class Dataset:
     def limit(self, n: int) -> "Dataset":
         return self._extend(exe.LimitStage(n))
 
+    # ------------------------------------------------------------ column ops
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        """fn(pandas.DataFrame) -> column values (reference:
+        Dataset.add_column)."""
+        def _add(df):
+            df = df.copy()
+            df[name] = fn(df)
+            return df
+        return self.map_batches(_add, batch_format="pandas")
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(lambda df: df.drop(columns=list(cols)),
+                                batch_format="pandas")
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(lambda df: df[list(cols)],
+                                batch_format="pandas")
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self.map_batches(lambda df: df.rename(columns=dict(mapping)),
+                                batch_format="pandas")
+
+    # ---------------------------------------------------------------- groupby
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # ------------------------------------------------------ global aggregates
+    def _column_chunks(self, col: str):
+        import numpy as np
+        for ref, _ in self._execute():
+            block = ray_tpu.get(ref)
+            if block.num_rows:
+                yield np.asarray(block.column(col).to_numpy(
+                    zero_copy_only=False))
+
+    def sum(self, col: str):
+        return float(__import__("numpy").sum(
+            [c.sum() for c in self._column_chunks(col)]))
+
+    def min(self, col: str):
+        return float(min(c.min() for c in self._column_chunks(col)))
+
+    def max(self, col: str):
+        return float(max(c.max() for c in self._column_chunks(col)))
+
+    def mean(self, col: str):
+        import numpy as np
+        tot, n = 0.0, 0
+        for c in self._column_chunks(col):
+            tot += float(c.sum())
+            n += c.size
+        return tot / max(n, 1)
+
+    def std(self, col: str, ddof: int = 1):
+        import numpy as np
+        chunks = list(self._column_chunks(col))
+        if not chunks:
+            return 0.0
+        all_ = np.concatenate(chunks)
+        return float(np.std(all_, ddof=ddof))
+
+    def unique(self, col: str) -> List:
+        import numpy as np
+        seen = []
+        s = set()
+        for c in self._column_chunks(col):
+            for v in np.unique(c):
+                v = v.item() if hasattr(v, "item") else v
+                if v not in s:
+                    s.add(v)
+                    seen.append(v)
+        return seen
+
+    # ------------------------------------------------------------ splits/zip
+    def random_split(self, fractions: List[float],
+                     seed: Optional[int] = None) -> List["Dataset"]:
+        import numpy as np
+        rows = self.take_all()
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(len(rows))
+        out = []
+        start = 0
+        from ray_tpu.data.read_api import from_items
+        for f in fractions:
+            k = int(round(f * len(rows)))
+            out.append(from_items([rows[i] for i in idx[start:start + k]]))
+            start += k
+        return out
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of equal-length datasets (reference:
+        Dataset.zip; clashing names get a _1 suffix)."""
+        import pandas as pd
+        a = self.to_pandas()
+        b = other.to_pandas()
+        if len(a) != len(b):
+            raise ValueError(f"length mismatch: {len(a)} vs {len(b)}")
+        b = b.rename(columns={c: f"{c}_1" for c in b.columns
+                              if c in a.columns})
+        from ray_tpu.data.read_api import from_pandas
+        return from_pandas(pd.concat([a.reset_index(drop=True),
+                                      b.reset_index(drop=True)], axis=1))
+
     def union(self, *others: "Dataset") -> "Dataset":
         bundles = list(self._execute())
         for o in others:
@@ -154,5 +257,55 @@ class Dataset:
             pcsv.write_csv(ray_tpu.get(ref),
                            os.path.join(path, f"part-{i:05d}.csv"))
 
+    def write_json(self, path: str):
+        import json
+        import os
+        os.makedirs(path, exist_ok=True)
+        for i, (ref, _) in enumerate(self._execute()):
+            block = ray_tpu.get(ref)
+            with open(os.path.join(path, f"part-{i:05d}.json"), "w") as f:
+                for row in block_lib.block_to_rows(block):
+                    f.write(json.dumps(row, default=str) + "\n")
+
     def __repr__(self):
         return f"Dataset(stages={len(self._stages)})"
+
+
+class GroupedData:
+    """Grouped view for aggregations (reference: ray.data
+    grouped_data.GroupedData — count/sum/mean/min/max/std + map_groups
+    over a distributed key-hash shuffle)."""
+
+    _ARROW_FNS = {"sum": "sum", "mean": "mean", "min": "min",
+                  "max": "max", "count": "count", "std": "stddev"}
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, col: str, fn: str, out_name: str) -> Dataset:
+        return self._ds._extend(exe.AllToAllStage(
+            "groupby_agg", key=self._key,
+            aggs=[(col, self._ARROW_FNS[fn], out_name)]))
+
+    def count(self) -> Dataset:
+        return self._agg(self._key, "count", "count()")
+
+    def sum(self, col: str) -> Dataset:
+        return self._agg(col, "sum", f"sum({col})")
+
+    def mean(self, col: str) -> Dataset:
+        return self._agg(col, "mean", f"mean({col})")
+
+    def min(self, col: str) -> Dataset:
+        return self._agg(col, "min", f"min({col})")
+
+    def max(self, col: str) -> Dataset:
+        return self._agg(col, "max", f"max({col})")
+
+    def std(self, col: str) -> Dataset:
+        return self._agg(col, "std", f"std({col})")
+
+    def map_groups(self, fn) -> Dataset:
+        return self._ds._extend(exe.AllToAllStage(
+            "map_groups", key=self._key, fn=fn))

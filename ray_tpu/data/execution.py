@@ -152,6 +152,12 @@ class AllToAllStage(Stage):
         elif self.kind == "sort":
             yield from self._sort(refs, self.kwargs["key"],
                                   self.kwargs.get("descending", False))
+        elif self.kind == "groupby_agg":
+            yield from self._groupby_agg(refs, self.kwargs["key"],
+                                         self.kwargs["aggs"])
+        elif self.kind == "map_groups":
+            yield from self._map_groups(refs, self.kwargs["key"],
+                                        self.kwargs["fn"])
         else:
             raise ValueError(self.kind)
 
@@ -188,6 +194,63 @@ class AllToAllStage(Stage):
         order = "descending" if descending else "ascending"
         out = merged.sort_by([(key, order)])
         yield (ray_tpu.put(out), block_lib.block_metadata(out))
+
+    def _hash_partitions(self, refs, key, n):
+        """Disjoint key-hash partitions across blocks (the shuffle step of
+        a distributed group-by; reference: ray.data shuffle ops)."""
+        import numpy as np
+        blocks = ray_tpu.get(list(refs))
+        merged = block_lib.concat_blocks(blocks)
+        if merged.num_rows == 0:
+            return [merged]
+        col = merged.column(key).to_pandas()
+        part = np.asarray(col.map(lambda v: hash(v) % n), np.int64)
+        return [merged.take(np.nonzero(part == i)[0]) for i in range(n)]
+
+    def _groupby_agg(self, refs, key, aggs):
+        """aggs: list of (column, arrow_agg_fn, out_name); key-disjoint
+        partitions aggregate in parallel remote tasks."""
+        n = max(1, min(len(refs), 8))
+        parts = self._hash_partitions(refs, key, n)
+
+        def agg_part(table):
+            import pyarrow as pa
+            if table.num_rows == 0:
+                return table
+            spec = [(c, f) for c, f, _ in aggs]
+            out = table.group_by(key).aggregate(spec)
+            rename = {f"{c}_{f}": name for c, f, name in aggs}
+            return out.rename_columns(
+                [rename.get(c, c) for c in out.column_names])
+
+        agg_remote = ray_tpu.remote(agg_part)
+        out_refs = [agg_remote.remote(p) for p in parts if p.num_rows]
+        for ref in out_refs:
+            block = ray_tpu.get(ref)
+            yield (ray_tpu.put(block), block_lib.block_metadata(block))
+
+    def _map_groups(self, refs, key, fn):
+        """Run fn(pandas.DataFrame) per key group (reference:
+        GroupedData.map_groups)."""
+        n = max(1, min(len(refs), 8))
+        parts = self._hash_partitions(refs, key, n)
+
+        def groups_part(table):
+            import pandas as pd
+            if table.num_rows == 0:
+                return table
+            df = table.to_pandas()
+            outs = [fn(g) for _, g in df.groupby(key, sort=False)]
+            outs = [o if isinstance(o, pd.DataFrame) else pd.DataFrame(o)
+                    for o in outs]
+            return block_lib.block_from_batch(pd.concat(outs)) if outs \
+                else table.slice(0, 0)
+
+        groups_remote = ray_tpu.remote(groups_part)
+        out_refs = [groups_remote.remote(p) for p in parts if p.num_rows]
+        for ref in out_refs:
+            block = ray_tpu.get(ref)
+            yield (ray_tpu.put(block), block_lib.block_metadata(block))
 
 
 class LimitStage(Stage):
