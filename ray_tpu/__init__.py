@@ -168,22 +168,39 @@ def remote(*args, **kwargs):
     return make
 
 
+def _client():
+    from ray_tpu.client import current_client
+    return current_client()
+
+
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
         *, timeout: Optional[float] = None):
+    cc = _client()
+    if cc is not None:
+        return cc.get(refs, timeout=timeout)
     return _get_worker().get(refs, timeout=timeout)
 
 
 def put(value: Any) -> ObjectRef:
+    cc = _client()
+    if cc is not None:
+        return cc.put(value)
     return _get_worker().put(value)
 
 
 def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
          timeout: Optional[float] = None):
+    cc = _client()
+    if cc is not None:
+        return cc.wait(list(refs), num_returns=num_returns, timeout=timeout)
     return _get_worker().wait(list(refs), num_returns=num_returns,
                               timeout=timeout)
 
 
 def kill(actor: ActorHandle, *, no_restart: bool = True):
+    cc = _client()
+    if cc is not None:
+        return cc.kill(actor)
     _get_worker().kill_actor(actor._id, no_restart=no_restart)
 
 

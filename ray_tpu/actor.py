@@ -118,6 +118,11 @@ class ActorClass:
         self.__name__ = getattr(cls, "__name__", "ActorClass")
 
     def remote(self, *args, **kwargs) -> ActorHandle:
+        from ray_tpu.client import current_client
+        cc = current_client()
+        if cc is not None:   # client-mode hook (reference: client_mode_hook)
+            return cc.remote(self._cls, **self._options).remote(
+                *args, **kwargs)
         from ray_tpu import _get_worker
         w = _get_worker()
         opts = self._options

@@ -59,6 +59,11 @@ class RemoteFunction:
         functools.update_wrapper(self, function)
 
     def remote(self, *args, **kwargs):
+        from ray_tpu.client import current_client
+        cc = current_client()
+        if cc is not None:   # client-mode hook (reference: client_mode_hook)
+            return cc.remote(self._function, **self._options).remote(
+                *args, **kwargs)
         from ray_tpu import _get_worker
         w = _get_worker()
         opts = self._options

@@ -1,0 +1,96 @@
+"""Remote client sessions (reference: python/ray/util/client/ — the
+`ray://` proxy). A separate process hosts the cluster + client proxy;
+this process connects WITHOUT ray_tpu.init and drives tasks, actors,
+puts and waits over the single proxy connection."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+HOST_SCRIPT = """
+import sys, time
+import ray_tpu
+from ray_tpu.client import serve_proxy
+ray_tpu.init(num_cpus=2, object_store_memory=64*1024*1024)
+addr = serve_proxy()
+print(f"PROXY_ADDR={addr}", flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+@pytest.fixture(scope="module")
+def proxy_addr():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    proc = subprocess.Popen([sys.executable, "-c", HOST_SCRIPT],
+                            stdout=subprocess.PIPE, text=True, env=env)
+    addr = None
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("PROXY_ADDR="):
+            addr = line.strip().split("=", 1)[1]
+            break
+    assert addr, "proxy did not start"
+    yield addr
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def test_client_tasks_actors_objects(proxy_addr):
+    from ray_tpu import client as rc
+    ctx = rc.connect(proxy_addr)
+    try:
+        import ray_tpu
+
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        ref = add.remote(2, 3)
+        assert ray_tpu.get(ref, timeout=60) == 5
+
+        # object refs as args resolve server-side
+        big = ray_tpu.put(list(range(100)))
+        @ray_tpu.remote
+        def total(xs):
+            return sum(xs)
+        assert ray_tpu.get(total.remote(big), timeout=60) == 4950
+
+        # wait
+        refs = [add.remote(i, i) for i in range(4)]
+        ready, rest = ray_tpu.wait(refs, num_returns=4, timeout=60)
+        assert len(ready) == 4 and not rest
+        assert sorted(ray_tpu.get(ready, timeout=60)) == [0, 2, 4, 6]
+
+        # actors
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self, start):
+                self.n = start
+
+            def incr(self, k=1):
+                self.n += k
+                return self.n
+
+        c = Counter.remote(10)
+        assert ray_tpu.get(c.incr.remote(), timeout=60) == 11
+        assert ray_tpu.get(c.incr.remote(5), timeout=60) == 16
+        ray_tpu.kill(c)
+
+        # errors propagate
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("client boom")
+
+        with pytest.raises(Exception, match="client boom"):
+            ray_tpu.get(boom.remote(), timeout=60)
+
+        assert ctx.cluster_resources().get("CPU") == 2.0
+    finally:
+        ctx.disconnect()
