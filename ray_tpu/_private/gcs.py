@@ -98,6 +98,8 @@ class GcsServer:
             "get_placement_group": self.h_get_placement_group,
             "get_all_placement_groups": self.h_get_all_placement_groups,
             "add_task_events": self.h_add_task_events,
+            "report_metrics": self.h_report_metrics,
+            "get_metrics": self.h_get_metrics,
             "list_task_events": self.h_list_task_events,
             "ping": lambda conn: "pong",
         }
@@ -500,6 +502,17 @@ class GcsServer:
         return out
 
     # --------------------------------------------------------------- pubsub
+    def h_report_metrics(self, conn, worker_id: str, metrics: list):
+        """Per-process metric snapshots (reference: the per-node metrics
+        agent collecting OpenCensus exports, metrics_agent.py:483)."""
+        if not hasattr(self, "metrics"):
+            self.metrics = {}
+        self.metrics[worker_id] = metrics
+        return True
+
+    def h_get_metrics(self, conn):
+        return getattr(self, "metrics", {})
+
     def h_subscribe(self, conn, channel: str):
         self.subscribers.setdefault(channel, set()).add(conn)
         return True

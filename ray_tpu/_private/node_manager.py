@@ -119,6 +119,8 @@ class NodeManager:
         # queued lease demand, reported in heartbeats for the autoscaler
         self._pending_demand: List[Dict[str, float]] = []
         self._spill_mutex = threading.Lock()
+        # pid -> [(path, stream_name, offset), ...] for the log monitor
+        self._log_files: Dict[int, list] = {}
 
     # -------------------------------------------------------------- lifecycle
     async def start(self) -> str:
@@ -166,6 +168,7 @@ class NodeManager:
         self.spill_dir = f"/tmp/raytpu/{self.session_name}/spill_{self.node_id[:8]}"
         self.spilled: Dict[bytes, str] = {}
         self._tasks = [
+            asyncio.ensure_future(self._log_monitor_loop()),
             asyncio.ensure_future(self._heartbeat_loop()),
             asyncio.ensure_future(self._view_refresh_loop()),
             asyncio.ensure_future(self._reap_children_loop()),
@@ -262,6 +265,37 @@ class NodeManager:
                     "labels": payload.get("labels", {})}
                 self._wake_lease_waiters()
 
+    async def _log_monitor_loop(self):
+        """Tail per-worker log files and publish new lines to the LOGS
+        pubsub channel so drivers can echo them (reference: LogMonitor
+        python/ray/_private/log_monitor.py:103 magic-prefix routing)."""
+        while True:
+            await asyncio.sleep(0.5)
+            for pid, files in list(self._log_files.items()):
+                for i, (path, stream, off) in enumerate(files):
+                    try:
+                        with open(path, "rb") as f:
+                            f.seek(off)
+                            chunk = f.read(256 * 1024)
+                    except OSError:
+                        continue
+                    if not chunk:
+                        continue
+                    nl = chunk.rfind(b"\n")
+                    if nl < 0:
+                        continue
+                    chunk = chunk[:nl + 1]
+                    files[i] = (path, stream, off + len(chunk))
+                    lines = chunk.decode("utf-8", "replace").splitlines()
+                    try:
+                        await self.gcs.call(
+                            "publish", channel="LOGS", key=self.node_id,
+                            payload={"pid": pid, "stream": stream,
+                                     "ip": rpc.node_ip_address(),
+                                     "lines": lines[:200]})
+                    except Exception:
+                        pass
+
     # ------------------------------------------------------------ worker pool
     def _spawn_worker(self) -> WorkerProc:
         env = dict(os.environ)
@@ -273,15 +307,23 @@ class NodeManager:
                "--node-id", self.node_id,
                "--session-name", self.session_name]
         # detach stdio so workers never hold a driver/pytest pipe open;
-        # logs go to the session log dir (reference: per-process log files
-        # under the session dir, python/ray/_private/log_monitor.py)
+        # per-worker log files under the session dir are tailed by
+        # _log_monitor_loop and published to the driver (reference:
+        # python/ray/_private/log_monitor.py:103 -> GCS pubsub -> driver)
         log_dir = f"/tmp/raytpu/{self.session_name}/logs"
         os.makedirs(log_dir, exist_ok=True)
-        logf = open(os.path.join(log_dir, "workers.err"), "ab")
+        self._worker_seq = getattr(self, "_worker_seq", 0) + 1
+        base = os.path.join(log_dir,
+                            f"worker-{self.node_id[:8]}-{self._worker_seq}")
+        outf = open(base + ".out", "ab")
+        errf = open(base + ".err", "ab")
         proc = subprocess.Popen(cmd, env=env, stdin=subprocess.DEVNULL,
-                                stdout=subprocess.DEVNULL, stderr=logf,
+                                stdout=outf, stderr=errf,
                                 start_new_session=True)
-        logf.close()
+        outf.close()
+        errf.close()
+        self._log_files[proc.pid] = [(base + ".out", "stdout", 0),
+                                     (base + ".err", "stderr", 0)]
         w = WorkerProc(proc)
         self._spawning += 1
         return w

@@ -202,6 +202,9 @@ class CoreWorker:
         self._event_flusher = asyncio.ensure_future(self._flush_task_events())
         self._install_ref_hooks()
         self._subscribed_actor_channel = False
+        if (self.mode == DRIVER
+                and os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0"):
+            await self.gcs.call("subscribe", channel="LOGS")
 
     def _install_ref_hooks(self):
         loop = self.loop
@@ -818,6 +821,16 @@ class CoreWorker:
         await self.gcs.call("subscribe", channel="ACTOR")
 
     def h_pubsub(self, conn, channel: str, key: str, payload: Any):
+        if channel == "LOGS":
+            # worker log lines -> driver stdout with a routing prefix
+            # (reference: log_monitor pubsub -> driver magic-prefix print)
+            import sys
+            prefix = f"({payload.get('pid')}, ip={payload.get('ip')})"
+            out = sys.stderr if payload.get("stream") == "stderr" \
+                else sys.stdout
+            for line in payload.get("lines", []):
+                print(f"{prefix} {line}", file=out)
+            return None
         if channel == "ACTOR":
             st = self.actor_handles.get(key)
             if st is None:

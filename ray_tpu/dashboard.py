@@ -35,6 +35,7 @@ class DashboardServer:
         r.add_get("/api/jobs/{job_id}/logs", self._job_logs)
         r.add_post("/api/jobs/{job_id}/stop", self._job_stop)
         r.add_get("/api/version", self._version)
+        r.add_get("/metrics", self._metrics)
         r.add_get("/healthz", self._healthz)
         runner = web.AppRunner(app)
         await runner.setup()
@@ -52,6 +53,20 @@ class DashboardServer:
     async def _healthz(self, request):
         from aiohttp import web
         return web.Response(text="ok")
+
+    async def _metrics(self, request):
+        """Prometheus scrape endpoint aggregating every process's pushed
+        metrics (reference: prometheus_exporter.py on the metrics agent)."""
+        from aiohttp import web
+
+        from ray_tpu.util.metrics import render_prometheus
+
+        def fetch():
+            import ray_tpu
+            return ray_tpu._get_worker().gcs_call("get_metrics")
+        all_metrics = await self._in_thread(fetch)
+        return web.Response(text=render_prometheus(all_metrics),
+                            content_type="text/plain")
 
     async def _version(self, request):
         from aiohttp import web

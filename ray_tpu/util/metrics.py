@@ -1,0 +1,178 @@
+"""User-defined metrics: Counter / Gauge / Histogram
+(reference: python/ray/util/metrics.py feeding the per-node agent's
+MetricsAgent, python/ray/_private/metrics_agent.py:483, re-exported to
+Prometheus). Here every process pushes its registry to the GCS on a 2s
+cadence and the dashboard renders the aggregate at /metrics in
+Prometheus text format.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_registry: Dict[str, "Metric"] = {}
+_registry_lock = threading.Lock()
+_pusher_started = False
+
+
+def _ensure_pusher():
+    global _pusher_started
+    with _registry_lock:
+        if _pusher_started:
+            return
+        _pusher_started = True
+    t = threading.Thread(target=_push_loop, name="metrics-push", daemon=True)
+    t.start()
+
+
+def _push_loop():
+    while True:
+        time.sleep(2.0)
+        try:
+            import ray_tpu
+            if not ray_tpu.is_initialized():
+                continue
+            with _registry_lock:
+                payload = [m._snapshot() for m in _registry.values()]
+            if payload:
+                ray_tpu._get_worker().gcs_call(
+                    "report_metrics",
+                    worker_id=ray_tpu._get_worker().core.worker_id,
+                    metrics=payload)
+        except Exception:
+            pass
+
+
+class Metric:
+    _type = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry[name] = self
+        _ensure_pusher()
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]):
+        merged = {**self._default_tags, **(tags or {})}
+        return tuple(sorted(merged.items()))
+
+    def _snapshot(self) -> Dict:
+        with self._lock:
+            return {"name": self._name, "type": self._type,
+                    "help": self._description,
+                    "samples": [[list(k), v]
+                                for k, v in self._values.items()]}
+
+
+class Counter(Metric):
+    _type = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict] = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(Metric):
+    _type = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict] = None):
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+
+class Histogram(Metric):
+    _type = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or
+                                 [0.01, 0.1, 1.0, 10.0, 100.0])
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+
+    def observe(self, value: float, tags: Optional[Dict] = None):
+        k = self._key(tags)
+        with self._lock:
+            counts = self._counts.setdefault(
+                k, [0] * (len(self.boundaries) + 1))
+            i = 0
+            while i < len(self.boundaries) and value > self.boundaries[i]:
+                i += 1
+            counts[i] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+
+    def _snapshot(self) -> Dict:
+        with self._lock:
+            return {"name": self._name, "type": self._type,
+                    "help": self._description,
+                    "boundaries": self.boundaries,
+                    "samples": [[list(k), self._counts[k],
+                                 self._sums.get(k, 0.0)]
+                                for k in self._counts]}
+
+
+def render_prometheus(all_metrics: Dict[str, List[Dict]]) -> str:
+    """GCS-aggregated {worker_id: [snapshots]} -> Prometheus text."""
+    by_name: Dict[str, List[Dict]] = {}
+    for snaps in all_metrics.values():
+        for m in snaps:
+            by_name.setdefault(m["name"], []).append(m)
+    out = []
+    for name, ms in sorted(by_name.items()):
+        m0 = ms[0]
+        if m0.get("help"):
+            out.append(f"# HELP {name} {m0['help']}")
+        out.append(f"# TYPE {name} {m0['type']}")
+        if m0["type"] == "histogram":
+            agg: Dict[Tuple, List] = {}
+            for m in ms:
+                for tags, counts, total in m["samples"]:
+                    key = tuple(map(tuple, tags))
+                    if key in agg:
+                        agg[key][0] = [a + b for a, b in
+                                       zip(agg[key][0], counts)]
+                        agg[key][1] += total
+                    else:
+                        agg[key] = [list(counts), total]
+            for key, (counts, total) in agg.items():
+                tag_s = ",".join(f'{k}="{v}"' for k, v in key)
+                cum = 0
+                for b, c in zip(m0["boundaries"], counts):
+                    cum += c
+                    le = (tag_s + "," if tag_s else "") + f'le="{b}"'
+                    out.append(f"{name}_bucket{{{le}}} {cum}")
+                cum += counts[-1]
+                le = (tag_s + "," if tag_s else "") + 'le="+Inf"'
+                out.append(f"{name}_bucket{{{le}}} {cum}")
+                brace = f"{{{tag_s}}}" if tag_s else ""
+                out.append(f"{name}_count{brace} {cum}")
+                out.append(f"{name}_sum{brace} {total}")
+        else:
+            agg2: Dict[Tuple, float] = {}
+            for m in ms:
+                for tags, v in m["samples"]:
+                    key = tuple(map(tuple, tags))
+                    agg2[key] = agg2.get(key, 0.0) + v \
+                        if m["type"] == "counter" else v
+            for key, v in agg2.items():
+                tag_s = ",".join(f'{k}="{v2}"' for k, v2 in key)
+                brace = f"{{{tag_s}}}" if tag_s else ""
+                out.append(f"{name}{brace} {v}")
+    return "\n".join(out) + "\n"
