@@ -1,8 +1,10 @@
-from ray_tpu.serve.api import (batch, deployment, get_app_handle, run,
-                               shutdown, status)
+from ray_tpu.serve.api import (batch, delete, deployment, get_app_handle,
+                               run, shutdown, status)
 from ray_tpu.serve.deployment import Application, Deployment
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
-__all__ = ["deployment", "run", "shutdown", "status", "batch",
+__all__ = ["deployment", "run", "shutdown", "status", "batch", "delete",
            "get_app_handle", "Deployment", "Application",
-           "DeploymentHandle", "DeploymentResponse"]
+           "DeploymentHandle", "DeploymentResponse", "multiplexed",
+           "get_multiplexed_model_id"]

@@ -62,6 +62,7 @@ class _Router:
         self.version = -1
         self.lock = threading.Lock()
         self._last_refresh = 0.0
+        self.model_map: Dict[str, int] = {}   # multiplexed model -> replica
 
     def _controller(self):
         from ray_tpu.serve.api import _get_controller
@@ -79,20 +80,28 @@ class _Router:
                 self.version = info["version"]
                 self.replicas = info["replicas"]
                 self.inflight = {i: 0 for i in range(len(self.replicas))}
+                self.model_map.clear()
 
-    def pick(self):
+    def pick(self, model_id: str = ""):
         self.refresh()
         with self.lock:
             n = len(self.replicas)
             if n == 0:
                 raise RuntimeError(
                     f"deployment {self.deployment_name} has no replicas")
-            if n == 1:
+            if model_id and self.model_map.get(model_id, n) < n:
+                # sticky multiplex routing: the replica that loaded this
+                # model keeps serving it (reference: multiplexed replica
+                # preference in the pow-2 scheduler)
+                idx = self.model_map[model_id]
+            elif n == 1:
                 idx = 0
             else:
                 a, b = random.sample(range(n), 2)
                 idx = a if self.inflight.get(a, 0) <= \
                     self.inflight.get(b, 0) else b
+            if model_id:
+                self.model_map[model_id] = idx
             self.inflight[idx] = self.inflight.get(idx, 0) + 1
             return idx, self.replicas[idx]
 
@@ -124,9 +133,12 @@ class DeploymentHandle:
                      else a for a in args)
         kwargs = {k: (v._object_ref if isinstance(v, DeploymentResponse)
                       else v) for k, v in kwargs.items()}
+        model_id = getattr(self, "_model_id", "")
+        if model_id:
+            kwargs = {**kwargs, "__serve_model_id": model_id}
         last_err = None
         for _ in range(retry + 1):
-            idx, replica = self._router.pick()
+            idx, replica = self._router.pick(model_id)
             try:
                 ref = replica.handle_request.remote(method, args, kwargs)
                 return DeploymentResponse(ref, self._router, idx)
@@ -144,8 +156,14 @@ class DeploymentHandle:
             raise AttributeError(name)
         return _MethodCaller(self, name)
 
-    def options(self, **_kw) -> "DeploymentHandle":
-        return self
+    def options(self, *, multiplexed_model_id: str = "",
+                **_kw) -> "DeploymentHandle":
+        if not multiplexed_model_id:
+            return self
+        clone = DeploymentHandle(self.deployment_name, self.app_name)
+        clone._router = self._router          # share routing state
+        clone._model_id = multiplexed_model_id
+        return clone
 
     def __reduce__(self):
         return (DeploymentHandle, (self.deployment_name, self.app_name))

@@ -33,6 +33,7 @@ class Replica:
                      for a in args)
         kwargs = {k: (ray_tpu.get(v) if isinstance(v, ObjectRef) else v)
                   for k, v in kwargs.items()}
+        model_id = kwargs.pop("__serve_model_id", "")
         with self._lock:
             self._ongoing += 1
         try:
@@ -42,12 +43,26 @@ class Replica:
                 fn = getattr(self._callable, method)
             import asyncio
             import inspect
+
+            from ray_tpu.serve import multiplex
             if inspect.iscoroutinefunction(fn):
-                # we're on an executor thread; hop onto the worker loop
+                # we're on an executor thread; hop onto the worker loop —
+                # the model-id contextvar is set inside the coroutine so
+                # it lives in the loop-side execution context
+                async def _call():
+                    tok = multiplex._set_model_id(model_id)
+                    try:
+                        return await fn(*args, **kwargs)
+                    finally:
+                        multiplex._current_model_id.reset(tok)
                 from ray_tpu._private.worker import global_worker
                 return asyncio.run_coroutine_threadsafe(
-                    fn(*args, **kwargs), global_worker.core.loop).result()
-            return fn(*args, **kwargs)
+                    _call(), global_worker.core.loop).result()
+            tok = multiplex._set_model_id(model_id)
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                multiplex._current_model_id.reset(tok)
         finally:
             with self._lock:
                 self._ongoing -= 1

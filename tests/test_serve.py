@@ -140,3 +140,49 @@ def test_http_ingress(ray_start):
     with urllib.request.urlopen(req, timeout=30) as resp:
         body = json.loads(resp.read())
     assert body == {"got": {"a": 1}}
+
+
+def test_model_multiplexing(ray_start):
+    """Many model ids share a replica pool with per-replica LRU caches and
+    sticky routing (reference: serve/multiplex.py)."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id, "scale": int(model_id[-1])}
+
+        async def __call__(self, x):
+            model_id = serve.get_multiplexed_model_id()
+            model = await self.get_model(model_id)
+            return x * model["scale"]
+
+        def load_count(self):
+            return len(self.loads)
+
+    app = MultiModel.bind()
+    serve.run(app, name="mux")
+    try:
+        h = serve.get_app_handle("mux")
+        h2 = h.options(multiplexed_model_id="m2")
+        h3 = h.options(multiplexed_model_id="m3")
+        assert h2.remote(10).result(timeout=60) == 20
+        assert h3.remote(10).result(timeout=60) == 30
+        # repeated calls hit the cached model on the same replica: total
+        # loads across replicas stays at 2
+        for _ in range(6):
+            assert h2.remote(1).result(timeout=60) == 2
+            assert h3.remote(1).result(timeout=60) == 3
+        import ray_tpu
+        total_loads = sum(
+            ray_tpu.get(r.handle_request.remote("load_count", (), {}),
+                        timeout=30)
+            for r in h._router.replicas)
+        assert total_loads == 2, total_loads
+    finally:
+        serve.delete("mux")
