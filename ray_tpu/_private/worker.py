@@ -609,7 +609,8 @@ class CoreWorker:
             "owner_node": self.node_id,
         }
         if runtime_env:
-            spec["runtime_env"] = runtime_env
+            spec["runtime_env"] = await self._package_runtime_env(
+                runtime_env)
         refs = [ObjectRef(rid, self.address) for rid in return_ids]
         for rid in return_ids:
             self._register_owned(rid, lineage=None, complete=False)
@@ -807,7 +808,8 @@ class CoreWorker:
             "method_names": list(method_names or []),
         }
         if runtime_env:
-            spec["runtime_env"] = runtime_env
+            spec["runtime_env"] = await self._package_runtime_env(
+                runtime_env)
         st = ActorHandleState(actor_id)
         self.actor_handles[actor_id] = st
         await self._ensure_actor_subscription()
@@ -1045,10 +1047,81 @@ class CoreWorker:
         except Exception:
             logger.exception("failed to set accelerator visibility")
 
+    async def _package_runtime_env(self, renv: Dict) -> Dict:
+        """Submission side: zip local working_dir / py_modules dirs into
+        content-addressed GCS KV packages (reference: runtime-env
+        packaging python/ray/_private/runtime_env/packaging.py — GCS URI
+        zips; URI-cached so identical dirs upload once)."""
+        import hashlib
+        import io
+        import zipfile
+        out = dict(renv)
+
+        async def pack_dir(path: str) -> str:
+            buf = io.BytesIO()
+            with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+                for root, dirs, files in os.walk(path):
+                    dirs[:] = [d for d in dirs if d != "__pycache__"]
+                    for fname in sorted(files):
+                        full = os.path.join(root, fname)
+                        z.write(full, os.path.relpath(full, path))
+            data = buf.getvalue()
+            uri = hashlib.sha1(data).hexdigest()
+            existing = await self.gcs.call("kv_get", ns="runtime_env",
+                                           key=uri.encode())
+            if existing is None:
+                await self.gcs.call("kv_put", ns="runtime_env",
+                                    key=uri.encode(), value=data)
+            return uri
+
+        wd = out.get("working_dir")
+        if wd and os.path.isdir(wd):
+            out["working_dir_uri"] = await pack_dir(wd)
+            out["working_dir_base"] = os.path.basename(
+                os.path.abspath(wd))
+            del out["working_dir"]
+        uris = []
+        for m in out.get("py_modules") or []:
+            if os.path.isdir(m):
+                uris.append([await pack_dir(m),
+                             os.path.basename(os.path.abspath(m))])
+        if uris:
+            out["py_modules_uris"] = uris
+            out.pop("py_modules", None)
+        return out
+
+    def _materialize_uri(self, uri: str, base: str = "") -> str:
+        """Worker side: fetch + extract a packaged dir (content-addressed
+        cache shared by all workers on the node; reference: uri_cache.py)."""
+        import zipfile
+        dest = f"/tmp/raytpu/runtime_envs/{uri}"
+        mod_root = os.path.join(dest, base) if base else dest
+        if os.path.isdir(dest):
+            return mod_root
+        data = asyncio.run_coroutine_threadsafe(
+            self.gcs.call("kv_get", ns="runtime_env", key=uri.encode()),
+            self.loop).result(120)
+        if data is None:
+            raise RuntimeError(f"runtime_env package {uri} missing")
+        tmp = dest + ".tmp" + os.urandom(4).hex()
+        extract_to = os.path.join(tmp, base) if base else tmp
+        os.makedirs(extract_to, exist_ok=True)
+        import io
+        with zipfile.ZipFile(io.BytesIO(data)) as z:
+            z.extractall(extract_to)
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)   # raced another worker
+        return mod_root
+
     def _apply_runtime_env(self, spec: Dict):
-        """env_vars / working_dir for this execution (reference:
-        python/ray/runtime_env/runtime_env.py:152; conda/pip/container
-        materialization is a later round)."""
+        """env_vars / working_dir / py_modules for this execution
+        (reference: python/ray/runtime_env/runtime_env.py:152; conda/pip/
+        container materialization is a later round). Runs on the executor
+        thread, so blocking KV fetches are safe."""
+        import sys
         renv = spec.get("runtime_env")
         if not renv:
             return None
@@ -1057,16 +1130,28 @@ class CoreWorker:
             saved[k] = os.environ.get(k)
             os.environ[k] = str(v)
         saved_cwd = None
+        added_paths: List[str] = []
         wd = renv.get("working_dir")
+        if not wd and renv.get("working_dir_uri"):
+            wd = self._materialize_uri(renv["working_dir_uri"],
+                                       renv.get("working_dir_base", ""))
         if wd:
             saved_cwd = os.getcwd()
             os.chdir(wd)
-        return (saved, saved_cwd)
+            sys.path.insert(0, wd)
+            added_paths.append(wd)
+        for uri, base in renv.get("py_modules_uris") or []:
+            root = self._materialize_uri(uri, base)
+            parent = os.path.dirname(root)
+            sys.path.insert(0, parent)
+            added_paths.append(parent)
+        return (saved, saved_cwd, added_paths)
 
     def _restore_runtime_env(self, token):
+        import sys
         if token is None:
             return
-        saved, saved_cwd = token
+        saved, saved_cwd, added_paths = token
         for k, v in saved.items():
             if v is None:
                 os.environ.pop(k, None)
@@ -1074,6 +1159,11 @@ class CoreWorker:
                 os.environ[k] = v
         if saved_cwd is not None:
             os.chdir(saved_cwd)
+        for p in added_paths:
+            try:
+                sys.path.remove(p)
+            except ValueError:
+                pass
 
     async def _execute(self, spec: Dict) -> Dict:
         self._record_task_event(

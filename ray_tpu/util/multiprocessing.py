@@ -1,0 +1,128 @@
+"""multiprocessing.Pool drop-in backed by cluster tasks (reference:
+python/ray/util/multiprocessing/pool.py — Pool API running on actors;
+here map work fans out as tasks, imap streams in order, apply_async
+returns AsyncResult-compatible futures)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+
+class AsyncResult:
+    def __init__(self, refs, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+        vals = ray_tpu.get(self._refs, timeout=timeout)
+        return vals[0] if self._single else vals
+
+    def wait(self, timeout: Optional[float] = None):
+        import ray_tpu
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        import ray_tpu
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        import ray_tpu
+        if not self.ready():
+            raise ValueError("result not ready")
+        try:
+            ray_tpu.get(self._refs)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Process pool over the cluster. processes bounds in-flight tasks."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = ()):
+        import ray_tpu
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._n = processes or int(
+            ray_tpu.cluster_resources().get("CPU", 2))
+        self._initializer = initializer
+        self._initargs = initargs
+        self._closed = False
+
+    def _wrap(self, func):
+        init, initargs = self._initializer, self._initargs
+        if init is None:
+            return func
+
+        def run(*a, **kw):
+            init(*initargs)
+            return func(*a, **kw)
+        return run
+
+    def _submit(self, func, argslist) -> List:
+        import ray_tpu
+        rf = ray_tpu.remote(self._wrap(func))
+        window: List = []
+        out: List = []
+        for args in argslist:
+            if len(window) >= self._n * 2:
+                _, window = ray_tpu.wait(window, num_returns=1)
+            ref = rf.remote(*args)
+            window.append(ref)
+            out.append(ref)
+        return out
+
+    def apply(self, func, args=(), kwds=None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func, args=(), kwds=None) -> AsyncResult:
+        import ray_tpu
+        rf = ray_tpu.remote(self._wrap(func))
+        return AsyncResult([rf.remote(*args, **(kwds or {}))], single=True)
+
+    def map(self, func, iterable, chunksize=None) -> List:
+        return AsyncResult(self._submit(func, ((x,) for x in iterable)),
+                           single=False).get()
+
+    def map_async(self, func, iterable, chunksize=None) -> AsyncResult:
+        return AsyncResult(self._submit(func, ((x,) for x in iterable)),
+                           single=False)
+
+    def starmap(self, func, iterable, chunksize=None) -> List:
+        return AsyncResult(self._submit(func, iterable), single=False).get()
+
+    def imap(self, func, iterable, chunksize=None):
+        import ray_tpu
+        refs = self._submit(func, ((x,) for x in iterable))
+        for r in refs:
+            yield ray_tpu.get(r)
+
+    def imap_unordered(self, func, iterable, chunksize=None):
+        import ray_tpu
+        refs = self._submit(func, ((x,) for x in iterable))
+        pending = list(refs)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            yield ray_tpu.get(ready[0])
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
