@@ -45,6 +45,34 @@ class RpcError(Exception):
         self.remote_tb = remote_tb
 
 
+_CHAOS_SPEC = None
+
+
+def _maybe_inject_failure(method: str):
+    """RPC chaos for fault-injection tests (reference: RpcFailureManager
+    src/ray/rpc/rpc_chaos.cc:35 + RAY_testing_rpc_failure). Spec via env
+    RAY_TPU_TESTING_RPC_FAILURE="method=prob,method2=prob"."""
+    global _CHAOS_SPEC
+    if _CHAOS_SPEC is None:
+        import os
+        spec = {}
+        raw = os.environ.get("RAY_TPU_TESTING_RPC_FAILURE", "")
+        for part in raw.split(","):
+            if "=" in part:
+                m, p = part.split("=", 1)
+                try:
+                    spec[m.strip()] = float(p)
+                except ValueError:
+                    pass
+        _CHAOS_SPEC = spec
+    prob = _CHAOS_SPEC.get(method)
+    if prob:
+        import random
+        if random.random() < prob:
+            raise RpcError("ChaosInjected",
+                           f"injected chaos failure for {method!r}")
+
+
 class ConnectionLost(Exception):
     pass
 
@@ -166,6 +194,7 @@ class Connection:
             await self.writer.drain()
 
     async def call(self, method: str, timeout: Optional[float] = None, **kwargs) -> Any:
+        _maybe_inject_failure(method)
         fut = await self.call_start(method, **kwargs)
         if timeout is not None:
             return await asyncio.wait_for(fut, timeout)

@@ -498,6 +498,13 @@ class CoreWorker:
             buf.close()
 
     async def _pull_to_local(self, oid: bytes, node_id: str):
+        for attempt in range(5):
+            try:
+                await self.node_conn.call("pull_object", oid=oid,
+                                          node_id=node_id)
+                return
+            except rpc.RpcError:
+                await asyncio.sleep(0.05 * (attempt + 1))
         await self.node_conn.call("pull_object", oid=oid, node_id=node_id)
 
     async def h_wait_object(self, conn, oid: bytes):
@@ -737,11 +744,25 @@ class CoreWorker:
             return lease
         target_conn = self.node_conn
         addr_chain = 0
+        attempts = 0
         while True:
-            resp = await target_conn.call("request_lease", resources=resources,
-                                          scheduling=scheduling,
-                                          worker_id=self.worker_id,
-                                          spilled=addr_chain > 0)
+            try:
+                resp = await target_conn.call(
+                    "request_lease", resources=resources,
+                    scheduling=scheduling, worker_id=self.worker_id,
+                    spilled=addr_chain > 0)
+            except (rpc.RpcError, rpc.ConnectionLost) as e:
+                # transient control-plane failure (or injected chaos):
+                # back off and retry (reference: retryable lease clients,
+                # normal_task_submitter.cc retry-on-raylet-unavailable)
+                attempts += 1
+                if attempts > 5:
+                    raise
+                await asyncio.sleep(0.05 * attempts)
+                if target_conn is not self.node_conn and target_conn.closed:
+                    target_conn = self.node_conn
+                    addr_chain = 0
+                continue
             if resp["status"] == "ok":
                 return Lease(resp["lease_id"], resp["worker_address"],
                              resp["node_address"], sig,

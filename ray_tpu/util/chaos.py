@@ -1,0 +1,55 @@
+"""Chaos-testing utilities (reference: ResourceKillerActor / RayletKiller
+python/ray/_private/test_utils.py:1433,1536 used by the chaos suites —
+kill random nodes during workloads and assert completion; RPC-level
+failure injection lives in _private/rpc.py behind
+RAY_TPU_TESTING_RPC_FAILURE)."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import List, Optional
+
+
+class NodeKiller:
+    """Kills random worker nodes of a cluster_utils.Cluster at an
+    interval; never touches protected nodes (e.g. the head)."""
+
+    def __init__(self, cluster, interval_s: float = 2.0,
+                 protected_node_ids: Optional[List[str]] = None,
+                 max_kills: int = 1, seed: int = 0):
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.protected = set(protected_node_ids or [])
+        self.max_kills = max_kills
+        self.killed: List[str] = []
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _loop(self):
+        while not self._stop.is_set() and len(self.killed) < self.max_kills:
+            if self._stop.wait(self.interval_s):
+                return
+            victims = [n for n in self.cluster.nodes
+                       if n.node_id not in self.protected
+                       and n.node_id not in self.killed]
+            if not victims:
+                continue
+            v = self._rng.choice(victims)
+            try:
+                v.kill()
+                self.killed.append(v.node_id)
+            except Exception:
+                pass
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

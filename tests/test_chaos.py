@@ -1,0 +1,71 @@
+"""Chaos tests: workloads complete while a node dies mid-run and while
+RPCs randomly fail (reference: python/ray/tests/chaos/ + release chaos
+suites — setup_chaos.py kills nodes during Data/Train workloads)."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+def test_tasks_survive_node_kill():
+    """Retriable tasks spread over 3 nodes; one node dies mid-flight; all
+    results still arrive via task retry (owner-side resubmission)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.chaos import NodeKiller
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    w1 = cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote(max_retries=5, scheduling_strategy="SPREAD")
+        def slow_square(x):
+            time.sleep(0.4)
+            return x * x
+
+        refs = [slow_square.remote(i) for i in range(24)]
+        killer = NodeKiller(cluster, interval_s=1.0,
+                            protected_node_ids=[cluster.nodes[0].node_id],
+                            max_kills=1).start()
+        try:
+            out = ray_tpu.get(refs, timeout=180)
+        finally:
+            killer.stop()
+        assert out == [i * i for i in range(24)]
+        assert killer.killed, "no node was killed — chaos did not fire"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+CHAOS_RPC_SCRIPT = """
+import ray_tpu
+ray_tpu.init(num_cpus=2)
+
+@ray_tpu.remote(max_retries=10)
+def f(x):
+    return x + 1
+
+out = ray_tpu.get([f.remote(i) for i in range(40)], timeout=120)
+assert out == [i + 1 for i in range(40)], out
+print("RPC_CHAOS_OK", flush=True)
+"""
+
+
+def test_rpc_failure_injection():
+    """5% of pull_object/request_lease RPCs raise injected errors; the
+    retry paths absorb them (reference: RAY_testing_rpc_failure)."""
+    import os
+    env = dict(os.environ)
+    env["RAY_TPU_TESTING_RPC_FAILURE"] = \
+        "request_lease=0.05,pull_object=0.05"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", CHAOS_RPC_SCRIPT],
+                         capture_output=True, text=True, timeout=180,
+                         env=env)
+    assert "RPC_CHAOS_OK" in out.stdout, out.stdout + out.stderr
