@@ -28,6 +28,7 @@ _ALIGN = 64
 KIND_PY = 0       # ordinary python object
 KIND_ERR = 1      # serialized exception (raised on get)
 KIND_RAW = 2      # raw bytes payload (zero pickling)
+KIND_MSGPACK = 3  # msgpack payload (cross-language: C++ API frontend)
 
 
 class SerializedObject:
@@ -123,6 +124,8 @@ def serialize_error(exc: BaseException) -> SerializedObject:
 def deserialize_wire(kind: int, pkl: bytes, buffers: List[bytes]) -> Any:
     if kind == KIND_RAW:
         return buffers[0]
+    if kind == KIND_MSGPACK:
+        return msgpack.unpackb(buffers[0], raw=False, strict_map_key=False)
     obj = pickle.loads(pkl, buffers=[pickle.PickleBuffer(b) for b in buffers])
     if kind == KIND_ERR:
         raise TaskError(obj)
@@ -135,6 +138,8 @@ def deserialize_from_store(data_mv: memoryview, meta: bytes) -> Any:
     bufs = [data_mv[o:o + n] for o, n in zip(m["o"], m["l"])]
     if kind == KIND_RAW:
         return bytes(bufs[0])
+    if kind == KIND_MSGPACK:
+        return msgpack.unpackb(bufs[0], raw=False, strict_map_key=False)
     obj = pickle.loads(m["p"], buffers=[pickle.PickleBuffer(b) for b in bufs])
     if kind == KIND_ERR:
         raise TaskError(obj)

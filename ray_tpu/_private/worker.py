@@ -573,6 +573,21 @@ class CoreWorker:
         self._func_cache[fid] = func
         return fid
 
+    async def _load_function_any(self, spec: Dict):
+        """func_id -> cloudpickled function from GCS KV; func_ref ->
+        "module:attr" import (cross-language callers name functions
+        instead of shipping pickles, reference: cross_language function
+        descriptors)."""
+        ref = spec.get("func_ref")
+        if ref:
+            import importlib
+            mod_name, _, attr = ref.partition(":")
+            fn = importlib.import_module(mod_name)
+            for part in attr.split("."):
+                fn = getattr(fn, part)
+            return fn
+        return await self._load_function(spec["func_id"])
+
     async def _load_function(self, fid: bytes):
         fn = self._func_cache.get(fid)
         if fn is not None:
@@ -1207,7 +1222,7 @@ class CoreWorker:
             else:
                 fn = getattr(self.actor_instance, spec["method"])
         else:
-            fn = await self._load_function(spec["func_id"])
+            fn = await self._load_function_any(spec)
         self.current_task_name = spec["name"]
         self.current_task_id = spec["task_id"]
         if asyncio.iscoroutinefunction(getattr(fn, "__call__", fn)) or \
@@ -1231,10 +1246,16 @@ class CoreWorker:
             if len(values) != nret:
                 raise ValueError(
                     f"task returned {len(values)} values, expected {nret}")
-        return {"returns": [self._encode_return(rid, v)
+        xlang = bool(spec.get("xlang"))
+        return {"returns": [self._encode_return(rid, v, xlang=xlang)
                             for rid, v in zip(spec["return_ids"], values)]}
 
-    def _encode_return(self, rid: bytes, value) -> list:
+    def _encode_return(self, rid: bytes, value, xlang: bool = False) -> list:
+        if xlang:
+            # cross-language caller: msgpack result inline on the wire
+            import msgpack as _mp
+            payload = _mp.packb(value, use_bin_type=True, default=str)
+            return ["wire", serialization.KIND_MSGPACK, b"", [payload]]
         s = serialization.serialize(value)
         if s.is_inline() or self.store is None:
             return ["wire"] + list(s.to_wire())

@@ -1,0 +1,36 @@
+// Cross-language smoke: submit Python tasks from C++ (see ray_tpu_api.hpp).
+#include <cstdio>
+#include <string>
+
+#include "ray_tpu_api.hpp"
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <gcs tcp:host:port>\n", argv[0]);
+    return 2;
+  }
+  rt::Client client;
+  client.Connect(argv[1]);
+
+  rt::Value p = client.Call("builtins:pow",
+                            {rt::Value::Int(2), rt::Value::Int(10)});
+  std::printf("pow=%lld\n", static_cast<long long>(p.i));
+  if (p.i != 1024) return 1;
+
+  rt::Value ln = client.Call(
+      "builtins:len", {rt::Value::Str("hello-cross-language")});
+  std::printf("len=%lld\n", static_cast<long long>(ln.i));
+  if (ln.i != 20) return 1;
+
+  bool raised = false;
+  try {
+    client.Call("builtins:int", {rt::Value::Str("not-a-number")});
+  } catch (const std::exception& e) {
+    raised = true;
+    std::printf("error propagated: %s\n", e.what());
+  }
+  if (!raised) return 1;
+
+  std::printf("CPP_API_OK\n");
+  return 0;
+}

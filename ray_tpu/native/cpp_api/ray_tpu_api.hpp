@@ -1,0 +1,462 @@
+// C++ API frontend for the ray_tpu runtime.
+//
+// Counterpart of the reference's C++ API (reference: cpp/include/ray/api.h,
+// cpp/src/ray/runtime/abstract_ray_runtime.cc) re-designed for this
+// runtime's control plane: one framed-msgpack RPC protocol speaks directly
+// to the GCS, node managers and workers (no protobuf/gRPC layer), and
+// cross-language task calls name Python functions ("module:attr") with
+// msgpack-encoded arguments and results (KIND_MSGPACK on the wire).
+//
+// Synchronous, dependency-free (C++17, POSIX sockets). Usage:
+//
+//   rt::Client c;
+//   c.Connect("tcp:127.0.0.1:6379");
+//   rt::Value out = c.Call("builtins:pow", {rt::Value::Int(2),
+//                                           rt::Value::Int(10)});
+//
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <random>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace rt {
+
+// ----------------------------------------------------------- value model
+struct Value {
+  enum Type { NIL, BOOL, INT, FLOAT, STR, BIN, ARRAY, MAP };
+  Type type = NIL;
+  bool b = false;
+  int64_t i = 0;
+  double d = 0.0;
+  std::string s;                       // STR
+  std::vector<uint8_t> bin;            // BIN
+  std::vector<Value> arr;              // ARRAY
+  std::map<std::string, Value> obj;    // MAP (string keys)
+
+  static Value Nil() { return Value{}; }
+  static Value Bool(bool v) { Value x; x.type = BOOL; x.b = v; return x; }
+  static Value Int(int64_t v) { Value x; x.type = INT; x.i = v; return x; }
+  static Value Float(double v) { Value x; x.type = FLOAT; x.d = v; return x; }
+  static Value Str(std::string v) {
+    Value x; x.type = STR; x.s = std::move(v); return x;
+  }
+  static Value Bin(std::vector<uint8_t> v) {
+    Value x; x.type = BIN; x.bin = std::move(v); return x;
+  }
+  static Value Arr(std::vector<Value> v) {
+    Value x; x.type = ARRAY; x.arr = std::move(v); return x;
+  }
+  static Value Map(std::map<std::string, Value> v) {
+    Value x; x.type = MAP; x.obj = std::move(v); return x;
+  }
+
+  double AsNumber() const { return type == INT ? double(i) : d; }
+};
+
+// ----------------------------------------------------- msgpack encoding
+inline void PackTo(const Value& v, std::string* out);
+
+inline void PackU8(std::string* out, uint8_t b) { out->push_back(char(b)); }
+inline void PackBe(std::string* out, const void* p, size_t n) {
+  const uint8_t* u = static_cast<const uint8_t*>(p);
+  for (size_t k = 0; k < n; k++) out->push_back(char(u[n - 1 - k]));
+}
+
+inline void PackUint(std::string* out, uint64_t x) {
+  if (x < 128) {
+    PackU8(out, uint8_t(x));
+  } else if (x <= 0xff) {
+    PackU8(out, 0xcc); PackU8(out, uint8_t(x));
+  } else if (x <= 0xffff) {
+    uint16_t v = uint16_t(x); PackU8(out, 0xcd); PackBe(out, &v, 2);
+  } else if (x <= 0xffffffffULL) {
+    uint32_t v = uint32_t(x); PackU8(out, 0xce); PackBe(out, &v, 4);
+  } else {
+    PackU8(out, 0xcf); PackBe(out, &x, 8);
+  }
+}
+
+inline void PackInt(std::string* out, int64_t x) {
+  if (x >= 0) { PackUint(out, uint64_t(x)); return; }
+  if (x >= -32) { PackU8(out, uint8_t(0xe0 | (x + 32))); return; }
+  if (x >= INT8_MIN) { PackU8(out, 0xd0); PackU8(out, uint8_t(x)); return; }
+  if (x >= INT16_MIN) {
+    int16_t v = int16_t(x); PackU8(out, 0xd1); PackBe(out, &v, 2); return;
+  }
+  if (x >= INT32_MIN) {
+    int32_t v = int32_t(x); PackU8(out, 0xd2); PackBe(out, &v, 4); return;
+  }
+  PackU8(out, 0xd3); PackBe(out, &x, 8);
+}
+
+inline void PackStr(std::string* out, const std::string& s) {
+  size_t n = s.size();
+  if (n < 32) PackU8(out, uint8_t(0xa0 | n));
+  else if (n <= 0xff) { PackU8(out, 0xd9); PackU8(out, uint8_t(n)); }
+  else { uint16_t v = uint16_t(n); PackU8(out, 0xda); PackBe(out, &v, 2); }
+  out->append(s);
+}
+
+inline void PackBin(std::string* out, const uint8_t* p, size_t n) {
+  if (n <= 0xff) { PackU8(out, 0xc4); PackU8(out, uint8_t(n)); }
+  else if (n <= 0xffff) {
+    uint16_t v = uint16_t(n); PackU8(out, 0xc5); PackBe(out, &v, 2);
+  } else {
+    uint32_t v = uint32_t(n); PackU8(out, 0xc6); PackBe(out, &v, 4);
+  }
+  out->append(reinterpret_cast<const char*>(p), n);
+}
+
+inline void PackTo(const Value& v, std::string* out) {
+  switch (v.type) {
+    case Value::NIL: PackU8(out, 0xc0); break;
+    case Value::BOOL: PackU8(out, v.b ? 0xc3 : 0xc2); break;
+    case Value::INT: PackInt(out, v.i); break;
+    case Value::FLOAT: {
+      PackU8(out, 0xcb); PackBe(out, &v.d, 8); break;
+    }
+    case Value::STR: PackStr(out, v.s); break;
+    case Value::BIN: PackBin(out, v.bin.data(), v.bin.size()); break;
+    case Value::ARRAY: {
+      size_t n = v.arr.size();
+      if (n < 16) PackU8(out, uint8_t(0x90 | n));
+      else { uint16_t w = uint16_t(n); PackU8(out, 0xdc); PackBe(out, &w, 2); }
+      for (const auto& e : v.arr) PackTo(e, out);
+      break;
+    }
+    case Value::MAP: {
+      size_t n = v.obj.size();
+      if (n < 16) PackU8(out, uint8_t(0x80 | n));
+      else { uint16_t w = uint16_t(n); PackU8(out, 0xde); PackBe(out, &w, 2); }
+      for (const auto& kv : v.obj) { PackStr(out, kv.first); PackTo(kv.second, out); }
+      break;
+    }
+  }
+}
+
+// ----------------------------------------------------- msgpack decoding
+struct Cursor {
+  const uint8_t* p;
+  size_t n;
+  size_t off = 0;
+  uint8_t U8() {
+    if (off >= n) throw std::runtime_error("msgpack underrun");
+    return p[off++];
+  }
+  const uint8_t* Take(size_t k) {
+    if (off + k > n) throw std::runtime_error("msgpack underrun");
+    const uint8_t* q = p + off; off += k; return q;
+  }
+  uint64_t Be(size_t k) {
+    const uint8_t* q = Take(k);
+    uint64_t x = 0;
+    for (size_t j = 0; j < k; j++) x = (x << 8) | q[j];
+    return x;
+  }
+};
+
+inline Value Unpack(Cursor* c) {
+  uint8_t t = c->U8();
+  if (t < 0x80) return Value::Int(t);
+  if (t >= 0xe0) return Value::Int(int8_t(t));
+  if ((t & 0xf0) == 0x80) {  // fixmap
+    std::map<std::string, Value> m;
+    for (int k = t & 0x0f; k > 0; k--) {
+      Value key = Unpack(c);
+      m[key.s] = Unpack(c);
+    }
+    return Value::Map(std::move(m));
+  }
+  if ((t & 0xf0) == 0x90) {  // fixarray
+    std::vector<Value> a;
+    for (int k = t & 0x0f; k > 0; k--) a.push_back(Unpack(c));
+    return Value::Arr(std::move(a));
+  }
+  if ((t & 0xe0) == 0xa0) {  // fixstr
+    size_t k = t & 0x1f;
+    const uint8_t* q = c->Take(k);
+    return Value::Str(std::string(reinterpret_cast<const char*>(q), k));
+  }
+  switch (t) {
+    case 0xc0: return Value::Nil();
+    case 0xc2: return Value::Bool(false);
+    case 0xc3: return Value::Bool(true);
+    case 0xc4: case 0xc5: case 0xc6: {
+      size_t k = c->Be(t == 0xc4 ? 1 : t == 0xc5 ? 2 : 4);
+      const uint8_t* q = c->Take(k);
+      return Value::Bin(std::vector<uint8_t>(q, q + k));
+    }
+    case 0xca: {
+      uint32_t raw = uint32_t(c->Be(4));
+      float f;
+      std::memcpy(&f, &raw, 4);
+      return Value::Float(f);
+    }
+    case 0xcb: {
+      uint64_t raw = c->Be(8);
+      double d;
+      std::memcpy(&d, &raw, 8);
+      return Value::Float(d);
+    }
+    case 0xcc: return Value::Int(int64_t(c->Be(1)));
+    case 0xcd: return Value::Int(int64_t(c->Be(2)));
+    case 0xce: return Value::Int(int64_t(c->Be(4)));
+    case 0xcf: return Value::Int(int64_t(c->Be(8)));
+    case 0xd0: return Value::Int(int8_t(c->Be(1)));
+    case 0xd1: return Value::Int(int16_t(c->Be(2)));
+    case 0xd2: return Value::Int(int32_t(c->Be(4)));
+    case 0xd3: return Value::Int(int64_t(c->Be(8)));
+    case 0xd9: case 0xda: case 0xdb: {
+      size_t k = c->Be(t == 0xd9 ? 1 : t == 0xda ? 2 : 4);
+      const uint8_t* q = c->Take(k);
+      return Value::Str(std::string(reinterpret_cast<const char*>(q), k));
+    }
+    case 0xdc: case 0xdd: {
+      size_t k = c->Be(t == 0xdc ? 2 : 4);
+      std::vector<Value> a;
+      a.reserve(k);
+      for (size_t j = 0; j < k; j++) a.push_back(Unpack(c));
+      return Value::Arr(std::move(a));
+    }
+    case 0xde: case 0xdf: {
+      size_t k = c->Be(t == 0xde ? 2 : 4);
+      std::map<std::string, Value> m;
+      for (size_t j = 0; j < k; j++) {
+        Value key = Unpack(c);
+        m[key.s] = Unpack(c);
+      }
+      return Value::Map(std::move(m));
+    }
+  }
+  throw std::runtime_error("msgpack: unsupported tag");
+}
+
+// -------------------------------------------------- framed rpc transport
+class RpcConn {
+ public:
+  RpcConn() = default;
+  RpcConn(const RpcConn&) = delete;
+  RpcConn& operator=(const RpcConn&) = delete;
+
+  // addr: "tcp:host:port" (as advertised by the runtime)
+  void Connect(const std::string& addr) {
+    std::string a = addr;
+    if (a.rfind("tcp:", 0) == 0) a = a.substr(4);
+    size_t colon = a.rfind(':');
+    if (colon == std::string::npos)
+      throw std::runtime_error("bad address " + addr);
+    std::string host = a.substr(0, colon);
+    std::string port = a.substr(colon + 1);
+    if (host == "0.0.0.0") host = "127.0.0.1";
+    struct addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0)
+      throw std::runtime_error("resolve failed: " + host);
+    fd_ = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd_ < 0 || connect(fd_, res->ai_addr, res->ai_addrlen) != 0) {
+      freeaddrinfo(res);
+      throw std::runtime_error("connect failed: " + addr);
+    }
+    freeaddrinfo(res);
+  }
+
+  Value Call(const std::string& method, const Value& kwargs) {
+    // [REQUEST=0, seq, method, kwargs]
+    Value frame = Value::Arr({Value::Int(0), Value::Int(++seq_),
+                              Value::Str(method), kwargs});
+    std::string body;
+    PackTo(frame, &body);
+    uint32_t len = uint32_t(body.size());
+    uint8_t hdr[4] = {uint8_t(len), uint8_t(len >> 8), uint8_t(len >> 16),
+                      uint8_t(len >> 24)};
+    WriteAll(hdr, 4);
+    WriteAll(body.data(), body.size());
+    for (;;) {  // responses are in-order for a single-threaded client
+      uint8_t rh[4];
+      ReadAll(rh, 4);
+      uint32_t rlen = uint32_t(rh[0]) | uint32_t(rh[1]) << 8 |
+                      uint32_t(rh[2]) << 16 | uint32_t(rh[3]) << 24;
+      std::vector<uint8_t> buf(rlen);
+      ReadAll(buf.data(), rlen);
+      Cursor c{buf.data(), buf.size()};
+      Value msg = Unpack(&c);
+      if (msg.arr.size() < 4 || msg.arr[0].i != 1) continue;  // not a resp
+      if (msg.arr[1].i != seq_) continue;                     // stale
+      if (!msg.arr[2].b) {
+        const Value& err = msg.arr[3];
+        std::string what = "rpc error";
+        if (err.type == Value::ARRAY && err.arr.size() >= 2)
+          what = err.arr[0].s + ": " + err.arr[1].s;
+        throw std::runtime_error(what);
+      }
+      return msg.arr[3];
+    }
+  }
+
+  ~RpcConn() {
+    if (fd_ >= 0) close(fd_);
+  }
+
+ private:
+  void WriteAll(const void* p, size_t n) {
+    const char* q = static_cast<const char*>(p);
+    while (n) {
+      ssize_t w = ::write(fd_, q, n);
+      if (w <= 0) throw std::runtime_error("rpc write failed");
+      q += w;
+      n -= size_t(w);
+    }
+  }
+  void ReadAll(void* p, size_t n) {
+    char* q = static_cast<char*>(p);
+    while (n) {
+      ssize_t r = ::read(fd_, q, n);
+      if (r <= 0) throw std::runtime_error("rpc read failed");
+      q += r;
+      n -= size_t(r);
+    }
+  }
+  int fd_ = -1;
+  int64_t seq_ = 0;
+};
+
+// --------------------------------------------------------------- client
+class Client {
+ public:
+  void Connect(const std::string& gcs_addr) {
+    gcs_.Connect(gcs_addr);
+    Value nodes = gcs_.Call("get_all_nodes", Value::Map({}));
+    for (const auto& n : nodes.arr) {
+      auto alive = n.obj.find("alive");
+      if (alive != n.obj.end() && !alive->second.b) continue;
+      node_address_ = n.obj.at("address").s;
+      node_id_ = n.obj.at("node_id").s;
+      break;
+    }
+    if (node_address_.empty())
+      throw std::runtime_error("no alive nodes in cluster");
+    node_.Connect(node_address_);
+    std::mt19937_64 rng(std::random_device{}());
+    worker_id_ = "cpp-";
+    for (int k = 0; k < 4; k++)
+      worker_id_ += "0123456789abcdef"[rng() % 16];
+  }
+
+  // Call a Python function by "module:attr" with msgpack args; blocks for
+  // the result (one lease per call; idle-lease reuse is the Python
+  // submitter's optimization, correctness is identical).
+  Value Call(const std::string& func_ref, const std::vector<Value>& args,
+             double num_cpus = 1.0) {
+    Value lease = RequestLease(num_cpus);
+    RpcConn worker;
+    worker.Connect(lease.obj.at("worker_address").s);
+    const std::string grant_node =
+        lease.obj.count("node_address") ? lease.obj.at("node_address").s
+                                        : node_address_;
+
+    std::mt19937_64 rng(std::random_device{}());
+    std::vector<uint8_t> task_id(16), ret_id;
+    for (auto& b : task_id) b = uint8_t(rng());
+    ret_id = task_id;
+    ret_id.push_back(0);
+    ret_id.push_back(0);
+    ret_id.push_back(0);
+    ret_id.push_back(1);  // return index 1, big-endian
+
+    std::vector<Value> enc_args;
+    for (const auto& a : args) {
+      std::string payload;
+      PackTo(a, &payload);
+      enc_args.push_back(Value::Arr(
+          {Value::Str("v"), Value::Int(3) /* KIND_MSGPACK */,
+           Value::Bin({}),
+           Value::Arr({Value::Bin(std::vector<uint8_t>(
+               payload.begin(), payload.end()))})}));
+    }
+    Value spec = Value::Map({
+        {"task_id", Value::Bin(task_id)},
+        {"job_id", Value::Int(0)},
+        {"name", Value::Str(func_ref)},
+        {"func_ref", Value::Str(func_ref)},
+        {"args", Value::Arr(std::move(enc_args))},
+        {"kwargs", Value::Map({})},
+        {"return_ids", Value::Arr({Value::Bin(ret_id)})},
+        {"owner_address", Value::Str("cpp-client")},
+        {"owner_node", Value::Str(node_id_)},
+        {"xlang", Value::Bool(true)},
+    });
+    Value resp;
+    try {
+      resp = worker.Call("push_task", Value::Map({{"spec", spec}}));
+    } catch (...) {
+      ReturnLease(grant_node, lease, /*worker_dead=*/true);
+      throw;
+    }
+    ReturnLease(grant_node, lease, false);
+    const Value& ret = resp.obj.at("returns").arr.at(0);
+    // ["wire", kind, pkl, [payloads]]
+    int64_t kind = ret.arr.at(1).i;
+    if (kind == 1)
+      throw std::runtime_error("remote task failed: " + func_ref);
+    const auto& payload = ret.arr.at(3).arr.at(0).bin;
+    Cursor c{payload.data(), payload.size()};
+    return Unpack(&c);
+  }
+
+ private:
+  Value RequestLease(double num_cpus) {
+    RpcConn* target = &node_;
+    std::unique_ptr<RpcConn> spill_conn;
+    for (int hop = 0; hop < 8; hop++) {
+      Value resp = target->Call(
+          "request_lease",
+          Value::Map({{"resources",
+                       Value::Map({{"CPU", Value::Float(num_cpus)}})},
+                      {"scheduling", Value::Map({})},
+                      {"worker_id", Value::Str(worker_id_)},
+                      {"spilled", Value::Bool(hop > 0)}}));
+      const std::string& status = resp.obj.at("status").s;
+      if (status == "ok") return resp;
+      if (status == "spill") {
+        spill_conn = std::make_unique<RpcConn>();
+        spill_conn->Connect(resp.obj.at("spill_to").s);
+        target = spill_conn.get();
+        continue;
+      }
+      throw std::runtime_error("lease denied");
+    }
+    throw std::runtime_error("lease spillback loop");
+  }
+
+  void ReturnLease(const std::string& grant_node, const Value& lease,
+                   bool worker_dead) {
+    try {
+      RpcConn conn;
+      conn.Connect(grant_node);
+      conn.Call("return_lease",
+                Value::Map({{"lease_id", lease.obj.at("lease_id")},
+                            {"worker_dead", Value::Bool(worker_dead)}}));
+    } catch (...) {
+    }
+  }
+
+  RpcConn gcs_;
+  RpcConn node_;
+  std::string node_address_;
+  std::string node_id_;
+  std::string worker_id_;
+};
+
+}  // namespace rt

@@ -1,0 +1,52 @@
+"""C++ API frontend test (reference: cpp/include/ray/api.h + the C++
+runtime): compile the example against ray_tpu_api.hpp, run it against a
+live cluster, and check cross-language task calls (msgpack args/results,
+error propagation)."""
+
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HOST_SCRIPT = """
+import time
+import ray_tpu
+ray_tpu.init(num_cpus=2)
+print(f"GCS={ray_tpu.get_gcs_address()}", flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+def test_cpp_client_cross_language(tmp_path):
+    binary = str(tmp_path / "cpp_example")
+    build = subprocess.run(
+        ["g++", "-std=c++17", "-O2", "-o", binary,
+         os.path.join(ROOT, "ray_tpu/native/cpp_api/example.cpp"),
+         "-I", os.path.join(ROOT, "ray_tpu/native/cpp_api")],
+        capture_output=True, text=True, timeout=120)
+    assert build.returncode == 0, build.stderr
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    host = subprocess.Popen([sys.executable, "-c", HOST_SCRIPT],
+                            stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        gcs = None
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            line = host.stdout.readline()
+            if line.startswith("GCS="):
+                gcs = line.strip().split("=", 1)[1]
+                break
+        assert gcs, "cluster did not start"
+        out = subprocess.run([binary, gcs], capture_output=True, text=True,
+                             timeout=120)
+        assert "CPP_API_OK" in out.stdout, out.stdout + out.stderr
+        assert "pow=1024" in out.stdout
+        assert "error propagated" in out.stdout
+    finally:
+        host.terminate()
+        host.wait(timeout=10)
