@@ -56,7 +56,11 @@ class RemoteFunction:
     def __init__(self, function, options: Optional[Dict[str, Any]] = None):
         self._function = function
         self._options = options or {}
-        functools.update_wrapper(self, function)
+        try:
+            functools.update_wrapper(self, function)
+        except AttributeError:
+            # callables without __name__/__doc__ (e.g. joblib wrappers)
+            self.__name__ = type(function).__name__
 
     def remote(self, *args, **kwargs):
         from ray_tpu.client import current_client
@@ -74,7 +78,8 @@ class RemoteFunction:
             resources=_resources_from_options(opts),
             max_retries=opts.get("max_retries", 3),
             scheduling=_scheduling_from_options(opts),
-            name=opts.get("name") or self._function.__name__,
+            name=opts.get("name") or getattr(self._function, "__name__",
+                                 type(self._function).__name__),
             runtime_env=opts.get("runtime_env"))
         return refs[0] if num_returns == 1 else refs
 

@@ -1,0 +1,52 @@
+"""Joblib backend running parallel work as cluster tasks (reference:
+python/ray/util/joblib/ — register_ray + RayBackend over the Pool API).
+Usage:
+
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        Parallel(n_jobs=8)(delayed(f)(i) for i in range(100))
+"""
+
+from __future__ import annotations
+
+
+def register_ray_tpu():
+    from joblib import register_parallel_backend
+    from joblib._parallel_backends import MultiprocessingBackend
+
+    class RayTpuBackend(MultiprocessingBackend):
+        """Joblib backend whose pool is the cluster-task Pool."""
+
+        supports_timeout = True
+
+        def effective_n_jobs(self, n_jobs):
+            import ray_tpu
+            if n_jobs == 1:
+                return 1
+            cpus = int(ray_tpu.cluster_resources().get("CPU", 1)) \
+                if ray_tpu.is_initialized() else 4
+            return cpus if n_jobs in (-1, None) else min(n_jobs, cpus)
+
+        def configure(self, n_jobs=1, parallel=None, prefer=None,
+                      require=None, **kwargs):
+            n_jobs = self.effective_n_jobs(n_jobs)
+            if n_jobs == 1:
+                raise FallbackToBackend(None)
+            from ray_tpu.util.multiprocessing import Pool
+            self._pool = Pool(processes=n_jobs)
+            self.parallel = parallel
+            return n_jobs
+
+        def terminate(self):
+            if getattr(self, "_pool", None) is not None:
+                self._pool.terminate()
+                self._pool = None
+
+    try:
+        from joblib._parallel_backends import FallbackToBackend
+    except ImportError:  # pragma: no cover
+        class FallbackToBackend(Exception):
+            pass
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)

@@ -82,10 +82,25 @@ class Pool:
     def apply(self, func, args=(), kwds=None):
         return self.apply_async(func, args, kwds).get()
 
-    def apply_async(self, func, args=(), kwds=None) -> AsyncResult:
+    def apply_async(self, func, args=(), kwds=None, callback=None,
+                    error_callback=None) -> AsyncResult:
         import ray_tpu
         rf = ray_tpu.remote(self._wrap(func))
-        return AsyncResult([rf.remote(*args, **(kwds or {}))], single=True)
+        ar = AsyncResult([rf.remote(*args, **(kwds or {}))], single=True)
+        if callback is not None or error_callback is not None:
+            import threading
+
+            def watch():
+                try:
+                    value = ar.get()
+                except Exception as e:
+                    if error_callback is not None:
+                        error_callback(e)
+                    return
+                if callback is not None:
+                    callback(value)
+            threading.Thread(target=watch, daemon=True).start()
+        return ar
 
     def map(self, func, iterable, chunksize=None) -> List:
         return AsyncResult(self._submit(func, ((x,) for x in iterable)),
