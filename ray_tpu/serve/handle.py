@@ -53,6 +53,42 @@ class DeploymentResponse:
         self._settle()
 
 
+class DeploymentResponseGenerator:
+    """Iterator over a streaming deployment call (reference:
+    DeploymentResponseGenerator, serve/handle.py)."""
+
+    def __init__(self, replica, stream_id: str, router, replica_idx):
+        self._replica = replica
+        self._sid = stream_id
+        self._router = router
+        self._idx = replica_idx
+        self._buf: List = []
+        self._done = False
+        self._error: Optional[str] = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while not self._buf:
+            if self._done:
+                self._settle()
+                if self._error:
+                    raise RuntimeError(f"stream failed: {self._error}")
+                raise StopIteration
+            chunks, done, error = ray_tpu.get(
+                self._replica.stream_next.remote(self._sid), timeout=60)
+            self._buf.extend(chunks)
+            self._done = done
+            self._error = error
+        return self._buf.pop(0)
+
+    def _settle(self):
+        if self._router is not None:
+            self._router._dec(self._idx)
+            self._router = None
+
+
 class _Router:
     def __init__(self, deployment_name: str, app_name: str):
         self.deployment_name = deployment_name
@@ -136,10 +172,16 @@ class DeploymentHandle:
         model_id = getattr(self, "_model_id", "")
         if model_id:
             kwargs = {**kwargs, "__serve_model_id": model_id}
+        stream = getattr(self, "_stream", False)
         last_err = None
         for _ in range(retry + 1):
             idx, replica = self._router.pick(model_id)
             try:
+                if stream:
+                    sid = ray_tpu.get(replica.start_stream.remote(
+                        method, args, kwargs), timeout=60)
+                    return DeploymentResponseGenerator(
+                        replica, sid, self._router, idx)
                 ref = replica.handle_request.remote(method, args, kwargs)
                 return DeploymentResponse(ref, self._router, idx)
             except Exception as e:
@@ -157,12 +199,14 @@ class DeploymentHandle:
         return _MethodCaller(self, name)
 
     def options(self, *, multiplexed_model_id: str = "",
-                **_kw) -> "DeploymentHandle":
-        if not multiplexed_model_id:
+                stream: bool = False, **_kw) -> "DeploymentHandle":
+        if not multiplexed_model_id and not stream:
             return self
         clone = DeploymentHandle(self.deployment_name, self.app_name)
         clone._router = self._router          # share routing state
-        clone._model_id = multiplexed_model_id
+        if multiplexed_model_id:
+            clone._model_id = multiplexed_model_id
+        clone._stream = stream
         return clone
 
     def __reduce__(self):

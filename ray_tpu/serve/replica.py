@@ -67,6 +67,68 @@ class Replica:
             with self._lock:
                 self._ongoing -= 1
 
+    # ------------------------------------------------------------ streaming
+    def start_stream(self, method: str, args: Tuple, kwargs: Dict) -> str:
+        """Run a generator method; chunks buffer server-side and drain via
+        stream_next (reference: streaming DeploymentResponseGenerator,
+        serve/handle.py — there gRPC streaming, here chunked polls)."""
+        import queue
+        import threading
+        import uuid
+        model_id = kwargs.pop("__serve_model_id", "")
+        sid = uuid.uuid4().hex
+        q: "queue.Queue" = queue.Queue()
+        if not hasattr(self, "_streams"):
+            self._streams = {}
+        self._streams[sid] = q
+
+        def run():
+            from ray_tpu.serve import multiplex
+            tok = multiplex._set_model_id(model_id)
+            try:
+                fn = self._callable if self._is_function \
+                    else getattr(self._callable, method)
+                out = fn(*args, **kwargs)
+                for chunk in out:
+                    q.put(("chunk", chunk))
+                q.put(("done", None))
+            except BaseException as e:
+                q.put(("error", f"{type(e).__name__}: {e}"))
+            finally:
+                multiplex._current_model_id.reset(tok)
+
+        threading.Thread(target=run, daemon=True).start()
+        return sid
+
+    def stream_next(self, stream_id: str, max_n: int = 64,
+                    timeout: float = 10.0):
+        """Returns (chunks, done, error)."""
+        import queue
+        q = self._streams.get(stream_id)
+        if q is None:
+            return [], True, "unknown stream"
+        chunks = []
+        done = False
+        error = None
+        try:
+            kind, payload = q.get(timeout=timeout)
+            while True:
+                if kind == "chunk":
+                    chunks.append(payload)
+                elif kind == "done":
+                    done = True
+                else:
+                    error = payload
+                    done = True
+                if done or len(chunks) >= max_n:
+                    break
+                kind, payload = q.get_nowait()
+        except queue.Empty:
+            pass
+        if done:
+            self._streams.pop(stream_id, None)
+        return chunks, done, error
+
     def get_queue_len(self) -> int:
         return self._ongoing
 

@@ -1,0 +1,42 @@
+"""Streaming deployment responses (reference: serve streaming handles —
+DeploymentResponseGenerator): generator methods stream chunks through
+chunked polls; errors mid-stream surface to the consumer."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    ctx = ray_tpu.init(num_cpus=4)
+    yield ctx
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_streaming_handle(ray_start):
+    @serve.deployment
+    class Streamer:
+        def __call__(self, n):
+            for i in range(n):
+                yield {"token": i}
+
+        def fail_midway(self, n):
+            for i in range(n):
+                if i == 3:
+                    raise ValueError("midstream boom")
+                yield i
+
+    serve.run(Streamer.bind(), name="stream-app")
+    h = serve.get_app_handle("stream-app").options(stream=True)
+    chunks = list(h.remote(5))
+    assert chunks == [{"token": i} for i in range(5)]
+
+    gen = h.fail_midway.remote(10)
+    got = []
+    with pytest.raises(RuntimeError, match="midstream boom"):
+        for c in gen:
+            got.append(c)
+    assert got == [0, 1, 2]
