@@ -139,6 +139,7 @@ class CoreWorker:
         # leases
         self._idle_leases: Dict[tuple, List[Lease]] = {}
         self._lease_reaper: Optional[asyncio.Task] = None
+        self._sig_queues: Dict[tuple, Dict] = {}   # per-signature dispatch
 
         # actor handles (submission side)
         self.actor_handles: Dict[str, ActorHandleState] = {}
@@ -645,50 +646,94 @@ class CoreWorker:
         self.pending_tasks[task_id] = pt
         self._record_task_event(task_id, "PENDING", name=spec["name"],
                                 job_id=self.job_id, type="NORMAL_TASK")
-        asyncio.ensure_future(self._run_task(pt, resources, scheduling or {}))
+        self._enqueue_task(pt, resources, scheduling or {})
         return refs
 
-    async def _run_task(self, pt: PendingTask, resources, scheduling):
+    # Per-signature dispatch: tasks queue by (resources, scheduling)
+    # signature and a bounded set of dispatchers each hold ONE lease and
+    # run queued tasks on it serially (reference: NormalTaskSubmitter —
+    # bounded in-flight lease requests + task pipelining onto granted
+    # workers, normal_task_submitter.cc). Without this, N concurrent
+    # submissions issue N simultaneous lease requests and the node
+    # manager's waiter queue becomes the bottleneck.
+    MAX_DISPATCHERS_PER_SIG = 32
+
+    def _enqueue_task(self, pt: PendingTask, resources, scheduling):
+        sig = self._lease_sig(resources, scheduling)
+        st = self._sig_queues.get(sig)
+        if st is None:
+            st = {"queue": __import__("collections").deque(),
+                  "dispatchers": 0, "resources": resources,
+                  "scheduling": scheduling}
+            self._sig_queues[sig] = st
+        st["queue"].append(pt)
+        # spawn when the queue is deeper than the dispatcher count, and
+        # always when an idle lease can serve the task immediately —
+        # otherwise a dispatcher blocked in a server-side lease wait
+        # would serialize fresh submissions behind grant latency
+        if (st["dispatchers"] < self.MAX_DISPATCHERS_PER_SIG
+                and (st["dispatchers"] < len(st["queue"])
+                     or self._idle_leases.get(sig))):
+            st["dispatchers"] += 1
+            asyncio.ensure_future(self._dispatch_loop(sig, st))
+
+    async def _dispatch_loop(self, sig, st):
         try:
-            while True:
-                if pt.cancelled:
-                    self._fail_task(pt, TaskCancelledError(pt.spec["name"]))
-                    return
+            while st["queue"]:
                 try:
-                    lease = await self._acquire_lease(resources, scheduling)
+                    lease = await self._acquire_lease(st["resources"],
+                                                      st["scheduling"])
                 except Exception as e:
-                    self._fail_task(pt, RuntimeError(f"lease failed: {e}"))
-                    return
-                if pt.cancelled:
-                    # cancel arrived while queued for a lease (reference:
-                    # CoreWorker::CancelTask drops queued tasks)
+                    if st["queue"]:
+                        pt = st["queue"].popleft()
+                        self._fail_task(pt, RuntimeError(
+                            f"lease failed: {e}"))
+                        self.pending_tasks.pop(pt.spec["task_id"], None)
+                    continue
+                lease_ok = True
+                while st["queue"] and lease_ok:
+                    pt = st["queue"].popleft()
+                    lease_ok = await self._run_on_lease(pt, lease, st)
+                if lease_ok:
                     await self._return_lease(lease)
-                    self._fail_task(pt, TaskCancelledError(pt.spec["name"]))
-                    return
-                try:
-                    if lease.resource_ids:
-                        pt.spec["accelerator_ids"] = lease.resource_ids
-                    pt.current_worker = lease.worker_address
-                    conn = await self.pool.get(lease.worker_address)
-                    resp = await conn.call("push_task", spec=pt.spec)
-                except (rpc.ConnectionLost, ConnectionError, rpc.RpcError) as e:
-                    await self._drop_lease(lease, dead=True)
-                    if isinstance(e, rpc.RpcError):
-                        self._fail_task(pt, RuntimeError(f"push failed: {e}"))
-                        return
-                    if pt.retries_left > 0:
-                        pt.retries_left -= 1
-                        logger.warning("task %s worker died; retrying (%d left)",
-                                       pt.spec["name"], pt.retries_left)
-                        continue
-                    self._fail_task(pt, WorkerCrashedError(
-                        f"worker died running {pt.spec['name']}"))
-                    return
-                await self._return_lease(lease)
-                self._complete_task(pt, resp)
-                return
         finally:
-            self.pending_tasks.pop(pt.spec["task_id"], None)
+            st["dispatchers"] -= 1
+            if not st["queue"] and st["dispatchers"] == 0:
+                self._sig_queues.pop(sig, None)
+
+    async def _run_on_lease(self, pt: PendingTask, lease, st) -> bool:
+        """Run one task on a held lease. Returns False if the lease died
+        (caller must stop using it). The pending_tasks entry stays alive
+        only while the task can still run (requeued for retry)."""
+        task_id = pt.spec["task_id"]
+        if pt.cancelled:
+            self._fail_task(pt, TaskCancelledError(pt.spec["name"]))
+            self.pending_tasks.pop(task_id, None)
+            return True
+        try:
+            if lease.resource_ids:
+                pt.spec["accelerator_ids"] = lease.resource_ids
+            pt.current_worker = lease.worker_address
+            conn = await self.pool.get(lease.worker_address)
+            resp = await conn.call("push_task", spec=pt.spec)
+        except (rpc.ConnectionLost, ConnectionError, rpc.RpcError) as e:
+            await self._drop_lease(lease, dead=True)
+            if isinstance(e, rpc.RpcError):
+                self._fail_task(pt, RuntimeError(f"push failed: {e}"))
+                self.pending_tasks.pop(task_id, None)
+            elif pt.retries_left > 0:
+                pt.retries_left -= 1
+                logger.warning("task %s worker died; retrying (%d left)",
+                               pt.spec["name"], pt.retries_left)
+                st["queue"].appendleft(pt)   # keep pending for retry
+            else:
+                self._fail_task(pt, WorkerCrashedError(
+                    f"worker died running {pt.spec['name']}"))
+                self.pending_tasks.pop(task_id, None)
+            return False
+        self._complete_task(pt, resp)
+        self.pending_tasks.pop(task_id, None)
+        return True
 
     def _complete_task(self, pt: PendingTask, resp: Dict):
         self._record_task_event(pt.spec["task_id"], "FINISHED")
