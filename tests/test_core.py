@@ -226,3 +226,19 @@ def test_nested_tasks(ray_start):
 def test_cluster_resources(ray_start):
     total = ray_tpu.cluster_resources()
     assert total.get("CPU") == 4.0
+
+
+def test_large_task_fan(ray_start):
+    """A 1000-task fan must complete promptly: submissions pipeline onto
+    a bounded set of leases instead of issuing 1000 lease requests
+    (reference: NormalTaskSubmitter lease pipelining)."""
+    import time
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    t0 = time.monotonic()
+    out = ray_tpu.get([inc.remote(i) for i in range(1000)], timeout=120)
+    assert out == [i + 1 for i in range(1000)]
+    assert time.monotonic() - t0 < 60
