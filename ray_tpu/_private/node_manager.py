@@ -452,17 +452,27 @@ class NodeManager:
                             "spill_to": view[target]["address"]}
             if scheduling_fits(pool_avail, resources) \
                     and self._chips_fit(resources):
+                # chips must be claimed atomically with the float
+                # accounting: _obtain_worker suspends, and a concurrent
+                # request could drain the pool between check and allocate
                 scheduling_sub(pool_avail, resources)
+                chips = self._allocate_chips(resources)
                 try:
                     w = await self._obtain_worker()
                 except RuntimeError as e:
+                    self._free_chips.extend(chips)
                     scheduling_addback(pool_avail, resources)
                     return {"status": "error", "reason": str(e)}
+                except BaseException:
+                    # OSError from spawn, CancelledError from a dropped
+                    # caller, ... — never leak the claimed chips/resources
+                    self._free_chips.extend(chips)
+                    scheduling_addback(pool_avail, resources)
+                    raise
                 self._lease_seq += 1
                 lease_id = f"{self.node_id[:8]}-{self._lease_seq}"
                 w.state = "leased"
                 w.lease_id = lease_id
-                chips = self._allocate_chips(resources)
                 self._leases[lease_id] = {"worker": w, "resources": resources,
                                           "bundle": bundle, "chips": chips}
                 return {"status": "ok", "lease_id": lease_id,
@@ -586,10 +596,13 @@ class NodeManager:
                 await asyncio.wait_for(fut, timeout=0.5)
             except asyncio.TimeoutError:
                 pass
+        # claim chips atomically with the float accounting (see h_lease)
         scheduling_sub(pool_avail, resources)
+        chips = self._allocate_chips(resources)
         try:
             w = await self._obtain_worker()
-        except RuntimeError:
+        except BaseException:
+            self._free_chips.extend(chips)
             scheduling_addback(pool_avail, resources)
             raise
         w.state = "actor"
@@ -598,7 +611,6 @@ class NodeManager:
         # _on_worker_death releases the resources on crash
         lease_id = f"actor-{spec['actor_id']}-{w.worker_id[:8]}"
         w.lease_id = lease_id
-        chips = self._allocate_chips(resources)
         self._leases[lease_id] = {"worker": w, "resources": resources,
                                   "bundle": bundle, "chips": chips}
         if chips:

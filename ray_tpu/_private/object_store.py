@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import sys
 from typing import Optional, Tuple
 
 from ray_tpu.native.build import build
@@ -68,6 +69,13 @@ class _Lib:
 
 def store_path(session_name: str, node_id_hex: str) -> str:
     return f"/dev/shm/raytpu_{session_name}_{node_id_hex[:12]}"
+
+
+if sys.version_info < (3, 12):  # pragma: no cover
+    raise ImportError(
+        "ray_tpu requires Python >= 3.12: zero-copy object reads tie shm "
+        "pins to derived views via the PEP 688 __buffer__ protocol "
+        "(see pyproject.toml requires-python)")
 
 
 class _PinnedRegion:

@@ -536,23 +536,27 @@ class CoreWorker:
     # ---------------------------------------------------------------- wait
     async def wait_async(self, refs: List[ObjectRef], num_returns: int,
                          timeout: Optional[float]):
+        # mirror the reference's contract: duplicates are rejected rather
+        # than silently collapsed (ray.wait raises on duplicate refs)
+        if len({r.id for r in refs}) != len(refs):
+            raise ValueError("wait() expects a list of distinct ObjectRefs")
         pending = {asyncio.ensure_future(self._resolve(r)): r for r in refs}
-        ready: List[ObjectRef] = []
+        ready_ids = set()
         deadline = None if timeout is None else time.monotonic() + timeout
-        while pending and len(ready) < num_returns:
+        while pending and len(ready_ids) < num_returns:
             tmo = None if deadline is None else max(0, deadline - time.monotonic())
             done, _ = await asyncio.wait(pending.keys(), timeout=tmo,
                                          return_when=asyncio.FIRST_COMPLETED)
             if not done:
                 break
             for fut in done:
-                ready.append(pending.pop(fut))
+                ready_ids.add(pending.pop(fut).id)
         for fut in pending:
             fut.cancel()
-        not_ready = [r for r in refs if r not in ready]
-        ready_in_order = [r for r in refs if r in ready][:num_returns]
-        extra = [r for r in ready if r not in ready_in_order]
-        return ready_in_order, extra + not_ready
+        ready_in_order = [r for r in refs if r.id in ready_ids][:num_returns]
+        taken = {r.id for r in ready_in_order}
+        rest = [r for r in refs if r.id not in taken]
+        return ready_in_order, rest
 
     # ---------------------------------------------------- function shipping
     def _function_key(self, pickled: bytes) -> bytes:
@@ -693,12 +697,32 @@ class CoreWorker:
                 lease_ok = True
                 while st["queue"] and lease_ok:
                     pt = st["queue"].popleft()
-                    lease_ok = await self._run_on_lease(pt, lease, st)
+                    try:
+                        lease_ok = await self._run_on_lease(pt, lease, st)
+                    except Exception as e:
+                        # unexpected failure must not strand the queue:
+                        # fail this task, drop the (suspect) lease, keep
+                        # draining with a fresh one
+                        logger.exception("dispatcher error running %s",
+                                         pt.spec.get("name"))
+                        self._fail_task(pt, RuntimeError(
+                            f"dispatch failed: {e}"))
+                        self.pending_tasks.pop(pt.spec["task_id"], None)
+                        await self._drop_lease(lease, dead=True)
+                        lease_ok = False
                 if lease_ok:
-                    await self._return_lease(lease)
+                    try:
+                        await self._return_lease(lease)
+                    except Exception:
+                        logger.exception("lease return failed")
         finally:
             st["dispatchers"] -= 1
-            if not st["queue"] and st["dispatchers"] == 0:
+            if st["queue"] and st["dispatchers"] == 0:
+                # we were the last dispatcher and tasks remain (e.g. an
+                # exception escaped above): respawn so callers never hang
+                st["dispatchers"] += 1
+                asyncio.ensure_future(self._dispatch_loop(sig, st))
+            elif not st["queue"] and st["dispatchers"] == 0:
                 self._sig_queues.pop(sig, None)
 
     async def _run_on_lease(self, pt: PendingTask, lease, st) -> bool:

@@ -42,7 +42,14 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     tie_embeddings: bool = False
     remat: bool = True
+    # checkpoint policy: "nothing" (recompute all), "dots" (save matmul
+    # outputs — usually fastest on TPU: backward reuses MXU results and
+    # recomputes only cheap elementwise), "dots_no_batch"
+    remat_policy: str = "dots"
     scan_layers: bool = True
+    # keep logits in bf16 and let the loss upcast inside its reductions —
+    # avoids materializing a [B,L,vocab] fp32 buffer (HBM traffic)
+    logits_fp32: bool = False
     # "auto": flash kernel on 1 seq shard, ring attention when seq axis > 1
     attention_impl: str = "auto"
     seq_axis: str = "seq"
@@ -195,12 +202,22 @@ class TransformerLM(nn.Module):
             (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
         x = embed.astype(cfg.dtype)[tokens]
 
+        policies = {
+            "nothing": jax.checkpoint_policies.nothing_saveable,
+            "dots": jax.checkpoint_policies.checkpoint_dots,
+            "dots_no_batch":
+                jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+        }
+        if cfg.remat and cfg.remat_policy not in policies:
+            raise ValueError(
+                f"remat_policy={cfg.remat_policy!r}; expected one of "
+                f"{sorted(policies)}")
+        remat_policy = policies.get(cfg.remat_policy)
         if cfg.scan_layers:
             scan_target = ScanBlock
             if cfg.remat:
                 scan_target = nn.remat(
-                    ScanBlock, prevent_cse=False,
-                    policy=jax.checkpoint_policies.nothing_saveable)
+                    ScanBlock, prevent_cse=False, policy=remat_policy)
             stack = nn.scan(
                 scan_target,
                 variable_axes={"params": 0},
@@ -215,8 +232,7 @@ class TransformerLM(nn.Module):
             block = Block
             if cfg.remat:
                 block = nn.remat(
-                    Block, prevent_cse=False,
-                    policy=jax.checkpoint_policies.nothing_saveable)
+                    Block, prevent_cse=False, policy=remat_policy)
             aux_total = jnp.zeros((), jnp.float32)
             for i in range(cfg.n_layers):
                 x, aux_i = block(cfg, name=f"layer_{i}")(x, positions)
@@ -235,7 +251,7 @@ class TransformerLM(nn.Module):
                 "unembed", _p(nn.initializers.normal(0.02), "embed", "vocab"),
                 (cfg.d_model, cfg.vocab_size), cfg.param_dtype)
             logits = jnp.einsum("bld,dv->blv", x, out.astype(cfg.dtype))
-        return logits.astype(jnp.float32)
+        return logits.astype(jnp.float32) if cfg.logits_fp32 else logits
 
 
 def count_params(params) -> int:

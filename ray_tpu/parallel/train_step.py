@@ -118,9 +118,12 @@ def state_shardings(abstract_state, mesh: Mesh, rules=None):
 
 
 def cross_entropy_loss(logits, targets, mask=None):
-    logz = jax.nn.logsumexp(logits, axis=-1)
+    # logits may be bf16 (TransformerConfig.logits_fp32=False): upcast
+    # inside the reduction so XLA fuses the convert into logsumexp instead
+    # of materializing a [B,L,vocab] fp32 buffer in HBM
+    logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    nll = logz - gold
+    nll = logz - gold.astype(jnp.float32)
     if mask is None:
         return nll.mean(), nll.size
     mask = mask.astype(jnp.float32)

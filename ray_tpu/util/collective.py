@@ -144,38 +144,90 @@ def allreduce(tensor, group_name: str = "default", op: str = "sum"):
     return out
 
 
+# jit cache for the device-collective closures: jax.jit keys on function
+# identity, so a fresh shard_map per call would retrace + recompile every
+# invocation. Keyed by (kind, op/src, ndev) — shapes/dtypes are handled by
+# jit's own cache once the callable is stable.
+_XLA_FNS: Dict[tuple, Any] = {}
+
+
+def _xla_mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices())
+    return Mesh(devs, ("all",)), jax.local_device_count()
+
+
 def _xla_allreduce(tensor, op: str):
     """Cross-process device allreduce: under jax.distributed all processes'
     devices form one global mesh; psum over it."""
     import jax
     import jax.numpy as jnp
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
     from jax.experimental.shard_map import shard_map
 
-    devs = np.array(jax.devices())
-    mesh = Mesh(devs, ("all",))
-    red = {"sum": "psum", "max": "pmax", "min": "pmin"}[op]
-    n_local = jax.local_device_count()
+    if op not in ("sum", "max", "min", "product"):
+        raise ValueError(f"unsupported allreduce op {op!r}")
+    mesh, n_local = _xla_mesh()
+    key = ("ar", op, mesh.size)
+    fn = _XLA_FNS.get(key)
+    if fn is None:
+        def f(x):
+            import jax.lax as lax
+            if op == "product":
+                # pprod via psum of logs is lossy — use all_gather+reduce;
+                # P() replicates per process onto its local devices: take
+                # one representative per process (homogeneous hosts)
+                g = lax.all_gather(x, "all")
+                return jnp.prod(g[::n_local], axis=0)
+            out = getattr(lax, {"sum": "psum", "max": "pmax",
+                                "min": "pmin"}[op])(x, "all")
+            if op == "sum":
+                # P() replicates each process's tensor onto all of its
+                # local devices; psum then counts every local copy —
+                # divide the multiplicity back out (exact for the k*n/n
+                # case, so cast back for integer tensors)
+                out = (out / n_local).astype(x.dtype)
+            return out
 
-    def f(x):
-        import jax.lax as lax
-        out = getattr(lax, red)(x, "all")
-        if red == "psum":
-            # P() replicates each process's tensor onto all of its local
-            # devices; psum then counts every local copy, so divide the
-            # per-process multiplicity back out (homogeneous hosts)
-            out = out / n_local
-        return out
-
-    g = shard_map(f, mesh=mesh, in_specs=P(), out_specs=P(),
-                  check_rep=False)
-    return jax.jit(g)(tensor)
+        fn = jax.jit(shard_map(f, mesh=mesh, in_specs=P(), out_specs=P(),
+                               check_rep=False))
+        _XLA_FNS[key] = fn
+    return fn(tensor)
 
 
 def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
     group = _GROUPS[group_name]
+    if group.backend == "xla":
+        return _xla_allgather(tensor)
     arr = np.asarray(tensor)
     return _store_exchange(group, arr, "ag")
+
+
+def _xla_allgather(tensor) -> List:
+    """Device all_gather across all processes' devices; returns one entry
+    per process (mirrors the store backend's per-rank list)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    mesh, n_local = _xla_mesh()
+    key = ("ag", mesh.size)
+    fn = _XLA_FNS.get(key)
+    if fn is None:
+        def f(x):
+            # every shard computes the identical [n_dev, ...] stack, so the
+            # result is replicated — out_specs=P() returns it once
+            return jax.lax.all_gather(x, "all")
+
+        fn = jax.jit(shard_map(f, mesh=mesh, in_specs=P(), out_specs=P(),
+                               check_rep=False))
+        _XLA_FNS[key] = fn
+    out = fn(tensor)
+    # one representative copy per process (each process's tensor was
+    # replicated over its local devices)
+    return [out[i] for i in range(0, out.shape[0], n_local)]
 
 
 def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
@@ -187,6 +239,8 @@ def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     group = _GROUPS[group_name]
+    if group.backend == "xla":
+        return _xla_broadcast(tensor, src_rank, group)
     import ray_tpu
     import cloudpickle as cp
     seq = group._seq
@@ -215,6 +269,37 @@ def barrier(group_name: str = "default"):
         _kv_get(f"{group.group_name}:bar:{seq}:{r}")
 
 
+def _xla_broadcast(tensor, src_rank: int, group: CollectiveGroup):
+    """Device broadcast as a psum where non-source processes contribute
+    zeros (every process passes a same-shaped buffer, like the reference
+    API). Stays entirely on-device over ICI/DCN."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    mesh, n_local = _xla_mesh()
+    contrib = (jnp.asarray(tensor) if group.rank == src_rank
+               else jnp.zeros_like(jnp.asarray(tensor)))
+    key = ("bc", mesh.size)
+    fn = _XLA_FNS.get(key)
+    if fn is None:
+        def f(x):
+            # divide the per-process local-device multiplicity back out;
+            # exact, so cast back preserves integer tensors
+            return (jax.lax.psum(x, "all") / n_local).astype(x.dtype)
+
+        fn = jax.jit(shard_map(f, mesh=mesh, in_specs=P(), out_specs=P(),
+                               check_rep=False))
+        _XLA_FNS[key] = fn
+    return fn(contrib)
+
+
+# NOTE: send/recv are host-mediated (object store + GCS KV) on every
+# backend: XLA has no true point-to-point primitive outside compiled
+# collectives (ppermute needs all devices in the program); device-to-device
+# P2P belongs to compiled-DAG channels (experimental/channel.py), not this
+# eager API.
 _P2P_SEQ: Dict[tuple, int] = {}
 
 

@@ -1,0 +1,115 @@
+"""Ablation timings on the real chip: where do the milliseconds go."""
+import time, functools
+import jax, jax.numpy as jnp
+import optax
+
+PEAK = 197e12
+
+
+def timeit(f, *args, n=20, warm=3):
+    for _ in range(warm):
+        out = f(*args)
+    jax.block_until_ready(out)
+    # force sync via host transfer of one scalar-ish element
+    jnp.asarray(jax.tree.leaves(out)[0]).ravel()[0].item()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = f(*args)
+    jnp.asarray(jax.tree.leaves(out)[0]).ravel()[0].item()
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+
+    # 1. pure matmul peak: [8192,768]x[768,2048] bf16, chained
+    a = jax.random.normal(key, (8192, 768), jnp.bfloat16)
+    w1 = jax.random.normal(key, (768, 2048), jnp.bfloat16)
+    w2 = jax.random.normal(key, (2048, 768), jnp.bfloat16)
+
+    @jax.jit
+    def mm(a):
+        for _ in range(20):
+            a = (a @ w1) @ w2
+        return a
+    dt = timeit(mm, a)
+    fl = 20 * 2 * 2 * 8192 * 768 * 2048
+    print(f"matmul768 chain: {dt*1e3:.2f} ms  {fl/dt/1e12:.0f} TFLOP/s "
+          f"({fl/dt/PEAK*100:.0f}%)", flush=True)
+
+    # bigger matmul [8192, 4096] x [4096, 4096]
+    a2 = jax.random.normal(key, (8192, 4096), jnp.bfloat16)
+    w3 = jax.random.normal(key, (4096, 4096), jnp.bfloat16)
+
+    @jax.jit
+    def mm2(a):
+        for _ in range(20):
+            a = a @ w3
+        return a
+    dt = timeit(mm2, a2)
+    fl = 20 * 2 * 8192 * 4096 * 4096
+    print(f"matmul4096 chain: {dt*1e3:.2f} ms  {fl/dt/1e12:.0f} TFLOP/s "
+          f"({fl/dt/PEAK*100:.0f}%)", flush=True)
+
+    # 2. flash attention fwd+bwd at 125m shapes
+    from ray_tpu.ops.attention import flash_attention, mha_reference
+    B, L, H, D = 8, 1024, 12, 64
+    q = jax.random.normal(key, (B, L, H, D), jnp.bfloat16)
+    k = jax.random.normal(key, (B, L, H, D), jnp.bfloat16)
+    v = jax.random.normal(key, (B, L, H, D), jnp.bfloat16)
+
+    for name, fn in [("flash", flash_attention), ("xla-ref", mha_reference)]:
+        fwd = jax.jit(functools.partial(fn, causal=True))
+        dt = timeit(fwd, q, k, v)
+        fl = 4 * B * L * L * H * D / 2  # causal
+        print(f"{name} fwd B{B} L{L}: {dt*1e3:.2f} ms "
+              f"({fl/dt/1e12:.1f} TFLOP/s, {fl/dt/PEAK*100:.0f}%)", flush=True)
+
+        def lossf(q, k, v):
+            return fn(q, k, v, causal=True).astype(jnp.float32).sum()
+        g = jax.jit(jax.grad(lossf, argnums=(0, 1, 2)))
+        dt = timeit(g, q, k, v)
+        fl = 4 * B * L * L * H * D / 2 * 3.5
+        print(f"{name} fwd+bwd: {dt*1e3:.2f} ms "
+              f"({fl/dt/1e12:.1f} TFLOP/s, {fl/dt/PEAK*100:.0f}%)", flush=True)
+
+    # 3. unembed + CE fwd+bwd (125m shapes)
+    V, E = 32000, 768
+    x = jax.random.normal(key, (8, 1024, E), jnp.bfloat16)
+    wv = jax.random.normal(key, (E, V), jnp.bfloat16)
+    tgt = jax.random.randint(key, (8, 1024), 0, V)
+
+    def ce(x, wv):
+        logits = jnp.einsum("bld,dv->blv", x, wv)
+        logz = jax.nn.logsumexp(logits.astype(jnp.float32), -1)
+        gold = jnp.take_along_axis(logits, tgt[..., None], -1)[..., 0]
+        return (logz - gold.astype(jnp.float32)).mean()
+    g = jax.jit(jax.grad(ce, argnums=(0, 1)))
+    dt = timeit(g, x, wv)
+    fl = 6 * 8 * 1024 * E * V
+    print(f"unembed+CE fwd+bwd: {dt*1e3:.2f} ms "
+          f"({fl/dt/1e12:.1f} TFLOP/s, {fl/dt/PEAK*100:.0f}%)", flush=True)
+
+    # 4. adamw update alone on 134M fp32 params
+    params = [jax.random.normal(key, (134, 1024, 1024), jnp.float32)]
+    opt = optax.adamw(3e-4)
+    ost = opt.init(params)
+    grads = [jnp.ones_like(params[0])]
+
+    @jax.jit
+    def upd(params, ost, grads):
+        u, ost = opt.update(grads, ost, params=params)
+        return optax.apply_updates(params, u), ost
+    dt = timeit(upd, params, ost, grads)
+    print(f"adamw 134M fp32: {dt*1e3:.2f} ms", flush=True)
+
+    # 5. dispatch overhead: trivial jitted fn round trip
+    @jax.jit
+    def triv(x):
+        return x + 1
+    xs = jnp.zeros((8,))
+    dt = timeit(triv, xs, n=50)
+    print(f"dispatch+sync roundtrip: {dt*1e3:.3f} ms", flush=True)
+
+
+main()

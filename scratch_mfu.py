@@ -1,0 +1,76 @@
+"""Scratch: measure train-step time / MFU variants on the real chip."""
+import sys, time, functools
+import jax, jax.numpy as jnp
+import optax
+
+from ray_tpu.models import MODEL_REGISTRY, TransformerLM, count_params
+from ray_tpu.parallel import MeshConfig, make_mesh
+from ray_tpu.parallel.train_step import make_train_fns
+
+PEAK = 197e12  # v5e bf16
+
+
+def model_flops_per_step(cfg, B, L):
+    # params excluding embeddings (matmul flops = 6*N*T), plus embed/unembed
+    n_layer = cfg.n_layers * (
+        cfg.d_model * cfg.d_model * 2                      # q, o
+        + cfg.d_model * (cfg.n_kv_heads * cfg.head_dim) * 2  # k, v
+        + 3 * cfg.d_model * cfg.d_ff)
+    n_unembed = cfg.d_model * cfg.vocab_size
+    T = B * L
+    matmul = 6 * (n_layer + n_unembed) * T
+    attn = cfg.n_layers * 4 * B * L * L * cfg.d_model * 3  # fwd*2mm + bwd
+    if True:  # causal => half
+        attn = attn / 2
+    return matmul + attn
+
+
+def run(name, B, L, steps=20, remat=None, attention_impl=None, warm=3):
+    cfg = MODEL_REGISTRY[name]
+    kw = {}
+    if remat is not None:
+        kw["remat"] = remat
+    if attention_impl is not None:
+        kw["attention_impl"] = attention_impl
+    if kw:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, **kw)
+    model = TransformerLM(cfg)
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1), devices=jax.devices()[:1])
+    init_fn, step_fn, _ = make_train_fns(model, optax.adamw(3e-4), mesh,
+                                         batch_shape=(B, L + 1))
+    state = init_fn(jax.random.PRNGKey(0))
+    n_params = count_params(state.params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, L + 1), 0,
+                                cfg.vocab_size)
+    for _ in range(warm):
+        state, m = step_fn(state, tokens)
+    float(m["loss"])  # force full sync via host transfer
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step_fn(state, tokens)
+    float(m["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    fl = model_flops_per_step(cfg, B, L)
+    mfu = fl / dt / PEAK
+    print(f"{name} B={B} L={L} remat={remat} attn={attention_impl}: "
+          f"{dt*1e3:.1f} ms/step  {B*L/dt:.0f} tok/s  "
+          f"params={n_params/1e6:.0f}M  MFU={mfu*100:.1f}%", flush=True)
+    return dt, mfu
+
+
+if __name__ == "__main__":
+    for spec in sys.argv[1:]:
+        # name:B:L[:remat=0][:attn=flash]
+        parts = spec.split(":")
+        name, B, L = parts[0], int(parts[1]), int(parts[2])
+        kw = {}
+        for p in parts[3:]:
+            k, v = p.split("=")
+            if k == "remat":
+                kw["remat"] = bool(int(v))
+            elif k == "attn":
+                kw["attention_impl"] = v
+            elif k == "steps":
+                kw["steps"] = int(v)
+        run(name, B, L, **kw)
