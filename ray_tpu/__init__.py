@@ -18,7 +18,7 @@ from ray_tpu._private.serialization import (ActorDiedError, ObjectLostError,
 from ray_tpu.actor import ActorClass, ActorHandle
 from ray_tpu.remote_function import RemoteFunction
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 _ctx_lock = threading.RLock()
 _context: Optional["_Context"] = None
@@ -53,6 +53,35 @@ def _set_connected_from_worker(core):
                                 owns_node=False, job_id=core.job_id)
 
 
+
+def _apply_system_config(values: Dict[str, Any]) -> None:
+    """Validate + coerce every entry first (fail fast, no partial
+    application), then set cfg and export env overrides so spawned GCS /
+    node-manager processes resolve the same values (the GCS then
+    re-propagates its snapshot to every joining node). The exported keys
+    are recorded so shutdown() can remove them."""
+    import os
+    from ray_tpu._private.config import cfg, flags
+    table = flags()
+    coerced = {}
+    for k, v in values.items():
+        flag = table.get(k)
+        if flag is None:
+            raise KeyError(f"unknown system config flag {k!r}")
+        try:
+            coerced[k] = flag.parse(str(v))
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"system config flag {k!r}={v!r} is not a valid "
+                f"{flag.type.__name__}")
+    for k, v in coerced.items():
+        cfg.set(k, v)
+        os.environ["RAY_TPU_" + k.upper()] = str(v)
+        _exported_config_env.append(("RAY_TPU_" + k.upper(), k))
+
+
+_exported_config_env: List[tuple] = []
+
 def init(address: Optional[str] = None, *,
          num_cpus: Optional[float] = None,
          resources: Optional[Dict[str, float]] = None,
@@ -62,7 +91,8 @@ def init(address: Optional[str] = None, *,
          ignore_reinit_error: bool = False,
          _node_address: Optional[str] = None,
          _store_path: Optional[str] = None,
-         _node_id: Optional[str] = None):
+         _node_id: Optional[str] = None,
+         _system_config: Optional[Dict[str, Any]] = None):
     """Connect to (or start) a cluster. With no address, starts a local
     head: GCS + node manager subprocesses (reference: ray.init at
     python/ray/_private/worker.py:1260)."""
@@ -74,12 +104,44 @@ def init(address: Optional[str] = None, *,
     import os
     if address is None:
         address = os.environ.get("RAY_TPU_ADDRESS")
+    if _system_config and address is not None:
+        raise ValueError(
+            "_system_config is only valid when starting a new head; "
+            "when joining an existing cluster the head's config wins")
     with _ctx_lock:
         if _context is not None:
             if ignore_reinit_error:
                 return _context
             raise RuntimeError("ray_tpu.init() already called "
                                "(use ignore_reinit_error=True)")
+        owns_node = False
+        node = None
+        if _system_config:
+            _apply_system_config(_system_config)
+        try:
+            return _init_locked(address, num_cpus, resources,
+                                object_store_memory, namespace, labels,
+                                _node_address, _store_path, _node_id,
+                                node_mod, worker_mod, Worker)
+        except BaseException:
+            if _system_config:
+                _drain_config_exports()
+            raise
+
+
+def _drain_config_exports() -> None:
+    import os
+    from ray_tpu._private.config import cfg as _cfg
+    for env_key, flag_name in _exported_config_env:
+        os.environ.pop(env_key, None)
+        _cfg.reset(flag_name)
+    _exported_config_env.clear()
+
+
+def _init_locked(address, num_cpus, resources, object_store_memory,
+                 namespace, labels, _node_address, _store_path, _node_id,
+                 node_mod, worker_mod, Worker):
+        global _context
         owns_node = False
         node = None
         if address is None:
@@ -153,6 +215,9 @@ def shutdown():
             ctx.node.kill()
         from ray_tpu._private import worker as worker_mod
         worker_mod.global_worker = None
+        # undo _system_config exports so a later init (or unrelated
+        # tooling spawned from this process) doesn't inherit stale values
+        _drain_config_exports()
 
 
 def remote(*args, **kwargs):

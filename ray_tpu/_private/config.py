@@ -1,0 +1,193 @@
+"""Typed runtime flag registry with env overrides and head propagation.
+
+The reference defines 218 ``RAY_CONFIG(type, name, default)`` flags
+(reference: src/ray/common/ray_config_def.h), each overridable via a
+``RAY_<name>`` env var, and the head node serializes its resolved config to
+every joining node (``GetSystemConfig``, node_manager.proto:432). This is
+the same capability with a TPU-sized surface:
+
+- every tunable in the runtime lives here (one place to discover/tune);
+- ``RAY_TPU_<NAME>`` env vars override defaults at process start;
+- the GCS snapshots its resolved values and ships them to node managers in
+  the ``register_node`` reply and to drivers/workers via
+  ``get_system_config``, so one head-side setting governs the cluster.
+
+Usage::
+
+    from ray_tpu._private.config import cfg
+    timeout = cfg.lease_idle_timeout_s
+
+Values resolve in priority order: explicit ``cfg.apply()`` (propagated
+snapshot) > ``RAY_TPU_*`` env var > registered default.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+class _Flag:
+    __slots__ = ("name", "type", "default", "doc")
+
+    def __init__(self, name: str, typ: Callable, default: Any, doc: str):
+        self.name = name
+        self.type = typ
+        self.default = default
+        self.doc = doc
+
+    def parse(self, raw: str) -> Any:
+        if self.type is bool:
+            return _parse_bool(raw)
+        return self.type(raw)
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def _flag(name: str, typ: Callable, default: Any, doc: str) -> None:
+    _REGISTRY[name] = _Flag(name, typ, default, doc)
+
+
+# ----------------------------------------------------------------- registry
+# Core worker / task submission
+_flag("lease_idle_timeout_s", float, 1.0,
+      "How long a granted worker lease may sit idle before being returned "
+      "to the node manager.")
+_flag("task_max_retries", int, 3,
+      "Default retry budget for tasks whose worker died (mirrors "
+      "@remote(max_retries=...) default).")
+_flag("max_dispatchers_per_sig", int, 32,
+      "Max concurrent lease-holding dispatchers per (resources, scheduling) "
+      "task signature in one submitter process.")
+_flag("actor_restart_probe_s", float, 0.2,
+      "Delay before probing the GCS for a restarted actor's new address "
+      "after an actor connection drops.")
+_flag("wait_poll_floor_s", float, 0.02,
+      "Floor for KV/rendezvous polling sleeps.")
+_flag("lineage_max_depth", int, 16,
+      "Maximum reconstruction attempts per lost object (bounds recursive "
+      "lineage re-execution storms; reference caps lineage similarly via "
+      "max_lineage_bytes / task retry budgets).")
+
+# Node manager
+_flag("transfer_chunk_bytes", int, 64 * 1024 * 1024,
+      "Chunk size for node-to-node object transfer.")
+_flag("heartbeat_interval_s", float, 0.5,
+      "Node manager -> GCS heartbeat period (also carries the resource "
+      "view).")
+_flag("view_refresh_s", float, 1.0,
+      "Period for refreshing the cluster resource view used by spillback "
+      "scheduling.")
+_flag("lease_wait_timeout_s", float, 300.0,
+      "Server-side cap on how long a lease request may queue for local "
+      "resources before erroring.")
+_flag("actor_resource_wait_s", float, 60.0,
+      "How long actor creation waits for local resources before failing.")
+_flag("infeasible_grace_s", float, 30.0,
+      "How long a request may be cluster-wide infeasible before it is "
+      "failed (it stays queued as autoscaler demand until then).")
+_flag("spill_check_interval_s", float, 2.0,
+      "Period of the object-spill pressure check loop.")
+_flag("spill_high_watermark", float, 0.8,
+      "Arena utilization above which primary copies spill to disk.")
+_flag("log_tail_interval_s", float, 0.5,
+      "Period of the worker-log tail loop feeding the driver log stream.")
+
+# GCS
+_flag("node_death_timeout_s", float, 5.0,
+      "Heartbeat silence after which the GCS declares a node dead.")
+_flag("gcs_snapshot_interval_s", float, 2.0,
+      "Period between GCS table snapshots to disk (fault-tolerance "
+      "restore source).")
+_flag("health_check_interval_s", float, 0.5,
+      "GCS-side period for scanning node liveness.")
+
+# Object store
+_flag("object_store_memory", int, 0,
+      "Default per-node object store arena size in bytes (0 = auto).")
+_flag("memory_monitor_interval_s", float, 1.0,
+      "Period of the per-node worker memory monitor (0 disables).")
+_flag("memory_usage_threshold", float, 0.95,
+      "Fraction of system memory above which the node manager kills the "
+      "largest retriable worker (OOM defense).")
+
+_flag("spill_low_watermark", float, 0.6,
+      "Spilling stops once arena utilization falls below this fraction.")
+# NOTE: RPC chaos injection is configured through rpc.py's own
+# RAY_TPU_TESTING_RPC_FAILURE spec string ("method=prob"), not a flag here.
+
+
+class Config:
+    """Resolved view over the registry; thread-safe; importable singleton."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._explicit: Dict[str, Any] = {}
+
+    def __getattr__(self, name: str) -> Any:
+        flag = _REGISTRY.get(name)
+        if flag is None:
+            raise AttributeError(f"unknown ray_tpu config flag {name!r}")
+        with self._lock:
+            if name in self._explicit:
+                return self._explicit[name]
+        raw = os.environ.get(_ENV_PREFIX + name.upper())
+        if raw is not None:
+            try:
+                return flag.parse(raw)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"bad value {raw!r} for {_ENV_PREFIX}{name.upper()} "
+                    f"(expected {flag.type.__name__})")
+        return flag.default
+
+    def set(self, name: str, value: Any) -> None:
+        flag = _REGISTRY.get(name)
+        if flag is None:
+            raise KeyError(f"unknown ray_tpu config flag {name!r}")
+        with self._lock:
+            self._explicit[name] = value
+
+    def reset(self, name: Optional[str] = None) -> None:
+        with self._lock:
+            if name is None:
+                self._explicit.clear()
+            else:
+                self._explicit.pop(name, None)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Fully-resolved {name: value} map — what the head ships to
+        joining nodes so the whole cluster runs one config."""
+        return {name: getattr(self, name) for name in _REGISTRY}
+
+    def apply(self, values: Dict[str, Any]) -> None:
+        """Apply a propagated snapshot (unknown keys are ignored so a
+        newer head can talk to an older node)."""
+        for k, v in values.items():
+            if k in _REGISTRY:
+                with self._lock:
+                    self._explicit[k] = v
+
+    def describe(self) -> str:
+        lines = []
+        for name, flag in sorted(_REGISTRY.items()):
+            cur = getattr(self, name)
+            mark = "" if cur == flag.default else "  [override]"
+            lines.append(f"{name} = {cur!r}{mark}\n    {flag.doc}")
+        return "\n".join(lines)
+
+
+cfg = Config()
+
+
+def flags() -> Dict[str, _Flag]:
+    return dict(_REGISTRY)

@@ -31,11 +31,12 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu._private import rpc
 from ray_tpu._private import scheduling
+from ray_tpu._private.config import cfg
 
 logger = logging.getLogger(__name__)
 
-HEARTBEAT_INTERVAL_S = 0.5
-NODE_DEATH_TIMEOUT_S = 5.0
+# tunables live in config.py (health_check_interval_s,
+# node_death_timeout_s, gcs_snapshot_interval_s)
 
 # Actor states (reference: src/ray/protobuf/gcs.proto ActorTableData.ActorState)
 DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
@@ -77,6 +78,7 @@ class GcsServer:
             "kv_del": self.h_kv_del, "kv_exists": self.h_kv_exists,
             "kv_keys": self.h_kv_keys,
             "register_node": self.h_register_node,
+            "get_system_config": lambda conn: cfg.snapshot(),
             "heartbeat": self.h_heartbeat,
             "drain_node": self.h_drain_node,
             "get_all_nodes": self.h_get_all_nodes,
@@ -173,7 +175,7 @@ class GcsServer:
 
     async def _snapshot_loop(self):
         while True:
-            await asyncio.sleep(2.0)
+            await asyncio.sleep(cfg.gcs_snapshot_interval_s)
             try:
                 self._save_snapshot()
             except Exception:
@@ -200,7 +202,8 @@ class GcsServer:
             info = self.nodes.get(node_id)
             if info is not None:
                 info["last_heartbeat"] = min(
-                    info["last_heartbeat"], time.monotonic() - NODE_DEATH_TIMEOUT_S / 2)
+                    info["last_heartbeat"],
+                    time.monotonic() - cfg.node_death_timeout_s / 2)
 
     # ------------------------------------------------------------------- kv
     def h_kv_put(self, conn, ns: str, key: bytes, value: bytes,
@@ -244,7 +247,8 @@ class GcsServer:
         }
         logger.info("node %s registered at %s (%s)", node_id[:12], address, resources)
         self._publish("NODE", node_id, {"state": "ALIVE", **_node_public(self.nodes[node_id])})
-        return {"node_id": node_id, "cluster_view": self._cluster_view()}
+        return {"node_id": node_id, "cluster_view": self._cluster_view(),
+                "system_config": cfg.snapshot()}
 
     def h_heartbeat(self, conn, node_id: str, available: Dict[str, float],
                     total: Optional[Dict[str, float]] = None,
@@ -280,10 +284,10 @@ class GcsServer:
 
     async def _check_node_deaths(self):
         while True:
-            await asyncio.sleep(HEARTBEAT_INTERVAL_S)
+            await asyncio.sleep(cfg.health_check_interval_s)
             now = time.monotonic()
             for node_id, info in list(self.nodes.items()):
-                if info["alive"] and now - info["last_heartbeat"] > NODE_DEATH_TIMEOUT_S:
+                if info["alive"] and now - info["last_heartbeat"] > cfg.node_death_timeout_s:
                     await self._mark_node_dead(node_id, "heartbeat timeout")
 
     async def _mark_node_dead(self, node_id: str, reason: str):

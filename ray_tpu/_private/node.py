@@ -133,6 +133,9 @@ def start_node(gcs_address: str, num_cpus: Optional[float] = None,
            "--resources", json.dumps(res),
            "--labels", json.dumps(labels or {}),
            "--session-name", session_name]
+    if not object_store_memory:
+        from ray_tpu._private.config import cfg
+        object_store_memory = cfg.object_store_memory or None
     if object_store_memory:
         cmd += ["--store-bytes", str(int(object_store_memory))]
     nm = _launch(cmd, ["NODE_ADDRESS", "NODE_ID", "STORE_PATH"],

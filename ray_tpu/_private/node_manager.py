@@ -29,13 +29,13 @@ import time
 from typing import Dict, List, Optional
 
 from ray_tpu._private import rpc, scheduling
+from ray_tpu._private.config import cfg
 from ray_tpu._private.object_store import ObjectStoreClient
 
 logger = logging.getLogger(__name__)
 
-FETCH_CHUNK = 64 * 1024 * 1024
-HEARTBEAT_S = 0.5
-VIEW_REFRESH_S = 1.0
+# tunables live in config.py (transfer_chunk_bytes, heartbeat_interval_s,
+# view_refresh_s, lease_wait_timeout_s, ...)
 
 
 class WorkerProc:
@@ -164,6 +164,9 @@ class NodeManager:
             resources=self.total, labels=self.labels,
             node_ip=rpc.node_ip_address())
         self.cluster_view = resp["cluster_view"]
+        # one head-side config governs the cluster (reference:
+        # GetSystemConfig handshake, node_manager.proto:432)
+        cfg.apply(resp.get("system_config") or {})
         await self.gcs.call("subscribe", channel="NODE")
         self.spill_dir = f"/tmp/raytpu/{self.session_name}/spill_{self.node_id[:8]}"
         self.spilled: Dict[bytes, str] = {}
@@ -172,6 +175,7 @@ class NodeManager:
             asyncio.ensure_future(self._heartbeat_loop()),
             asyncio.ensure_future(self._view_refresh_loop()),
             asyncio.ensure_future(self._reap_children_loop()),
+            asyncio.ensure_future(self._memory_monitor_loop()),
             asyncio.ensure_future(self._spill_loop()),
         ]
         logger.info("node manager %s at %s (store %s, %s)",
@@ -223,7 +227,7 @@ class NodeManager:
                     await self.gcs.call("subscribe", channel="NODE")
                 except Exception:
                     pass
-            await asyncio.sleep(HEARTBEAT_S)
+            await asyncio.sleep(cfg.heartbeat_interval_s)
 
     def _reported_available(self) -> Dict[str, float]:
         avail = dict(self.available)
@@ -235,7 +239,7 @@ class NodeManager:
 
     async def _view_refresh_loop(self):
         while True:
-            await asyncio.sleep(VIEW_REFRESH_S)
+            await asyncio.sleep(cfg.view_refresh_s)
             try:
                 self.cluster_view = await self.gcs.call("get_cluster_view")
             except (rpc.RpcError, rpc.ConnectionLost):
@@ -248,6 +252,78 @@ class NodeManager:
                 if w.proc is not None and w.proc.poll() is not None \
                         and w.state != "dead":
                     await self._on_worker_death(w, f"exit code {w.proc.returncode}")
+
+    # ------------------------------------------------------ memory monitor
+    @staticmethod
+    def _system_memory_fraction() -> float:
+        """Used fraction of system memory from /proc/meminfo (the
+        reference samples the same source: src/ray/common/memory_monitor.h,
+        GetLinuxMemoryBytes)."""
+        total = avail = None
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        total = int(line.split()[1])
+                    elif line.startswith("MemAvailable:"):
+                        avail = int(line.split()[1])
+                    if total is not None and avail is not None:
+                        break
+        except OSError:
+            return 0.0
+        if not total or avail is None:
+            return 0.0
+        return 1.0 - avail / total
+
+    @staticmethod
+    def _proc_rss_bytes(pid: int) -> int:
+        try:
+            with open(f"/proc/{pid}/statm") as f:
+                return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+        except (OSError, IndexError, ValueError):
+            return 0
+
+    async def _memory_monitor_loop(self):
+        """OOM defense: when system memory crosses the usage threshold,
+        kill the worker with the largest RSS, preferring retriable task
+        workers over actors (reference: memory_monitor.h:52 + raylet
+        worker killing policies — retriable-first, group-by-owner). The
+        owner sees a worker death and retries; without this the kernel
+        OOM-killer may take down the whole node manager instead."""
+        while True:
+            interval = cfg.memory_monitor_interval_s
+            if interval <= 0:
+                await asyncio.sleep(5.0)
+                continue
+            await asyncio.sleep(interval)
+            try:
+                frac = self._system_memory_fraction()
+                if frac < cfg.memory_usage_threshold:
+                    continue
+                victim = self._pick_oom_victim()
+                if victim is None:
+                    continue
+                logger.warning(
+                    "memory pressure %.1f%% > %.1f%%: killing worker %s "
+                    "(state=%s, rss=%dMB)", frac * 100,
+                    cfg.memory_usage_threshold * 100,
+                    victim.worker_id and victim.worker_id[:12],
+                    victim.state, self._proc_rss_bytes(victim.pid) >> 20)
+                await self._on_worker_death(
+                    victim, f"killed by memory monitor at {frac:.0%} usage")
+            except Exception:
+                logger.exception("memory monitor pass failed")
+
+    def _pick_oom_victim(self) -> Optional["WorkerProc"]:
+        # leased task workers first (their tasks retry); actors only if
+        # nothing else is killable; never idle workers (tiny RSS, and
+        # killing them frees nothing the pool won't re-create)
+        for states in (("leased",), ("actor",)):
+            candidates = [w for w in self.workers.values()
+                          if w.state in states and w.pid]
+            if candidates:
+                return max(candidates, key=lambda w: self._proc_rss_bytes(w.pid))
+        return None
 
     def h_pubsub(self, conn, channel, key, payload):
         if channel == "NODE":
@@ -270,7 +346,7 @@ class NodeManager:
         pubsub channel so drivers can echo them (reference: LogMonitor
         python/ray/_private/log_monitor.py:103 magic-prefix routing)."""
         while True:
-            await asyncio.sleep(0.5)
+            await asyncio.sleep(cfg.log_tail_interval_s)
             for pid, files in list(self._log_files.items()):
                 for i, (path, stream, off) in enumerate(files):
                     try:
@@ -424,7 +500,7 @@ class NodeManager:
         that has already been redirected once is grant-or-queue here — never
         redirected again (the reference's grant_or_reject spillback rule,
         preventing ping-pong on stale cluster views)."""
-        deadline = time.monotonic() + 300.0
+        deadline = time.monotonic() + cfg.lease_wait_timeout_s
         strategy = scheduling.get("strategy", "DEFAULT")
         infeasible_since = None
         while True:
@@ -495,7 +571,8 @@ class NodeManager:
                     # fail after a sustained infeasibility window.
                     if infeasible_since is None:
                         infeasible_since = time.monotonic()
-                    elif time.monotonic() - infeasible_since > 30.0:
+                    elif (time.monotonic() - infeasible_since
+                            > cfg.infeasible_grace_s):
                         return {"status": "error",
                                 "reason": f"resources {resources} "
                                           f"unschedulable anywhere"}
@@ -584,7 +661,7 @@ class NodeManager:
         bundle = self.bundles.get((pg_id, bundle_index)) if pg_id else None
         pool_avail = bundle["available"] if bundle else self.available
         # queue for resources (leases drain within their idle timeout)
-        deadline = time.monotonic() + 60.0
+        deadline = time.monotonic() + cfg.actor_resource_wait_s
         while not (scheduling_fits(pool_avail, resources)
                    and self._chips_fit(resources)):
             if time.monotonic() > deadline:
@@ -696,7 +773,7 @@ class NodeManager:
                 meta_view[:] = meta["meta"]
                 off = 0
                 while off < data_size:
-                    n = min(FETCH_CHUNK, data_size - off)
+                    n = min(cfg.transfer_chunk_bytes, data_size - off)
                     chunk = await self.pool.call(addr, "fetch_object", oid=oid,
                                                  part="data", offset=off,
                                                  length=n)
@@ -740,13 +817,14 @@ class NodeManager:
         writes — the store is directly mapped, a read is a memcpy)."""
         loop = asyncio.get_event_loop()
         while True:
-            await asyncio.sleep(2.0)
+            await asyncio.sleep(cfg.spill_check_interval_s)
             try:
                 # disk writes run in a thread: a multi-hundred-MB pass must
                 # not stall heartbeats (reference: dedicated IO workers,
                 # local_object_manager.h)
                 await loop.run_in_executor(
-                    None, self._spill_pass, 0.8, 0.6)
+                    None, self._spill_pass,
+                    cfg.spill_high_watermark, cfg.spill_low_watermark)
             except Exception:
                 logger.exception("spill iteration failed")
 

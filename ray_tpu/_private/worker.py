@@ -36,6 +36,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import cloudpickle
 
 from ray_tpu._private import ids, rpc, serialization
+from ray_tpu._private.config import cfg
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.object_store import ObjectStoreClient
 from ray_tpu._private.serialization import (ActorDiedError, ObjectLostError,
@@ -47,8 +48,8 @@ logger = logging.getLogger(__name__)
 DRIVER = "driver"
 WORKER = "worker"
 
-LEASE_IDLE_TIMEOUT_S = 1.0
-DEFAULT_MAX_RETRIES = 3
+# tunables live in config.py (lease_idle_timeout_s, task_max_retries,
+# max_dispatchers_per_sig, actor_restart_probe_s)
 
 
 def _encode_arg(arg, ref_hook) -> list:
@@ -178,6 +179,10 @@ class CoreWorker:
         self.gcs = await rpc.connect(self.gcs_address,
                                      handlers={"pubsub": self.h_pubsub},
                                      name="->gcs", retries=10)
+        try:
+            cfg.apply(await self.gcs.call("get_system_config") or {})
+        except rpc.RpcError:
+            pass   # older GCS without the handler
         if self.node_address:
             self.node_conn = await rpc.connect(
                 self.node_address, handlers={
@@ -405,6 +410,98 @@ class CoreWorker:
             raise val
         return val
 
+    # ------------------------------------------------- lineage reconstruction
+    async def _node_is_dead(self, node_id: str) -> bool:
+        """GCS-verified liveness (authoritative node table)."""
+        try:
+            nodes = await self.gcs.call("get_all_nodes")
+        except (rpc.RpcError, rpc.ConnectionLost, ConnectionError):
+            return False   # can't verify -> don't destroy state
+        for n in nodes:
+            if n.get("node_id") == node_id:
+                return not n.get("alive", False)
+        return True        # unknown to the GCS: gone
+
+    async def _recover_object(self, oid: bytes) -> bool:
+        """Re-execute the creating task of a lost object (reference:
+        ObjectRecoveryManager::RecoverObject, object_recovery_manager.h:41).
+        Returns True if a reconstruction attempt was started (caller should
+        re-wait on the object), False if the object is unrecoverable."""
+        entry = self.owned.get(oid)
+        if entry is None:
+            return False
+        lineage = entry.get("lineage")
+        if not lineage:
+            return False
+        fut = entry.get("recovering")
+        if fut is not None:
+            # another getter already triggered reconstruction — piggyback
+            await fut
+            return True
+        if lineage["attempts"] >= cfg.lineage_max_depth:
+            logger.warning("object %s exceeded %d reconstruction attempts",
+                           oid.hex()[:16], cfg.lineage_max_depth)
+            return False
+        lineage["attempts"] += 1
+        spec = lineage["spec"]
+        task_id = spec["task_id"]
+        logger.info("reconstructing %s via task %s (attempt %d)",
+                    oid.hex()[:16], spec["name"], lineage["attempts"])
+        fut = self.loop.create_future()
+        return_ids = spec["return_ids"]
+        for rid in return_ids:
+            e = self.owned.get(rid)
+            if e is not None:
+                e["complete"] = False
+                e["location"] = None
+                e["recovering"] = fut
+            self.memory_store.pop(rid, None)
+        self._record_task_event(task_id, "PENDING", name=spec["name"],
+                                job_id=self.job_id, type="NORMAL_TASK",
+                                reconstruction=True)
+        pt = PendingTask(spec, return_ids, lineage["max_retries"],
+                         list(lineage["arg_refs"]))
+        for r in pt.arg_refs:
+            e = self.owned.get(r.id)
+            if e is not None:
+                e["submitted"] = e.get("submitted", 0) + 1
+        self.pending_tasks[task_id] = pt
+        self._enqueue_task(pt, lineage["resources"], lineage["scheduling"])
+
+        def _done(_fut=fut, _ids=return_ids):
+            for rid in _ids:
+                e = self.owned.get(rid)
+                if e is not None and e.get("recovering") is _fut:
+                    e.pop("recovering", None)
+            if not _fut.done():
+                _fut.set_result(None)
+
+        # resolve the recovery future when the task completes (or fails):
+        # _complete_task/_fail_task repopulate memory_store and set+pop the
+        # object events, so poll presence with an event-assisted wait (a
+        # bare event wait would race a completion that happened before we
+        # registered)
+        async def _watch():
+            # no wall deadline: clearing the recovering marker while the
+            # resubmitted task is still queued would allow a duplicate
+            # concurrent reconstruction of the same task_id. The task is
+            # finished once its result lands in memory_store or its
+            # pending entry is gone (dispatchers always _complete_task or
+            # _fail_task, and failed dispatchers respawn).
+            rid0 = return_ids[0]
+            while (rid0 not in self.memory_store
+                   and task_id in self.pending_tasks):
+                ev = self.object_events.setdefault(rid0, asyncio.Event())
+                try:
+                    await asyncio.wait_for(ev.wait(), timeout=0.5)
+                except asyncio.TimeoutError:
+                    pass
+            _done()
+
+        asyncio.ensure_future(_watch())
+        await fut
+        return True
+
     async def _resolve(self, ref: ObjectRef) -> Tuple[Any, bool]:
         """Returns (value, is_exception)."""
         oid = ref.id
@@ -431,13 +528,48 @@ class CoreWorker:
                         if ok:
                             self.memory_store[oid] = ("shm",)
                             continue
+                    if (is_exc and isinstance(val, ObjectLostError)
+                            and await self._recover_object(oid)):
+                        tried_restore = False
+                        continue
                     return val, is_exc
                 if kind == "loc":
                     node_id = entry[1]
                     if node_id == self.node_id:
                         self.memory_store[oid] = ("shm",)
                         continue
-                    await self._pull_to_local(oid, node_id)
+                    try:
+                        await self._pull_to_local(oid, node_id)
+                    except Exception as e:
+                        # holding node gone. Owner: re-execute the
+                        # creating task from lineage. Borrower: report
+                        # the loss to the owner, who reconstructs and
+                        # replies with a fresh status.
+                        self.memory_store.pop(oid, None)
+                        if oid in self.owned:
+                            if await self._recover_object(oid):
+                                continue
+                            return ObjectLostError(
+                                f"{oid.hex()[:16]} lost with node "
+                                f"{node_id[:12]}: {e}"), True
+                        owner = ref.owner_address
+                        if owner and owner != self.address:
+                            try:
+                                resp = await self.pool.call(
+                                    owner, "wait_object", oid=oid,
+                                    lost_on=node_id)
+                            except (rpc.RpcError, rpc.ConnectionLost,
+                                    ConnectionError) as e2:
+                                return ObjectLostError(
+                                    f"owner unreachable during recovery: "
+                                    f"{e2}"), True
+                            err = self._apply_wait_object_resp(oid, resp)
+                            if err is not None:
+                                return err
+                            continue
+                        return ObjectLostError(
+                            f"{oid.hex()[:16]} lost with node "
+                            f"{node_id[:12]}: {e}"), True
                     self.memory_store[oid] = ("shm",)
                     continue
             if self.store is not None and self.store.contains(oid):
@@ -459,16 +591,22 @@ class CoreWorker:
             except (rpc.RpcError, rpc.ConnectionLost, ConnectionError) as e:
                 return ObjectLostError(
                     f"owner {owner} unreachable for {oid.hex()[:16]}: {e}"), True
-            status = resp["status"]
-            if status == "inline":
-                k, p, b = resp["kind"], resp["pkl"], resp["bufs"]
-                self.memory_store[oid] = ("wire", k, p, b)
-                continue
-            if status == "location":
-                self.memory_store[oid] = ("loc", resp["node_id"])
-                continue
-            if status == "lost":
-                return ObjectLostError(resp.get("reason", "object lost")), True
+            err = self._apply_wait_object_resp(oid, resp)
+            if err is not None:
+                return err
+
+    def _apply_wait_object_resp(self, oid: bytes, resp: Dict):
+        """Record a wait_object reply into the local memory store; returns
+        an (error, True) tuple for a lost object, else None."""
+        status = resp["status"]
+        if status == "inline":
+            self.memory_store[oid] = ("wire", resp["kind"], resp["pkl"],
+                                      resp["bufs"])
+            return None
+        if status == "location":
+            self.memory_store[oid] = ("loc", resp["node_id"])
+            return None
+        return ObjectLostError(resp.get("reason", "object lost")), True
 
     def _deser_wire(self, kind, pkl, bufs):
         try:
@@ -508,9 +646,31 @@ class CoreWorker:
                 await asyncio.sleep(0.05 * (attempt + 1))
         await self.node_conn.call("pull_object", oid=oid, node_id=node_id)
 
-    async def h_wait_object(self, conn, oid: bytes):
+    async def h_wait_object(self, conn, oid: bytes, lost_on: str = None):
         """Owner-side: serve value or location to a borrower (reference:
-        core_worker GetObjectStatus / future_resolver.h)."""
+        core_worker GetObjectStatus / future_resolver.h). ``lost_on`` is a
+        borrower reporting that the named node no longer serves the
+        object — if our view still points there, reconstruct from lineage
+        before answering (reference: ObjectRecoveryManager pinning-or-
+        reconstruct on owner, object_recovery_manager.h:41)."""
+        if lost_on is not None:
+            entry = self.memory_store.get(oid)
+            owned = self.owned.get(oid)
+            stale = ((entry is not None and entry[0] == "loc"
+                      and entry[1] == lost_on)
+                     or (owned is not None
+                         and owned.get("location") == lost_on))
+            if stale and await self._node_is_dead(lost_on):
+                # verified against the GCS node table — a transient pull
+                # failure from a healthy node must NOT destroy the only
+                # location record (the borrower just retries)
+                self.memory_store.pop(oid, None)
+                if owned is not None:
+                    owned["location"] = None
+                if not await self._recover_object(oid):
+                    return {"status": "lost",
+                            "reason": f"copy on {lost_on[:12]} lost and "
+                                      "not reconstructable"}
         while True:
             entry = self.memory_store.get(oid)
             if entry is not None:
@@ -606,7 +766,7 @@ class CoreWorker:
 
     # ------------------------------------------------------ task submission
     def submit_task(self, func, args, kwargs, num_returns=1, resources=None,
-                    max_retries=DEFAULT_MAX_RETRIES, scheduling=None,
+                    max_retries=None, scheduling=None,
                     name=None, runtime_env=None) -> List[ObjectRef]:
         return asyncio.run_coroutine_threadsafe(
             self.submit_task_async(func, args, kwargs, num_returns, resources,
@@ -614,7 +774,7 @@ class CoreWorker:
             self.loop).result()
 
     async def submit_task_async(self, func, args, kwargs, num_returns=1,
-                                resources=None, max_retries=DEFAULT_MAX_RETRIES,
+                                resources=None, max_retries=None,
                                 scheduling=None, name=None,
                                 runtime_env=None) -> List[ObjectRef]:
         task_id = ids.new_task_id(ids.job_id_from_int(self.job_id))
@@ -639,8 +799,21 @@ class CoreWorker:
             spec["runtime_env"] = await self._package_runtime_env(
                 runtime_env)
         refs = [ObjectRef(rid, self.address) for rid in return_ids]
+        if max_retries is None:
+            max_retries = cfg.task_max_retries
+        # Lineage: retain the creating task so a lost shm copy can be
+        # re-executed (reference: ObjectRecoveryManager
+        # object_recovery_manager.h:41; spec retained by TaskManager,
+        # task_manager.h:208). Holding arg_refs in the lineage keeps the
+        # argument objects' owned entries alive for as long as any return
+        # ref might need reconstruction (lineage pinning,
+        # reference_count.h:64).
+        lineage = {"spec": spec, "resources": dict(resources),
+                   "scheduling": dict(scheduling or {}),
+                   "max_retries": max_retries, "arg_refs": list(arg_refs),
+                   "attempts": 0}
         for rid in return_ids:
-            self._register_owned(rid, lineage=None, complete=False)
+            self._register_owned(rid, lineage=lineage, complete=False)
         pt = PendingTask(spec, return_ids, max_retries, arg_refs)
         # pin args for the task's duration
         for r in arg_refs:
@@ -660,23 +833,29 @@ class CoreWorker:
     # workers, normal_task_submitter.cc). Without this, N concurrent
     # submissions issue N simultaneous lease requests and the node
     # manager's waiter queue becomes the bottleneck.
-    MAX_DISPATCHERS_PER_SIG = 32
 
     def _enqueue_task(self, pt: PendingTask, resources, scheduling):
         sig = self._lease_sig(resources, scheduling)
         st = self._sig_queues.get(sig)
         if st is None:
             st = {"queue": __import__("collections").deque(),
-                  "dispatchers": 0, "resources": resources,
+                  "dispatchers": 0, "busy": 0, "resources": resources,
                   "scheduling": scheduling}
             self._sig_queues[sig] = st
         st["queue"].append(pt)
-        # spawn when the queue is deeper than the dispatcher count, and
-        # always when an idle lease can serve the task immediately —
-        # otherwise a dispatcher blocked in a server-side lease wait
-        # would serialize fresh submissions behind grant latency
-        if (st["dispatchers"] < self.MAX_DISPATCHERS_PER_SIG
-                and (st["dispatchers"] < len(st["queue"])
+        self._maybe_spawn_dispatcher(sig, st)
+
+    def _maybe_spawn_dispatcher(self, sig, st):
+        # Spawn when queued tasks outnumber FREE dispatchers (dispatchers
+        # whose current task is in flight count as busy — a running task
+        # may block on a queued task's result, so leaving work behind a
+        # busy dispatcher can deadlock a dependency chain), and always
+        # when an idle lease can serve the task immediately — otherwise a
+        # dispatcher blocked in a server-side lease wait would serialize
+        # fresh submissions behind grant latency.
+        free = st["dispatchers"] - st["busy"]
+        if (st["dispatchers"] < cfg.max_dispatchers_per_sig
+                and (len(st["queue"]) > free
                      or self._idle_leases.get(sig))):
             st["dispatchers"] += 1
             asyncio.ensure_future(self._dispatch_loop(sig, st))
@@ -697,6 +876,11 @@ class CoreWorker:
                 lease_ok = True
                 while st["queue"] and lease_ok:
                     pt = st["queue"].popleft()
+                    st["busy"] += 1
+                    # work remains behind us: make sure it isn't stuck
+                    # waiting for this (possibly dependent) task
+                    if st["queue"]:
+                        self._maybe_spawn_dispatcher(sig, st)
                     try:
                         lease_ok = await self._run_on_lease(pt, lease, st)
                     except Exception as e:
@@ -710,6 +894,8 @@ class CoreWorker:
                         self.pending_tasks.pop(pt.spec["task_id"], None)
                         await self._drop_lease(lease, dead=True)
                         lease_ok = False
+                    finally:
+                        st["busy"] -= 1
                 if lease_ok:
                     try:
                         await self._return_lease(lease)
@@ -876,12 +1062,12 @@ class CoreWorker:
 
     async def _reap_leases(self):
         while not self._shutdown:
-            await asyncio.sleep(LEASE_IDLE_TIMEOUT_S / 2)
+            await asyncio.sleep(cfg.lease_idle_timeout_s / 2)
             now = time.monotonic()
             for sig, pool in list(self._idle_leases.items()):
                 keep = []
                 for lease in pool:
-                    if now - lease.last_used > LEASE_IDLE_TIMEOUT_S:
+                    if now - lease.last_used > cfg.lease_idle_timeout_s:
                         asyncio.ensure_future(self._drop_lease(lease))
                     else:
                         keep.append(lease)
@@ -1069,7 +1255,7 @@ class CoreWorker:
 
     async def _probe_actor(self, actor_id: str):
         """Refresh actor state from GCS after a connection loss."""
-        await asyncio.sleep(0.2)
+        await asyncio.sleep(cfg.actor_restart_probe_s)
         st = self.actor_handles.get(actor_id)
         if st is None or st.ready.is_set():
             return

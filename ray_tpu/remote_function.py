@@ -76,7 +76,7 @@ class RemoteFunction:
             self._function, args, kwargs,
             num_returns=num_returns,
             resources=_resources_from_options(opts),
-            max_retries=opts.get("max_retries", 3),
+            max_retries=opts.get("max_retries"),
             scheduling=_scheduling_from_options(opts),
             name=opts.get("name") or getattr(self._function, "__name__",
                                  type(self._function).__name__),

@@ -21,6 +21,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ray_tpu._private.config import cfg
+
 _GROUPS: Dict[str, "CollectiveGroup"] = {}
 
 
@@ -51,7 +53,7 @@ def _kv_get(key: str, timeout: float = 60.0) -> bytes:
             return v
         if time.monotonic() > deadline:
             raise TimeoutError(f"collective rendezvous timed out on {key}")
-        time.sleep(0.02)
+        time.sleep(cfg.wait_poll_floor_s)
 
 
 def init_collective_group(world_size: int, rank: int,
@@ -186,9 +188,13 @@ def _xla_allreduce(tensor, op: str):
             if op == "sum":
                 # P() replicates each process's tensor onto all of its
                 # local devices; psum then counts every local copy —
-                # divide the multiplicity back out (exact for the k*n/n
-                # case, so cast back for integer tensors)
-                out = (out / n_local).astype(x.dtype)
+                # divide the multiplicity back out. Integer dtypes use
+                # integer floordiv (exact: value is k*n_local) so large
+                # sums never round through float32.
+                if jnp.issubdtype(x.dtype, jnp.integer):
+                    out = out // n_local
+                else:
+                    out = (out / n_local).astype(x.dtype)
             return out
 
         fn = jax.jit(shard_map(f, mesh=mesh, in_specs=P(), out_specs=P(),
@@ -286,8 +292,11 @@ def _xla_broadcast(tensor, src_rank: int, group: CollectiveGroup):
     if fn is None:
         def f(x):
             # divide the per-process local-device multiplicity back out;
-            # exact, so cast back preserves integer tensors
-            return (jax.lax.psum(x, "all") / n_local).astype(x.dtype)
+            # integer floordiv keeps large integer payloads exact
+            s = jax.lax.psum(x, "all")
+            if jnp.issubdtype(x.dtype, jnp.integer):
+                return s // n_local
+            return (s / n_local).astype(x.dtype)
 
         fn = jax.jit(shard_map(f, mesh=mesh, in_specs=P(), out_specs=P(),
                                check_rep=False))

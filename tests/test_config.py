@@ -1,0 +1,81 @@
+"""Config/flag system: registry, env overrides, propagation.
+
+Mirrors the reference's RAY_CONFIG behavior (reference:
+src/ray/common/ray_config_def.h — env-overridable typed flags;
+node_manager.proto:432 GetSystemConfig head->node propagation).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ray_tpu._private.config import Config, cfg, flags
+
+
+def test_defaults_and_registry():
+    assert cfg.lease_idle_timeout_s == 1.0
+    assert cfg.task_max_retries == 3
+    assert cfg.transfer_chunk_bytes == 64 * 1024 * 1024
+    assert len(flags()) >= 20
+    with pytest.raises(AttributeError):
+        cfg.no_such_flag
+
+
+def test_env_override(monkeypatch):
+    c = Config()
+    monkeypatch.setenv("RAY_TPU_LEASE_IDLE_TIMEOUT_S", "7.5")
+    monkeypatch.setenv("RAY_TPU_TASK_MAX_RETRIES", "9")
+    assert c.lease_idle_timeout_s == 7.5
+    assert c.task_max_retries == 9
+    monkeypatch.setenv("RAY_TPU_TASK_MAX_RETRIES", "not-an-int")
+    with pytest.raises(ValueError):
+        c.task_max_retries
+
+
+def test_explicit_beats_env(monkeypatch):
+    c = Config()
+    monkeypatch.setenv("RAY_TPU_NODE_DEATH_TIMEOUT_S", "99")
+    c.set("node_death_timeout_s", 3.0)
+    assert c.node_death_timeout_s == 3.0
+    c.reset("node_death_timeout_s")
+    assert c.node_death_timeout_s == 99.0
+
+
+def test_snapshot_apply_roundtrip():
+    c = Config()
+    c.set("heartbeat_interval_s", 0.123)
+    snap = c.snapshot()
+    c2 = Config()
+    c2.apply(snap)
+    assert c2.heartbeat_interval_s == 0.123
+    # unknown keys ignored (newer head / older node)
+    c2.apply({"flag_from_the_future": 1})
+    assert "describe" and "heartbeat_interval_s" in c2.describe()
+
+
+def test_cluster_propagation(tmp_path):
+    """_system_config set at init reaches worker processes through the
+    GCS snapshot handshake."""
+    script = """
+import ray_tpu
+from ray_tpu._private.config import cfg
+ray_tpu.init(num_cpus=2, _system_config={"lease_idle_timeout_s": 4.25})
+
+@ray_tpu.remote
+def read_flag():
+    from ray_tpu._private.config import cfg
+    return cfg.lease_idle_timeout_s
+
+assert cfg.lease_idle_timeout_s == 4.25
+got = ray_tpu.get(read_flag.remote(), timeout=60)
+assert got == 4.25, got
+ray_tpu.shutdown()
+print("PROPAGATED")
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=180)
+    assert "PROPAGATED" in out.stdout, (out.stdout, out.stderr[-2000:])

@@ -1,0 +1,89 @@
+"""Memory monitor / OOM worker-killing (reference:
+src/ray/common/memory_monitor.h:52, raylet worker_killing_policy_*.cc —
+retriable task workers die before actors; the node daemon survives).
+"""
+
+import os
+import subprocess
+import sys
+
+from ray_tpu._private.node_manager import NodeManager
+
+
+class _FakeWorker:
+    def __init__(self, state, pid, rss):
+        self.state = state
+        self.pid = pid
+        self.worker_id = f"w{pid}"
+        self._rss = rss
+
+
+def _nm_with_workers(monkeypatch, workers):
+    nm = NodeManager.__new__(NodeManager)   # no start(): policy-only test
+    nm.workers = {w.worker_id: w for w in workers}
+    monkeypatch.setattr(NodeManager, "_proc_rss_bytes",
+                        staticmethod(lambda pid: next(
+                            w._rss for w in workers if w.pid == pid)))
+    return nm
+
+
+def test_meminfo_fraction_parses():
+    frac = NodeManager._system_memory_fraction()
+    assert 0.0 < frac < 1.0
+
+
+def test_victim_prefers_retriable_over_actor(monkeypatch):
+    workers = [
+        _FakeWorker("actor", 11, rss=9_000_000),
+        _FakeWorker("leased", 12, rss=1_000),
+        _FakeWorker("leased", 13, rss=5_000),
+        _FakeWorker("idle", 14, rss=99_000_000),
+    ]
+    nm = _nm_with_workers(monkeypatch, workers)
+    v = nm._pick_oom_victim()
+    assert v.pid == 13          # biggest *leased*, not the bigger actor/idle
+
+
+def test_victim_falls_back_to_actor(monkeypatch):
+    workers = [
+        _FakeWorker("actor", 21, rss=10),
+        _FakeWorker("actor", 22, rss=20),
+        _FakeWorker("idle", 23, rss=999),
+    ]
+    nm = _nm_with_workers(monkeypatch, workers)
+    assert nm._pick_oom_victim().pid == 22
+
+
+def test_no_victim_when_only_idle(monkeypatch):
+    nm = _nm_with_workers(monkeypatch, [_FakeWorker("idle", 31, rss=1)])
+    assert nm._pick_oom_victim() is None
+
+
+def test_oom_kill_e2e():
+    """threshold=0 makes every monitor pass fire: the leased worker is
+    killed mid-task and the owner surfaces a worker-crash failure."""
+    script = """
+import ray_tpu
+ray_tpu.init(num_cpus=2, _system_config={
+    "memory_usage_threshold": 0.0,
+    "memory_monitor_interval_s": 0.2,
+})
+
+@ray_tpu.remote(max_retries=0)
+def hog():
+    import time
+    time.sleep(30)
+    return "survived"
+
+try:
+    ray_tpu.get(hog.remote(), timeout=60)
+    print("UNEXPECTED-SUCCESS")
+except Exception as e:
+    print("KILLED:", type(e).__name__)
+ray_tpu.shutdown()
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=180)
+    assert "KILLED:" in out.stdout, (out.stdout, out.stderr[-2000:])
