@@ -332,11 +332,19 @@ class GcsServer:
         owner_address, definition (bytes key into KV function table),
         init_args (serialized), options."""
         actor_id = spec["actor_id"]
+        # idempotent on actor_id: clients retry through GCS reconnects, and
+        # a retried registration (reply lost, or the actor was already in
+        # the restart snapshot) must not double-schedule or trip the
+        # named-actor check (reference: GcsActorManager dedupes
+        # RegisterActor on actor id, gcs_actor_manager.cc)
+        if actor_id in self.actors and self.actors[actor_id]["state"] != DEAD:
+            return True
         name = spec.get("name")
         ns = spec.get("namespace", "default")
         if name:
             existing = self.named_actors.get((ns, name))
-            if existing is not None and self.actors[existing]["state"] != DEAD:
+            if (existing is not None and existing != actor_id
+                    and self.actors[existing]["state"] != DEAD):
                 raise ValueError(f"actor name {name!r} already taken in namespace {ns!r}")
             self.named_actors[(ns, name)] = actor_id
         row = {

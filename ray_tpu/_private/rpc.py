@@ -326,7 +326,7 @@ async def connect(addr: str, handlers: Optional[Dict[str, Callable]] = None,
         except (ConnectionRefusedError, FileNotFoundError, OSError) as e:
             last_err = e
             if attempt < retries:
-                await asyncio.sleep(retry_delay * (1.5 ** attempt))
+                await asyncio.sleep(min(retry_delay * (1.5 ** attempt), 2.0))
     raise ConnectionError(f"cannot connect to {addr}: {last_err}")
 
 

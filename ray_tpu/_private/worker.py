@@ -135,7 +135,7 @@ class CoreWorker:
         self.pending_tasks: Dict[bytes, PendingTask] = {}
         self._task_counter = 0
         self._func_cache: Dict[bytes, Callable] = {}
-        self._shipped_funcs: set = set()
+        self._func_blobs: Dict[bytes, bytes] = {}
 
         # leases
         self._idle_leases: Dict[tuple, List[Lease]] = {}
@@ -168,6 +168,7 @@ class CoreWorker:
             "wait_object": self.h_wait_object,
             "cancel_task": self.h_cancel_task,
             "add_borrow": self.h_add_borrow,
+            "fetch_function": self.h_fetch_function,
             "remove_borrow": self.h_remove_borrow,
             "object_located": self.h_object_located,
             "exit": self.h_exit,
@@ -208,8 +209,11 @@ class CoreWorker:
         self._event_flusher = asyncio.ensure_future(self._flush_task_events())
         self._install_ref_hooks()
         self._subscribed_actor_channel = False
+        self._subscribed_channels = set()
+        self._gcs_reconnect_lock = None   # created lazily on the loop
         if (self.mode == DRIVER
                 and os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0"):
+            self._subscribed_channels.add("LOGS")
             await self.gcs.call("subscribe", channel="LOGS")
 
     def _install_ref_hooks(self):
@@ -311,6 +315,37 @@ class CoreWorker:
                 await self.gcs.notify("add_task_events", events=batch)
             except Exception:
                 pass
+
+
+    async def _reconnect_gcs(self):
+        """Re-establish the GCS connection after a GCS restart and
+        re-subscribe (reference: NotifyGCSRestart + client reconnection,
+        node_manager.proto:383, gcs_client_reconnection_test.cc).
+        Serialized: concurrent failed callers piggyback on one reconnect
+        instead of racing N connections (and N pubsub registrations)."""
+        if self._gcs_reconnect_lock is None:
+            self._gcs_reconnect_lock = asyncio.Lock()
+        async with self._gcs_reconnect_lock:
+            if self.gcs is not None and not self.gcs.closed:
+                return   # a concurrent caller already reconnected
+            logger.warning("GCS connection lost; reconnecting")
+            self.gcs = await rpc.connect(self.gcs_address,
+                                         handlers={"pubsub": self.h_pubsub},
+                                         name="->gcs", retries=30)
+            for ch in sorted(self._subscribed_channels):
+                try:
+                    await self.gcs.call("subscribe", channel=ch)
+                except Exception:
+                    logger.exception("resubscribe %s failed", ch)
+
+    async def gcs_call_async(self, method, **kw):
+        """GCS call that survives one GCS restart (drivers buffer through
+        a restart instead of failing)."""
+        try:
+            return await self.gcs.call(method, **kw)
+        except (rpc.ConnectionLost, ConnectionError):
+            await self._reconnect_gcs()
+            return await self.gcs.call(method, **kw)
 
     # -------------------------------------------------- ownership bookkeeping
     def _register_owned(self, oid: bytes, lineage=None, complete=False):
@@ -414,7 +449,7 @@ class CoreWorker:
     async def _node_is_dead(self, node_id: str) -> bool:
         """GCS-verified liveness (authoritative node table)."""
         try:
-            nodes = await self.gcs.call("get_all_nodes")
+            nodes = await self.gcs_call_async("get_all_nodes")
         except (rpc.RpcError, rpc.ConnectionLost, ConnectionError):
             return False   # can't verify -> don't destroy state
         for n in nodes:
@@ -731,12 +766,18 @@ class CoreWorker:
             except (AttributeError, TypeError):
                 pass
         fid = self._function_key(pickled)
-        if fid not in self._shipped_funcs:
-            await self.gcs.call("kv_put", ns="funcs", key=fid, value=pickled,
-                                overwrite=False)
-            self._shipped_funcs.add(fid)
+        if fid not in self._func_blobs:
+            # blob retained so executors can re-fetch from us if the GCS
+            # KV copy is lost (GCS restart from a pre-ship snapshot);
+            # presence doubles as the shipped-marker
+            self._func_blobs[fid] = pickled
+            await self.gcs_call_async("kv_put", ns="funcs", key=fid,
+                                      value=pickled, overwrite=False)
         self._func_cache[fid] = func
         return fid
+
+    def h_fetch_function(self, conn, fid: bytes):
+        return self._func_blobs.get(fid)
 
     async def _load_function_any(self, spec: Dict):
         """func_id -> cloudpickled function from GCS KV; func_ref ->
@@ -751,13 +792,29 @@ class CoreWorker:
             for part in attr.split("."):
                 fn = getattr(fn, part)
             return fn
-        return await self._load_function(spec["func_id"])
+        return await self._load_function(spec["func_id"],
+                                         spec.get("owner_address"))
 
-    async def _load_function(self, fid: bytes):
+    async def _load_function(self, fid: bytes, owner_address: str = None):
         fn = self._func_cache.get(fid)
         if fn is not None:
             return fn
-        pickled = await self.gcs.call("kv_get", ns="funcs", key=fid)
+        pickled = await self.gcs_call_async("kv_get", ns="funcs", key=fid)
+        if pickled is None and owner_address:
+            # GCS KV lost the blob (restart from a pre-ship snapshot):
+            # the owner retains every function it shipped — fetch from it
+            # and repair the table for other executors
+            try:
+                pickled = await self.pool.call(owner_address,
+                                               "fetch_function", fid=fid)
+            except (rpc.RpcError, rpc.ConnectionLost, ConnectionError):
+                pickled = None
+            if pickled is not None:
+                try:
+                    await self.gcs_call_async("kv_put", ns="funcs", key=fid,
+                                              value=pickled, overwrite=False)
+                except Exception:
+                    pass
         if pickled is None:
             raise RuntimeError(f"function {fid.hex()[:12]} not in GCS KV")
         fn = cloudpickle.loads(pickled)
@@ -1104,14 +1161,15 @@ class CoreWorker:
         st = ActorHandleState(actor_id)
         self.actor_handles[actor_id] = st
         await self._ensure_actor_subscription()
-        await self.gcs.call("create_actor", spec=spec)
+        await self.gcs_call_async("create_actor", spec=spec)
         return actor_id
 
     async def _ensure_actor_subscription(self):
         if getattr(self, "_subscribed_actor_channel", False):
             return
         self._subscribed_actor_channel = True
-        await self.gcs.call("subscribe", channel="ACTOR")
+        self._subscribed_channels.add("ACTOR")
+        await self.gcs_call_async("subscribe", channel="ACTOR")
 
     def h_pubsub(self, conn, channel: str, key: str, payload: Any):
         if channel == "LOGS":
@@ -1147,7 +1205,7 @@ class CoreWorker:
             st = ActorHandleState(actor_id)
             self.actor_handles[actor_id] = st
             await self._ensure_actor_subscription()
-            info = await self.gcs.call("get_actor_info", actor_id=actor_id)
+            info = await self.gcs_call_async("get_actor_info", actor_id=actor_id)
             if info is not None:
                 # don't regress a fresher pubsub update that raced us
                 if not st.ready.is_set():
@@ -1259,7 +1317,7 @@ class CoreWorker:
         st = self.actor_handles.get(actor_id)
         if st is None or st.ready.is_set():
             return
-        info = await self.gcs.call("get_actor_info", actor_id=actor_id)
+        info = await self.gcs_call_async("get_actor_info", actor_id=actor_id)
         if info and info["state"] == "ALIVE" and info["address"]:
             st.state = "ALIVE"
             st.address = info["address"]
@@ -1270,7 +1328,7 @@ class CoreWorker:
             st.ready.set()
 
     async def kill_actor_async(self, actor_id: str, no_restart=True):
-        await self.gcs.call("kill_actor", actor_id=actor_id,
+        await self.gcs_call_async("kill_actor", actor_id=actor_id,
                             no_restart=no_restart)
 
     # --------------------------------------------------------- execution side
@@ -1358,10 +1416,10 @@ class CoreWorker:
                         z.write(full, os.path.relpath(full, path))
             data = buf.getvalue()
             uri = hashlib.sha1(data).hexdigest()
-            existing = await self.gcs.call("kv_get", ns="runtime_env",
+            existing = await self.gcs_call_async("kv_get", ns="runtime_env",
                                            key=uri.encode())
             if existing is None:
-                await self.gcs.call("kv_put", ns="runtime_env",
+                await self.gcs_call_async("kv_put", ns="runtime_env",
                                     key=uri.encode(), value=data)
             return uri
 
@@ -1390,7 +1448,7 @@ class CoreWorker:
         if os.path.isdir(dest):
             return mod_root
         data = asyncio.run_coroutine_threadsafe(
-            self.gcs.call("kv_get", ns="runtime_env", key=uri.encode()),
+            self.gcs_call_async("kv_get", ns="runtime_env", key=uri.encode()),
             self.loop).result(120)
         if data is None:
             raise RuntimeError(f"runtime_env package {uri} missing")
@@ -1670,7 +1728,7 @@ class Worker:
         return self._run(self.core.cancel_task_async(ref, force))
 
     def gcs_call(self, method, **kw):
-        return self._run(self.core.gcs.call(method, **kw))
+        return self._run(self.core.gcs_call_async(method, **kw))
 
     def node_call(self, method, **kw):
         return self._run(self.core.node_conn.call(method, **kw))
