@@ -109,6 +109,14 @@ class GcsServer:
         self.server.on_disconnect = self._on_disconnect
         self._load_snapshot()
         self.address = await self.server.listen_tcp("0.0.0.0", self.port)
+        # restart path: snapshot-restored actors that never reached ALIVE
+        # must be (re)scheduled — the client's retried create_actor hits
+        # the idempotent early-return and will wait forever otherwise
+        # (reference: gcs_actor_manager.cc reconstruct-on-restart)
+        for aid, row in self.actors.items():
+            if row["state"] in (PENDING_CREATION, RESTARTING,
+                                DEPENDENCIES_UNREADY):
+                asyncio.ensure_future(self._schedule_actor(aid, delay=1.0))
         self._death_checker = asyncio.ensure_future(self._check_node_deaths())
         self._snapshot_task = None
         if self.persist_path:

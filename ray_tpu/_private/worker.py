@@ -135,7 +135,14 @@ class CoreWorker:
         self.pending_tasks: Dict[bytes, PendingTask] = {}
         self._task_counter = 0
         self._func_cache: Dict[bytes, Callable] = {}
-        self._func_blobs: Dict[bytes, bytes] = {}
+        # byte-capped LRU of shipped function pickles (served to executors
+        # if the GCS KV copy is lost to a restart; eviction only risks the
+        # rare restart-from-stale-snapshot window, while an unbounded dict
+        # would grow with every distinct closure a long-lived driver ships)
+        self._func_blobs: "__import__('collections').OrderedDict" = \
+            __import__("collections").OrderedDict()
+        self._func_blob_bytes = 0
+        self._func_blob_cap = 256 * 1024 * 1024
 
         # leases
         self._idle_leases: Dict[tuple, List[Lease]] = {}
@@ -771,8 +778,15 @@ class CoreWorker:
             # KV copy is lost (GCS restart from a pre-ship snapshot);
             # presence doubles as the shipped-marker
             self._func_blobs[fid] = pickled
+            self._func_blob_bytes += len(pickled)
+            while (self._func_blob_bytes > self._func_blob_cap
+                   and len(self._func_blobs) > 1):
+                _, old_blob = self._func_blobs.popitem(last=False)
+                self._func_blob_bytes -= len(old_blob)
             await self.gcs_call_async("kv_put", ns="funcs", key=fid,
                                       value=pickled, overwrite=False)
+        else:
+            self._func_blobs.move_to_end(fid)
         self._func_cache[fid] = func
         return fid
 

@@ -115,7 +115,7 @@ class BackendExecutor:
             local_counters[ip] = local_rank + 1
             setups.append(w.setup.remote(n, rank, local_rank, node_rank))
         ray_tpu.get(setups, timeout=120)
-        if self.use_jax_distributed and n > 1:
+        if self.use_jax_distributed:
             import uuid
             group = f"train-{uuid.uuid4().hex[:8]}"
             ray_tpu.get([w.setup_jax_distributed.remote(group, n, r)

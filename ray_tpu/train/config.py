@@ -20,6 +20,16 @@ class ScalingConfig:
     topology: Optional[str] = None        # e.g. "v5litepod-8", "v4-32"
     mesh: Optional[MeshConfig] = None     # per-worker device mesh axes
     placement_strategy: str = "PACK"
+    # None -> follow use_tpu; True forces the jax.distributed rendezvous
+    # even on CPU workers (multi-process CPU collectives, used in CI)
+    use_jax_distributed: Optional[bool] = None
+
+    def jax_distributed_enabled(self) -> bool:
+        """Explicit True/False wins (even for one worker); default follows
+        use_tpu, where a single-worker run needs no rendezvous."""
+        if self.use_jax_distributed is not None:
+            return self.use_jax_distributed
+        return self.use_tpu and self.num_workers > 1
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})

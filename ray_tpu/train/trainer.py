@@ -61,7 +61,7 @@ class JaxTrainer:
         while True:
             executor = BackendExecutor(
                 self.scaling_config,
-                use_jax_distributed=self.scaling_config.use_tpu
+                use_jax_distributed=self.scaling_config.jax_distributed_enabled()
                 and self.scaling_config.num_workers > 1)
             error = None
             try:

@@ -84,10 +84,9 @@ def _init_jax_distributed(world_size: int, rank: int, group_name: str):
         _kv_put(key, addr.encode())
     else:
         addr = _kv_get(key).decode()
-    if world_size > 1:
-        jax.distributed.initialize(coordinator_address=addr,
-                                   num_processes=world_size,
-                                   process_id=rank)
+    jax.distributed.initialize(coordinator_address=addr,
+                               num_processes=world_size,
+                               process_id=rank)
 
 
 def destroy_collective_group(group_name: str = "default"):
