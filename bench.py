@@ -1,54 +1,298 @@
-"""Round benchmark: core runtime microbenchmark vs the reference's
-checked-in number (BASELINE.md, release/perf_metrics/microbenchmark.json:
-single-client `ray.put` calls/s = 4,962 on a 64-core node; here measured
-on this box). The direct-mapped object path (no store-daemon round trip)
-is the architectural change under test.
+"""Round benchmark: core-runtime microbenchmarks vs the reference's
+checked-in numbers (BASELINE.md, from release/perf_metrics/
+microbenchmark.json, measured there on a 64-core node; this box is far
+smaller, so vs_baseline is conservative), plus the TPU train-step MFU
+headline when a real chip is reachable.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line on stdout:
+  {"metric", "value", "unit", "vs_baseline", "metrics": {...all...}}
+Headline = train_step_mfu on TPU when available, else the geometric-mean
+vs_baseline across the control-plane suite. Per-metric progress goes to
+stderr. Benchmark shapes mirror the reference's harness
+(reference: python/ray/_private/ray_perf.py:1-328).
 """
 
 from __future__ import annotations
 
 import json
+import math
 import sys
 import time
 
-BASELINE_PUT_CALLS = 4962.0   # single_client_put_calls_Plasma_Store
+BASELINES = {
+    "single_client_put_calls_per_s": 4962.0,
+    "single_client_get_calls_per_s": 10412.0,
+    "single_client_tasks_sync_per_s": 942.0,
+    "single_client_tasks_async_per_s": 7998.0,
+    "actor_calls_sync_1_1_per_s": 1935.0,
+    "actor_calls_async_1_1_per_s": 8761.0,
+    "actor_calls_async_n_n_per_s": 27090.0,
+    "single_client_put_gb_per_s": 17.8,
+    "wait_1k_refs_per_s": 5.2,
+}
+
+V5E_PEAK_FLOPS = 197e12     # bf16
+MFU_BASELINE = 0.40         # BASELINE.json north star: >=40% MFU
 
 
-def bench_put_calls(duration: float = 4.0) -> float:
-    import ray_tpu
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
 
+
+def _rate(n, t0):
+    return n / (time.perf_counter() - t0)
+
+
+def bench_puts(ray_tpu, duration=3.0):
     payload = {"k": 1}
-    for _ in range(200):                       # warm
+    for _ in range(100):
         ray_tpu.put(payload)
-    n = 0
-    kept = []
-    t0 = time.perf_counter()
-    while True:
-        for _ in range(200):
+    n, kept, t0 = 0, [], time.perf_counter()
+    while time.perf_counter() - t0 < duration:
+        for _ in range(100):
             kept.append(ray_tpu.put(payload))
-        n += 200
+        n += 100
         if len(kept) > 2000:
             kept.clear()
-        if time.perf_counter() - t0 > duration:
-            break
-    return n / (time.perf_counter() - t0)
+    return _rate(n, t0)
+
+
+def bench_gets(ray_tpu, duration=3.0):
+    ref = ray_tpu.put([1] * 16)
+    for _ in range(100):
+        ray_tpu.get(ref)
+    n, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < duration:
+        for _ in range(100):
+            ray_tpu.get(ref)
+        n += 100
+    return _rate(n, t0)
+
+
+def bench_put_bandwidth(ray_tpu, duration=3.0):
+    import numpy as np
+    blob = np.ones(64 * 1024 * 1024, dtype=np.uint8)   # 64 MB
+    ray_tpu.put(blob)
+    n, kept, t0 = 0, [], time.perf_counter()
+    while time.perf_counter() - t0 < duration:
+        kept.append(ray_tpu.put(blob))
+        n += 1
+        if len(kept) > 3:
+            kept.clear()
+    return _rate(n, t0) * len(blob) / 1e9
+
+
+def bench_tasks_sync(ray_tpu, duration=5.0):
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    for _ in range(20):
+        ray_tpu.get(nop.remote())
+    n, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < duration:
+        for _ in range(10):
+            ray_tpu.get(nop.remote())
+        n += 10
+    return _rate(n, t0)
+
+
+def bench_tasks_async(ray_tpu, duration=5.0, batch=200):
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(20)])
+    n, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < duration:
+        ray_tpu.get([nop.remote() for _ in range(batch)])
+        n += batch
+    return _rate(n, t0)
+
+
+def bench_actor_sync(ray_tpu, duration=5.0):
+    @ray_tpu.remote(num_cpus=0.1)
+    class A:
+        def m(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get([a.m.remote() for _ in range(20)])
+    n, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < duration:
+        for _ in range(10):
+            ray_tpu.get(a.m.remote())
+        n += 10
+    return _rate(n, t0)
+
+
+def bench_actor_async(ray_tpu, duration=5.0, batch=200):
+    @ray_tpu.remote(num_cpus=0.1)
+    class A:
+        def m(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get([a.m.remote() for _ in range(20)])
+    n, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < duration:
+        ray_tpu.get([a.m.remote() for _ in range(batch)])
+        n += batch
+    return _rate(n, t0)
+
+
+def bench_actor_async_n_n(ray_tpu, duration=5.0, n_actors=3, batch=100):
+    @ray_tpu.remote(num_cpus=0.1)
+    class A:
+        def m(self):
+            return None
+
+    actors = [A.remote() for _ in range(n_actors)]
+    ray_tpu.get([a.m.remote() for a in actors])
+    n, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < duration:
+        refs = [a.m.remote() for a in actors for _ in range(batch)]
+        ray_tpu.get(refs)
+        n += len(refs)
+    return _rate(n, t0)
+
+
+def bench_wait_1k(ray_tpu, rounds=5):
+    refs = [ray_tpu.put(i) for i in range(1000)]
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        ready, rest = ray_tpu.wait(refs, num_returns=1000, timeout=30)
+        assert len(ready) == 1000
+    return _rate(rounds, t0)
+
+
+def _tpu_reachable(timeout=120):
+    """Probe device enumeration in a subprocess: a wedged device tunnel
+    hangs jax.devices() forever, which must not hang the whole bench."""
+    import subprocess
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        log("TPU probe timed out; skipping MFU")
+        return False
+    plat = (out.stdout or "").strip().splitlines()[-1:] or [""]
+    if out.returncode == 0 and plat[0] == "tpu":
+        return True
+    log(f"TPU probe: rc={out.returncode} platform={plat[0]!r}; skipping MFU")
+    return False
+
+
+def bench_train_step_mfu():
+    """Flagship-model train step on the real chip: tokens/s + MFU.
+    Returns None when no TPU is reachable (the control-plane suite still
+    runs)."""
+    if not _tpu_reachable():
+        return None
+    import jax
+    devs = jax.devices()
+    import optax
+
+    from ray_tpu.models import MODEL_REGISTRY, TransformerLM
+    from ray_tpu.parallel import MeshConfig, make_mesh
+    from ray_tpu.parallel.train_step import make_train_fns
+
+    name, B, L = "llama-125m", 16, 1024
+    cfg_m = MODEL_REGISTRY[name]
+    model = TransformerLM(cfg_m)
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1), devices=devs[:1])
+    init_fn, step_fn, _ = make_train_fns(model, optax.adamw(3e-4), mesh,
+                                         batch_shape=(B, L + 1))
+    state = init_fn(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, L + 1), 0,
+                                cfg_m.vocab_size)
+    for _ in range(3):
+        state, m = step_fn(state, tokens)
+    float(m["loss"])                       # full sync
+    steps = 20
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step_fn(state, tokens)
+    float(m["loss"])
+    dt = (time.perf_counter() - t0) / steps
+
+    n_layer = cfg_m.n_layers * (
+        cfg_m.d_model * cfg_m.d_model * 2
+        + cfg_m.d_model * (cfg_m.n_kv_heads * cfg_m.head_dim) * 2
+        + 3 * cfg_m.d_model * cfg_m.d_ff)
+    n_unembed = cfg_m.d_model * cfg_m.vocab_size
+    flops = 6 * (n_layer + n_unembed) * B * L \
+        + cfg_m.n_layers * 4 * B * L * L * cfg_m.d_model * 3 / 2
+    mfu = flops / dt / V5E_PEAK_FLOPS
+    log(f"train_step: {name} B={B} L={L} {dt*1e3:.1f} ms/step "
+        f"{B*L/dt:.0f} tok/s MFU={mfu*100:.1f}%")
+    return {"mfu": mfu, "tokens_per_s": B * L / dt, "ms_per_step": dt * 1e3}
 
 
 def main():
     import ray_tpu
-    ray_tpu.init(object_store_memory=256 * 1024 * 1024)
+
+    results = {}
+    # fake CPU count: the reference benches on a 64-core node; these are
+    # nop workloads measuring control-plane throughput, not compute
+    # auto-detected CPUs: on a many-core node the suite parallelizes like
+    # the reference's; on this 1-core bench box extra worker processes
+    # only thrash, so actors claim fractional CPUs instead
+    ray_tpu.init(object_store_memory=512 * 1024 * 1024)
     try:
-        calls_per_s = bench_put_calls()
+        for key, fn in [
+            ("single_client_put_calls_per_s", bench_puts),
+            ("single_client_get_calls_per_s", bench_gets),
+            ("single_client_put_gb_per_s", bench_put_bandwidth),
+            ("single_client_tasks_sync_per_s", bench_tasks_sync),
+            ("single_client_tasks_async_per_s", bench_tasks_async),
+            ("actor_calls_sync_1_1_per_s", bench_actor_sync),
+            ("actor_calls_async_1_1_per_s", bench_actor_async),
+            ("actor_calls_async_n_n_per_s", bench_actor_async_n_n),
+            ("wait_1k_refs_per_s", bench_wait_1k),
+        ]:
+            try:
+                v = fn(ray_tpu)
+                results[key] = {"value": round(v, 2),
+                                "vs_baseline": round(v / BASELINES[key], 3)}
+                log(f"{key}: {v:.1f} ({results[key]['vs_baseline']}x)")
+            except Exception as e:
+                log(f"{key} FAILED: {e}")
+                results[key] = {"value": 0.0, "vs_baseline": 0.0,
+                                "error": str(e)[:200]}
     finally:
         ray_tpu.shutdown()
-    print(json.dumps({
-        "metric": "put_calls_per_s_single_client",
-        "value": round(calls_per_s, 1),
-        "unit": "calls/s",
-        "vs_baseline": round(calls_per_s / BASELINE_PUT_CALLS, 3),
-    }))
+
+    mfu_res = None
+    try:
+        mfu_res = bench_train_step_mfu()
+    except Exception as e:
+        log(f"train_step_mfu FAILED: {e}")
+    if mfu_res is not None:
+        results["train_step_mfu"] = {
+            "value": round(mfu_res["mfu"], 4),
+            "vs_baseline": round(mfu_res["mfu"] / MFU_BASELINE, 3),
+            "tokens_per_s": round(mfu_res["tokens_per_s"], 1),
+            "ms_per_step": round(mfu_res["ms_per_step"], 2),
+        }
+        headline = {"metric": "train_step_mfu",
+                    "value": results["train_step_mfu"]["value"],
+                    "unit": "fraction_of_v5e_peak",
+                    "vs_baseline": results["train_step_mfu"]["vs_baseline"]}
+    else:
+        # failed benchmarks count at 0.01x so a broken suite can't
+        # report a healthy geomean
+        ratios = [max(r.get("vs_baseline", 0.0), 0.01)
+                  for r in results.values()]
+        geo = math.exp(sum(math.log(r) for r in ratios) / len(ratios)) \
+            if ratios else 0.0
+        headline = {"metric": "core_microbench_geomean_vs_baseline",
+                    "value": round(geo, 3), "unit": "x",
+                    "vs_baseline": round(geo, 3)}
+    headline["metrics"] = results
+    print(json.dumps(headline), flush=True)
 
 
 if __name__ == "__main__":

@@ -189,8 +189,12 @@ class Connection:
         async with self._writer_lock:
             if self._closed:
                 raise ConnectionLost(f"connection {self.name} closed")
-            self.writer.write(len(data).to_bytes(4, "little"))
-            self.writer.write(data)
+            if len(data) < 65536:
+                # one buffer -> one syscall for the common small message
+                self.writer.write(len(data).to_bytes(4, "little") + data)
+            else:
+                self.writer.write(len(data).to_bytes(4, "little"))
+                self.writer.write(data)
             await self.writer.drain()
 
     async def call(self, method: str, timeout: Optional[float] = None, **kwargs) -> Any:

@@ -134,7 +134,10 @@ class CoreWorker:
         # tasks
         self.pending_tasks: Dict[bytes, PendingTask] = {}
         self._task_counter = 0
-        self._func_cache: Dict[bytes, Callable] = {}
+        # LRU of live function objects (closures can capture large
+        # arrays; evicted entries reload from _func_blobs / GCS KV)
+        self._func_cache = __import__("collections").OrderedDict()
+        self._func_cache_cap = 512
         # byte-capped LRU of shipped function pickles (served to executors
         # if the GCS KV copy is lost to a restart; eviction only risks the
         # rare restart-from-stale-snapshot window, while an unbounded dict
@@ -764,7 +767,13 @@ class CoreWorker:
     def _function_key(self, pickled: bytes) -> bytes:
         return hashlib.sha1(pickled).digest()
 
-    async def _ship_function(self, func) -> bytes:
+    def _ship_function_nowait(self, func) -> bytes:
+        """Register the function and start the GCS KV upload without
+        awaiting it: keeping this non-blocking preserves submission order
+        across tasks (an await here would let later same-function
+        submissions overtake the first one in the dispatch queue).
+        Executors that race the upload fetch the blob from us directly
+        (h_fetch_function)."""
         pickled = getattr(func, "_rt_pickled", None)
         if pickled is None:
             pickled = cloudpickle.dumps(func)
@@ -783,12 +792,23 @@ class CoreWorker:
                    and len(self._func_blobs) > 1):
                 _, old_blob = self._func_blobs.popitem(last=False)
                 self._func_blob_bytes -= len(old_blob)
-            await self.gcs_call_async("kv_put", ns="funcs", key=fid,
-                                      value=pickled, overwrite=False)
+            asyncio.ensure_future(self.gcs_call_async(
+                "kv_put", ns="funcs", key=fid, value=pickled,
+                overwrite=False))
         else:
             self._func_blobs.move_to_end(fid)
-        self._func_cache[fid] = func
+        self._cache_function(fid, func)
         return fid
+
+    def _cache_function(self, fid: bytes, func) -> None:
+        cache = self._func_cache
+        cache[fid] = func
+        cache.move_to_end(fid)
+        while len(cache) > self._func_cache_cap:
+            cache.popitem(last=False)
+
+    async def _ship_function(self, func) -> bytes:
+        return self._ship_function_nowait(func)
 
     def h_fetch_function(self, conn, fid: bytes):
         return self._func_blobs.get(fid)
@@ -832,7 +852,7 @@ class CoreWorker:
         if pickled is None:
             raise RuntimeError(f"function {fid.hex()[:12]} not in GCS KV")
         fn = cloudpickle.loads(pickled)
-        self._func_cache[fid] = fn
+        self._cache_function(fid, fn)
         return fn
 
     # ------------------------------------------------------ task submission
@@ -844,32 +864,62 @@ class CoreWorker:
                                    max_retries, scheduling, name, runtime_env),
             self.loop).result()
 
+    def _build_task_spec(self, func, args, kwargs, num_returns, name):
+        """Caller-thread-safe part of task submission: ids + arg encoding
+        (ids are urandom-based; serialization touches no loop state)."""
+        task_id = ids.new_task_id(ids.job_id_from_int(self.job_id))
+        return_ids = [ids.object_id_for_return(task_id, i)
+                      for i in range(1, num_returns + 1)]
+        arg_refs: List[ObjectRef] = []
+        spec = {
+            "task_id": task_id, "job_id": self.job_id,
+            "name": name or getattr(func, "__name__", "task"),
+            "args": [_encode_arg(a, arg_refs.append) for a in args],
+            "kwargs": {k: _encode_arg(v, arg_refs.append)
+                       for k, v in (kwargs or {}).items()},
+            "return_ids": return_ids, "owner_address": self.address,
+            "owner_node": self.node_id,
+        }
+        refs = [ObjectRef(rid, self.address) for rid in return_ids]
+        return spec, return_ids, arg_refs, refs
+
+    def submit_task_threadsafe(self, func, args, kwargs, num_returns=1,
+                               resources=None, max_retries=None,
+                               scheduling=None, name=None,
+                               runtime_env=None) -> List[ObjectRef]:
+        """Fire-and-forget submission from a user thread: the refs come
+        back without a loop round trip (submission is local-fast like the
+        reference's SubmitTask; errors surface through the refs)."""
+        spec, return_ids, arg_refs, refs = self._build_task_spec(
+            func, args, kwargs, num_returns, name)
+
+        def _kickoff():
+            asyncio.ensure_future(self._finish_task_submit(
+                func, spec, return_ids, arg_refs, resources, max_retries,
+                scheduling, runtime_env))
+
+        self.loop.call_soon_threadsafe(_kickoff)
+        return refs
+
     async def submit_task_async(self, func, args, kwargs, num_returns=1,
                                 resources=None, max_retries=None,
                                 scheduling=None, name=None,
                                 runtime_env=None) -> List[ObjectRef]:
-        task_id = ids.new_task_id(ids.job_id_from_int(self.job_id))
-        return_ids = [ids.object_id_for_return(task_id, i)
-                      for i in range(1, num_returns + 1)]
-        fid = await self._ship_function(func)
-        arg_refs: List[ObjectRef] = []
-        enc_args = [_encode_arg(a, arg_refs.append) for a in args]
-        enc_kwargs = {k: _encode_arg(v, arg_refs.append)
-                      for k, v in (kwargs or {}).items()}
+        spec, return_ids, arg_refs, refs = self._build_task_spec(
+            func, args, kwargs, num_returns, name)
+        await self._finish_task_submit(func, spec, return_ids, arg_refs,
+                                       resources, max_retries, scheduling,
+                                       runtime_env)
+        return refs
+
+    async def _finish_task_submit(self, func, spec, return_ids, arg_refs,
+                                  resources, max_retries, scheduling,
+                                  runtime_env):
+        """Loop-side completion of a task submission. Failures surface on
+        the return refs (the submitting thread has already moved on)."""
         resources = dict(resources or {})
         if not resources:
             resources = {"CPU": 1.0}
-        spec = {
-            "task_id": task_id, "job_id": self.job_id,
-            "name": name or getattr(func, "__name__", "task"),
-            "func_id": fid, "args": enc_args, "kwargs": enc_kwargs,
-            "return_ids": return_ids, "owner_address": self.address,
-            "owner_node": self.node_id,
-        }
-        if runtime_env:
-            spec["runtime_env"] = await self._package_runtime_env(
-                runtime_env)
-        refs = [ObjectRef(rid, self.address) for rid in return_ids]
         if max_retries is None:
             max_retries = cfg.task_max_retries
         # Lineage: retain the creating task so a lost shm copy can be
@@ -891,11 +941,21 @@ class CoreWorker:
             e = self.owned.get(r.id)
             if e is not None:
                 e["submitted"] = e.get("submitted", 0) + 1
-        self.pending_tasks[task_id] = pt
-        self._record_task_event(task_id, "PENDING", name=spec["name"],
-                                job_id=self.job_id, type="NORMAL_TASK")
+        self.pending_tasks[spec["task_id"]] = pt
+        self._record_task_event(spec["task_id"], "PENDING",
+                                name=spec["name"], job_id=self.job_id,
+                                type="NORMAL_TASK")
+        try:
+            spec["func_id"] = self._ship_function_nowait(func)
+            if runtime_env:
+                spec["runtime_env"] = await self._package_runtime_env(
+                    runtime_env)
+            await self._resolve_dependencies(arg_refs)
+        except Exception as e:
+            self._fail_task(pt, RuntimeError(f"task submission failed: {e}"))
+            self.pending_tasks.pop(spec["task_id"], None)
+            return
         self._enqueue_task(pt, resources, scheduling or {})
-        return refs
 
     # Per-signature dispatch: tasks queue by (resources, scheduling)
     # signature and a bounded set of dispatchers each hold ONE lease and
@@ -904,6 +964,29 @@ class CoreWorker:
     # workers, normal_task_submitter.cc). Without this, N concurrent
     # submissions issue N simultaneous lease requests and the node
     # manager's waiter queue becomes the bottleneck.
+
+    async def _resolve_dependencies(self, arg_refs: List[ObjectRef]):
+        """Wait until every argument object is complete BEFORE the task
+        occupies a lease (reference: DependencyResolver in
+        NormalTaskSubmitter, transport/dependency_resolver.h — args
+        resolve owner-side so leased workers never block on upstream
+        tasks; without this, dependent tasks can exhaust the lease pool
+        and deadlock behind their own dependencies)."""
+        for r in arg_refs:
+            entry = self.owned.get(r.id)
+            if entry is not None:
+                while not entry.get("complete"):
+                    ev = self.object_events.setdefault(r.id, asyncio.Event())
+                    await ev.wait()
+                    entry = self.owned.get(r.id)
+                    if entry is None:
+                        break
+            elif r.owner_address and r.owner_address != self.address:
+                try:
+                    await self.pool.call(r.owner_address, "wait_object",
+                                         oid=r.id)
+                except (rpc.RpcError, rpc.ConnectionLost, ConnectionError):
+                    pass   # the executor surfaces the fetch error
 
     def _enqueue_task(self, pt: PendingTask, resources, scheduling):
         sig = self._lease_sig(resources, scheduling)
@@ -1215,9 +1298,11 @@ class CoreWorker:
 
     async def _actor_state(self, actor_id: str) -> ActorHandleState:
         st = self.actor_handles.get(actor_id)
+        probe = st is None or not st.ready.is_set()
         if st is None:
             st = ActorHandleState(actor_id)
             self.actor_handles[actor_id] = st
+        if probe:
             await self._ensure_actor_subscription()
             info = await self.gcs_call_async("get_actor_info", actor_id=actor_id)
             if info is not None:
@@ -1232,9 +1317,8 @@ class CoreWorker:
                         st.ready.set()
         return st
 
-    async def submit_actor_task_async(self, actor_id: str, method: str,
-                                      args, kwargs, num_returns=1,
-                                      max_task_retries=0) -> List[ObjectRef]:
+    def _build_actor_task_spec(self, actor_id, method, args, kwargs,
+                               num_returns):
         task_id = ids.new_task_id(ids.job_id_from_int(self.job_id))
         return_ids = [ids.object_id_for_return(task_id, i)
                       for i in range(1, num_returns + 1)]
@@ -1249,6 +1333,35 @@ class CoreWorker:
             "owner_node": self.node_id,
         }
         refs = [ObjectRef(rid, self.address) for rid in return_ids]
+        return spec, return_ids, arg_refs, refs
+
+    def submit_actor_task_threadsafe(self, actor_id: str, method: str,
+                                     args, kwargs, num_returns=1,
+                                     max_task_retries=0) -> List[ObjectRef]:
+        """Fire-and-forget actor submission from a user thread — no loop
+        round trip per call. Ordering: call_soon_threadsafe is FIFO and
+        _finish_actor_submit enqueues synchronously, so calls from one
+        thread start in submission order (the reference's
+        SequentialActorSubmitQueue guarantee)."""
+        spec, return_ids, arg_refs, refs = self._build_actor_task_spec(
+            actor_id, method, args, kwargs, num_returns)
+        self.loop.call_soon_threadsafe(
+            self._finish_actor_submit, spec, return_ids, arg_refs,
+            max_task_retries)
+        return refs
+
+    async def submit_actor_task_async(self, actor_id: str, method: str,
+                                      args, kwargs, num_returns=1,
+                                      max_task_retries=0) -> List[ObjectRef]:
+        spec, return_ids, arg_refs, refs = self._build_actor_task_spec(
+            actor_id, method, args, kwargs, num_returns)
+        self._finish_actor_submit(spec, return_ids, arg_refs,
+                                  max_task_retries)
+        return refs
+
+    def _finish_actor_submit(self, spec, return_ids, arg_refs,
+                             max_task_retries):
+        actor_id = spec["actor_id"]
         for rid in return_ids:
             self._register_owned(rid, complete=False)
         pt = PendingTask(spec, return_ids, max_task_retries, arg_refs)
@@ -1256,14 +1369,22 @@ class CoreWorker:
             e = self.owned.get(r.id)
             if e is not None:
                 e["submitted"] = e.get("submitted", 0) + 1
-        self._record_task_event(task_id, "PENDING", name=method,
-                                job_id=self.job_id, type="ACTOR_TASK",
-                                actor_id=actor_id)
-        st = await self._actor_state(actor_id)
+        self._record_task_event(spec["task_id"], "PENDING",
+                                name=spec["method"], job_id=self.job_id,
+                                type="ACTOR_TASK", actor_id=actor_id)
+        st = self.actor_handles.get(actor_id)
+        if st is None:
+            # borrowed handle's first use: create the state synchronously
+            # so later calls enqueue behind this one in order, and kick an
+            # async GCS probe to resolve the address (the sender loop
+            # blocks on st.ready until it lands)
+            st = ActorHandleState(actor_id)
+            self.actor_handles[actor_id] = st
+            asyncio.ensure_future(self._actor_state(actor_id))
         if st.sender is None:
-            st.sender = asyncio.ensure_future(self._actor_sender(actor_id, st))
+            st.sender = asyncio.ensure_future(
+                self._actor_sender(actor_id, st))
         st.queue.put_nowait(pt)
-        return refs
 
     async def _actor_sender(self, actor_id: str, st: ActorHandleState):
         """Per-actor ordered submission pipeline: sends are serialized (so
@@ -1272,6 +1393,7 @@ class CoreWorker:
         concurrently so calls pipeline."""
         while True:
             pt = await st.queue.get()
+            await self._resolve_dependencies(pt.arg_refs)
             while True:
                 await st.ready.wait()
                 if st.state == "DEAD":
@@ -1623,7 +1745,8 @@ class CoreWorker:
     async def h_become_actor(self, conn, spec: Dict):
         self._apply_accelerator_ids(spec)
         self._apply_runtime_env(spec)   # permanent for the actor's life
-        cls = await self._load_function(spec["class_id"])
+        cls = await self._load_function(spec["class_id"],
+                                        spec.get("owner_address"))
         args, kwargs = await self._resolve_args(
             {"args": spec["init_args"], "kwargs": spec["init_kwargs"]})
         self.actor_id = spec["actor_id"]
@@ -1726,14 +1849,14 @@ class Worker:
         return self._run(self.core.wait_async(refs, num_returns, timeout))
 
     def submit(self, func, args, kwargs, **opts) -> List[ObjectRef]:
-        return self._run(self.core.submit_task_async(func, args, kwargs, **opts))
+        return self.core.submit_task_threadsafe(func, args, kwargs, **opts)
 
     def create_actor(self, cls, args, kwargs, **opts) -> str:
         return self._run(self.core.create_actor_async(cls, args, kwargs, **opts))
 
     def submit_actor_task(self, actor_id, method, args, kwargs, **opts):
-        return self._run(self.core.submit_actor_task_async(
-            actor_id, method, args, kwargs, **opts))
+        return self.core.submit_actor_task_threadsafe(
+            actor_id, method, args, kwargs, **opts)
 
     def kill_actor(self, actor_id, no_restart=True):
         return self._run(self.core.kill_actor_async(actor_id, no_restart))

@@ -23,13 +23,27 @@ class Dataset:
 
     def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
                     fn_args=(), fn_kwargs=None,
+                    fn_constructor_args=(), fn_constructor_kwargs=None,
                     concurrency: Optional[int] = None,
+                    num_cpus: Optional[float] = None,
                     **_ignored) -> "Dataset":
+        if isinstance(fn, type):
+            # callable class -> stateful transform on an actor pool
+            # (reference: ActorPoolMapOperator; map_batches(CallableCls,
+            # concurrency=N) in ray.data)
+            return self._extend(exe.ActorPoolMapStage(
+                fn, batch_format=batch_format,
+                fn_constructor_args=fn_constructor_args,
+                fn_constructor_kwargs=fn_constructor_kwargs,
+                fn_args=fn_args, fn_kwargs=fn_kwargs,
+                pool_size=concurrency or 2,
+                num_cpus=0.5 if num_cpus is None else num_cpus))
         return self._extend(exe.MapStage("map_batches", fn,
                                          batch_format=batch_format,
                                          fn_args=fn_args,
                                          fn_kwargs=fn_kwargs,
-                                         concurrency=concurrency))
+                                         concurrency=concurrency,
+                                         num_cpus=num_cpus))
 
     def map(self, fn: Callable, *, concurrency=None, **_) -> "Dataset":
         return self._extend(exe.MapStage("map", fn, concurrency=concurrency))
@@ -195,11 +209,13 @@ class Dataset:
 
     def iter_jax_batches(self, *, batch_size: int, mesh=None, sharding=None,
                          batch_format: str = "numpy", drop_last: bool = True,
-                         prefetch: int = 2, dtypes=None):
+                         prefetch: int = 2, device_prefetch: int = 2,
+                         dtypes=None):
         from ray_tpu.data.iterator import iter_jax_batches as _ijb
         return _ijb(self._execute(), batch_size=batch_size, mesh=mesh,
                     sharding=sharding, drop_last=drop_last,
-                    prefetch=prefetch, dtypes=dtypes)
+                    prefetch=prefetch, device_prefetch=device_prefetch,
+                    dtypes=dtypes)
 
     def take(self, n: int = 20) -> List[Dict[str, Any]]:
         out = []
@@ -227,6 +243,14 @@ class Dataset:
     def to_pandas(self):
         blocks = [ray_tpu.get(r) for r, _ in self._execute()]
         return block_lib.concat_blocks(blocks).to_pandas()
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None):
+        """One executing stream, n disjoint consumers (reference:
+        Dataset.streaming_split -> OutputSplitter)."""
+        from ray_tpu.data.split import streaming_split
+        return streaming_split(self, n, equal=equal,
+                               locality_hints=locality_hints)
 
     def split(self, n: int) -> List["Dataset"]:
         bundles = list(self._execute())

@@ -23,6 +23,43 @@ RefBundle = Tuple[Any, block_lib.BlockMetadata]
 DEFAULT_MAX_IN_FLIGHT = 8
 
 
+class ExecutionBudget:
+    """Cross-operator resource budget (reference: execution/
+    resource_manager.py + backpressure_policy/ — the streaming executor
+    throttles operators against cluster resources instead of letting one
+    stage flood the object store). One budget is shared by every stage of
+    a plan: a stage may only widen its in-flight window while under both
+    the task cap and the bytes cap; at the cap it drains its own window
+    head first (pull-based stages always keep making progress, so this
+    throttles without deadlock)."""
+
+    def __init__(self, max_tasks: int = 32,
+                 max_bytes: int = 256 * 1024 * 1024):
+        self.max_tasks = max_tasks
+        self.max_bytes = max_bytes
+        self.tasks = 0
+        self.bytes = 0
+
+    def try_acquire(self, est_bytes: int, force: bool = False) -> bool:
+        """force=True always succeeds (still counted): a stage with an
+        EMPTY window must launch regardless of the budget, otherwise an
+        upstream stage whose tokens are all held downstream (or vice
+        versa) livelocks the pipeline. Total in-flight stays bounded by
+        max_tasks + n_stages."""
+        if not force:
+            if self.tasks + 1 > self.max_tasks:
+                return False
+            if self.bytes + est_bytes > self.max_bytes and self.tasks > 0:
+                return False
+        self.tasks += 1
+        self.bytes += est_bytes
+        return True
+
+    def release(self, est_bytes: int) -> None:
+        self.tasks -= 1
+        self.bytes -= est_bytes
+
+
 def _map_block_remote(fn_kind: str, fn, block, batch_format: str,
                       fn_args, fn_kwargs):
     """Runs inside a worker: apply one transform to one block.
@@ -54,7 +91,9 @@ def _map_block_remote(fn_kind: str, fn, block, batch_format: str,
 class Stage:
     """Base: transforms a stream of RefBundles."""
 
-    def execute(self, upstream: Iterator[RefBundle]) -> Iterator[RefBundle]:
+    def execute(self, upstream: Iterator[RefBundle],
+                budget: Optional[ExecutionBudget] = None
+                ) -> Iterator[RefBundle]:
         raise NotImplementedError
 
 
@@ -62,7 +101,7 @@ class InputStage(Stage):
     def __init__(self, bundles: List[RefBundle]):
         self.bundles = bundles
 
-    def execute(self, upstream):
+    def execute(self, upstream, budget=None):
         yield from self.bundles
 
 
@@ -75,7 +114,9 @@ class ReadStage(Stage):
         self.max_in_flight = (concurrency or max_in_flight
                               or DEFAULT_MAX_IN_FLIGHT)
 
-    def execute(self, upstream):
+    EST_READ_BYTES = 8 * 1024 * 1024    # pre-read output size guess
+
+    def execute(self, upstream, budget=None):
         # two returns: the block ref is yielded WITHOUT fetching its bytes
         # to the driver; only the small metadata ref is materialized
         remote_read = ray_tpu.remote(num_returns=2)(
@@ -85,14 +126,21 @@ class ReadStage(Stage):
         exhausted = False
         while True:
             while not exhausted and len(window) < self.max_in_flight:
+                if budget is not None and not budget.try_acquire(
+                        self.EST_READ_BYTES, force=not window):
+                    break
                 fn = next(fns, None)
                 if fn is None:
+                    if budget is not None:
+                        budget.release(self.EST_READ_BYTES)
                     exhausted = True
                     break
                 window.append(remote_read.remote(fn))
             if not window:
                 return
             block_ref, meta_ref = window.popleft()
+            if budget is not None:
+                budget.release(self.EST_READ_BYTES)
             yield (block_ref, ray_tpu.get(meta_ref))
 
 
@@ -103,36 +151,138 @@ def _with_meta(block):
 class MapStage(Stage):
     def __init__(self, fn_kind: str, fn, batch_format: str = "numpy",
                  fn_args=(), fn_kwargs=None, max_in_flight: int = None,
-                 concurrency: Optional[int] = None):
+                 concurrency: Optional[int] = None,
+                 num_cpus: Optional[float] = None):
         self.fn_kind = fn_kind
         self.fn = fn
         self.batch_format = batch_format
         self.fn_args = fn_args
         self.fn_kwargs = fn_kwargs
+        self.num_cpus = num_cpus
         self.max_in_flight = (concurrency or max_in_flight
                               or DEFAULT_MAX_IN_FLIGHT)
 
-    def execute(self, upstream):
-        remote_map = ray_tpu.remote(num_returns=2)(_map_block_remote)
+    def execute(self, upstream, budget=None):
+        opts = {"num_returns": 2}
+        if self.num_cpus is not None:
+            opts["num_cpus"] = self.num_cpus
+        remote_map = ray_tpu.remote(**opts)(_map_block_remote)
         window = collections.deque()
         upstream = iter(upstream)
         exhausted = False
+        # rolling output-size estimate for the byte budget: last input
+        # block's size (metadata-driven, like op_runtime_metrics);
+        # per-execution local so concurrent runs don't share state
+        peek_est = 0
         while True:
             while not exhausted and len(window) < self.max_in_flight:
+                est = 0
+                if budget is not None:
+                    est = peek_est
+                    if not budget.try_acquire(est, force=not window):
+                        break
                 nxt = next(upstream, None)
                 if nxt is None:
+                    if budget is not None:
+                        budget.release(est)
                     exhausted = True
                     break
                 ref, meta = nxt
-                window.append(remote_map.remote(
+                peek_est = getattr(meta, "size_bytes", 0) or 0
+                window.append((remote_map.remote(
                     self.fn_kind, self.fn, ref, self.batch_format,
-                    self.fn_args, self.fn_kwargs))
+                    self.fn_args, self.fn_kwargs), est))
             if not window:
                 return
-            block_ref, meta_ref = window.popleft()
+            (block_ref, meta_ref), est = window.popleft()
+            if budget is not None:
+                budget.release(est)
             # block until this output's metadata is ready (keeps order;
             # later tasks keep running in the window); bytes stay put
             yield (block_ref, ray_tpu.get(meta_ref))
+
+
+class ActorPoolMapStage(Stage):
+    """Stateful transforms on a pool of long-lived actors (reference:
+    ActorPoolMapOperator, _internal/execution/operators/ — used when the
+    UDF is a callable class whose construction is expensive: model
+    weights, tokenizers, device state). Blocks round-robin onto the
+    least-loaded actor with a bounded per-actor pipeline."""
+
+    def __init__(self, fn_cls, batch_format: str = "numpy",
+                 fn_constructor_args=(), fn_constructor_kwargs=None,
+                 fn_args=(), fn_kwargs=None, pool_size: int = 2,
+                 max_in_flight_per_actor: int = 2,
+                 num_cpus: float = 0.5):
+        self.fn_cls = fn_cls
+        self.batch_format = batch_format
+        self.ctor_args = fn_constructor_args
+        self.ctor_kwargs = fn_constructor_kwargs or {}
+        self.fn_args = fn_args
+        self.fn_kwargs = fn_kwargs or {}
+        self.pool_size = pool_size
+        self.per_actor = max_in_flight_per_actor
+        self.num_cpus = num_cpus
+
+    def execute(self, upstream, budget=None):
+        fn_cls = self.fn_cls
+        batch_format = self.batch_format
+        fn_args, fn_kwargs = self.fn_args, self.fn_kwargs
+
+        @ray_tpu.remote(num_cpus=self.num_cpus, max_concurrency=1)
+        class _MapWorker:
+            def __init__(self, ctor_args, ctor_kwargs):
+                self._fn = fn_cls(*ctor_args, **ctor_kwargs)
+
+            def apply(self, block):
+                from ray_tpu.data import block as B
+                batch = B.block_to_batch(block, batch_format)
+                out = self._fn(batch, *fn_args, **fn_kwargs)
+                out_block = B.block_from_batch(out)
+                return out_block, B.block_metadata(out_block)
+
+        actors = [_MapWorker.remote(self.ctor_args, self.ctor_kwargs)
+                  for _ in range(self.pool_size)]
+        load = {i: 0 for i in range(self.pool_size)}
+        window = collections.deque()   # (result_ref, actor_idx)
+        upstream = iter(upstream)
+        exhausted = False
+        peek_est = 0   # rolling output estimate = last input block size
+        try:
+            while True:
+                while (not exhausted
+                       and len(window) < self.pool_size * self.per_actor):
+                    est = 0
+                    if budget is not None:
+                        est = peek_est
+                        if not budget.try_acquire(est, force=not window):
+                            break
+                    nxt = next(upstream, None)
+                    if nxt is None:
+                        if budget is not None:
+                            budget.release(est)
+                        exhausted = True
+                        break
+                    ref, meta = nxt
+                    peek_est = getattr(meta, "size_bytes", 0) or 0
+                    idx = min(load, key=load.get)
+                    load[idx] += 1
+                    window.append(
+                        (actors[idx].apply.options(num_returns=2)
+                         .remote(ref), idx, est))
+                if not window:
+                    return
+                (block_ref, meta_ref), idx, est = window.popleft()
+                load[idx] -= 1
+                if budget is not None:
+                    budget.release(est)
+                yield (block_ref, ray_tpu.get(meta_ref))
+        finally:
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
 
 
 class AllToAllStage(Stage):
@@ -142,7 +292,7 @@ class AllToAllStage(Stage):
         self.kind = kind
         self.kwargs = kwargs
 
-    def execute(self, upstream):
+    def execute(self, upstream, budget=None):
         bundles = list(upstream)
         refs = [r for r, _ in bundles]
         if self.kind == "repartition":
@@ -257,7 +407,7 @@ class LimitStage(Stage):
     def __init__(self, limit: int):
         self.limit = limit
 
-    def execute(self, upstream):
+    def execute(self, upstream, budget=None):
         remaining = self.limit
         for ref, meta in upstream:
             if remaining <= 0:
@@ -273,8 +423,11 @@ class LimitStage(Stage):
                 return
 
 
-def execute_plan(stages: List[Stage]) -> Iterator[RefBundle]:
+def execute_plan(stages: List[Stage],
+                 budget: Optional[ExecutionBudget] = None
+                 ) -> Iterator[RefBundle]:
+    budget = budget or ExecutionBudget()
     stream: Iterator[RefBundle] = iter(())
     for stage in stages:
-        stream = stage.execute(stream)
+        stream = stage.execute(stream, budget)
     return stream

@@ -54,10 +54,20 @@ def iter_batches(bundles, *, batch_size: Optional[int], batch_format: str,
 
 def iter_jax_batches(bundles, *, batch_size: int, mesh=None, sharding=None,
                      drop_last: bool = True, prefetch: int = 2,
+                     device_prefetch: int = 2,
                      dtypes: Optional[Dict] = None):
     """Yields dict-of-jax-arrays batches placed per `sharding` (or
     replicated batch-sharded over the mesh's data axes when only `mesh`
-    is given). Prefetch thread overlaps host batch prep with the step."""
+    is given). Two overlap layers feed the mesh (the "ingest feeds TPU
+    device buffers" north star):
+    - a prefetch thread overlaps host batch prep (block fetch, slicing,
+      dtype casts) with everything downstream;
+    - a depth-`device_prefetch` buffer of already-device_put batches keeps
+      host->HBM DMA running while the training step consumes the previous
+      batch (jax.device_put is async, so enqueueing N batches ahead
+      overlaps transfer with compute)."""
+    import collections
+
     import jax
 
     if sharding is None and mesh is not None:
@@ -83,13 +93,22 @@ def iter_jax_batches(bundles, *, batch_size: int, mesh=None, sharding=None,
 
     t = threading.Thread(target=producer, daemon=True)
     t.start()
+
+    def to_device(item):
+        if sharding is not None:
+            return {k: jax.device_put(v, sharding) for k, v in item.items()}
+        return {k: jax.numpy.asarray(v) for k, v in item.items()}
+
+    pending = collections.deque()
+    depth = max(1, device_prefetch)
     while True:
         item = q.get()
         if item is SENTINEL:
-            if err:
-                raise err[0]
-            return
-        if sharding is not None:
-            yield {k: jax.device_put(v, sharding) for k, v in item.items()}
-        else:
-            yield {k: jax.numpy.asarray(v) for k, v in item.items()}
+            break
+        pending.append(to_device(item))
+        if len(pending) >= depth:
+            yield pending.popleft()
+    while pending:
+        yield pending.popleft()
+    if err:
+        raise err[0]

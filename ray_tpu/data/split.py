@@ -1,0 +1,202 @@
+"""Streaming split: one executing dataset feeding N consumers (train
+workers) with disjoint block streams (reference: Dataset.streaming_split
+via OutputSplitter, python/ray/data/_internal/execution/operators/
+output_splitter.py, wired into Train by _internal/data_config.py).
+
+The plan executes ONCE inside a coordinator actor; consumers pull bundles
+by split index over actor RPC. Block bytes never route through the
+coordinator — only refs + metadata travel; consumers fetch blocks from
+the object store directly.
+
+Semantics mirrored from the reference OutputSplitter:
+- bundles deal to the consumer with the fewest rows so far (row balance);
+- per-consumer queues are bounded — a lagging consumer applies
+  backpressure to the whole stream instead of pinning unbounded blocks;
+- ``equal=True`` holds back each consumer's tail and, at end of stream,
+  slices it so every consumer receives EXACTLY the same row count (the
+  remainder is dropped, as in the reference) — required when consumers
+  run lockstep collectives (SPMD training gangs).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import ray_tpu
+
+_WAIT = "__wait__"          # sentinel: stream blocked on a full peer queue
+_QUEUE_CAP = 16             # bundles per consumer before backpressure
+
+
+class _SplitCoordinator:
+    """Actor: executes the plan lazily and deals bundles row-balanced."""
+
+    RETAIN = 4   # handed-out bundles pinned until the consumer's next pull
+
+    def __init__(self, stages, n: int, equal: bool):
+        from ray_tpu.data import execution as exe
+        self._n = n
+        self._equal = equal
+        self._stream = iter(exe.execute_plan(stages))
+        self._queues = [collections.deque() for _ in range(n)]
+        self._rows_dealt = [0] * n     # rows enqueued per consumer
+        self._rows_handed = [0] * n    # rows actually delivered
+        # keep recently handed-out refs alive: the consumer registers its
+        # borrow with us (the owner) only after deserializing the reply,
+        # so dropping our copy at hand-off would free the block under it
+        self._handed = [collections.deque() for _ in range(n)]
+        self._done = False
+        self._trimmed = False
+
+    # ------------------------------------------------------------ dealing
+    def _advance(self):
+        """Pull one bundle from the stream and deal it. Returns True on
+        progress, False at end of stream, None when blocked on a full
+        queue (backpressure: the caller returns a wait sentinel)."""
+        if self._done:
+            return False
+        dest = min(range(self._n), key=lambda i: self._rows_dealt[i])
+        if len(self._queues[dest]) >= _QUEUE_CAP:
+            return None
+        bundle = next(self._stream, None)
+        if bundle is None:
+            self._done = True
+            return False
+        self._queues[dest].append(bundle)
+        self._rows_dealt[dest] += bundle[1].num_rows
+        return True
+
+    def _hand(self, idx: int):
+        bundle = self._queues[idx].popleft()
+        self._rows_handed[idx] += bundle[1].num_rows
+        handed = self._handed[idx]
+        handed.append(bundle)
+        while len(handed) > self.RETAIN:
+            handed.popleft()
+        return bundle
+
+    def _trim_for_equality(self):
+        """End of stream, equal mode: pool every undelivered bundle and
+        redistribute with block slicing so each consumer's total delivered
+        rows is exactly the target (reference OutputSplitter's equal mode
+        splits blocks and drops the remainder the same way)."""
+        from ray_tpu.data import block as block_lib
+        self._trimmed = True
+        pool = [b for q in self._queues for b in q]
+        pool_rows = sum(b[1].num_rows for b in pool)
+        total = sum(self._rows_handed) + pool_rows
+        # highest exactly-reachable target: nobody can hand rows back, and
+        # the pool must cover everyone's deficit
+        target = max(total // self._n, max(self._rows_handed))
+        while target > 0 and sum(max(target - h, 0)
+                                 for h in self._rows_handed) > pool_rows:
+            target -= 1
+
+        cursor = iter(pool)
+        current = None          # (ref, meta, offset)
+
+        def take(quota: int, out: collections.deque):
+            nonlocal current
+            while quota > 0:
+                if current is None:
+                    nxt = next(cursor, None)
+                    if nxt is None:
+                        return
+                    current = (nxt[0], nxt[1], 0)
+                ref, meta, off = current
+                avail = meta.num_rows - off
+                if avail <= quota and off == 0:
+                    out.append((ref, meta))
+                    quota -= avail
+                    current = None
+                else:
+                    n_take = min(avail, quota)
+                    block = ray_tpu.get(ref)
+                    part = block_lib.slice_block(block, off, off + n_take)
+                    out.append((ray_tpu.put(part),
+                                block_lib.block_metadata(part)))
+                    quota -= n_take
+                    current = (ref, meta, off + n_take) \
+                        if off + n_take < meta.num_rows else None
+
+        for i in range(self._n):
+            kept = collections.deque()
+            take(max(target - self._rows_handed[i], 0), kept)
+            self._queues[i] = kept
+
+    # -------------------------------------------------------------- api
+    def next(self, idx: int):
+        """Next (block_ref, metadata) for consumer idx; (_WAIT,) when the
+        stream is backpressured by a lagging peer; None at end."""
+        q = self._queues[idx]
+        while True:
+            if self._equal and not self._done:
+                # keep one bundle in reserve until the stream ends so the
+                # tail can be sliced to equality
+                if len(q) >= 2:
+                    return self._hand(idx)
+            elif q:
+                return self._hand(idx)
+            progressed = self._advance()
+            if progressed is None:
+                return (_WAIT,) if not q or self._equal else self._hand(idx)
+            if progressed is False:
+                if self._equal and not self._trimmed:
+                    self._trim_for_equality()
+                    q = self._queues[idx]
+                return self._hand(idx) if q else None
+
+    def rows_delivered(self) -> List[int]:
+        return list(self._rows_handed)
+
+    def ping(self):
+        return True
+
+
+class DataIterator:
+    """Per-consumer shard handle; usable from any process holding it
+    (reference: ray.data.DataIterator returned by streaming_split)."""
+
+    def __init__(self, coordinator, idx: int):
+        self._coordinator = coordinator
+        self._idx = idx
+
+    def _bundles(self) -> Iterator:
+        while True:
+            bundle = ray_tpu.get(
+                self._coordinator.next.remote(self._idx), timeout=600)
+            if bundle is None:
+                return
+            if bundle[0] == _WAIT:
+                time.sleep(0.1)
+                continue
+            yield tuple(bundle)
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy", drop_last: bool = False):
+        from ray_tpu.data import iterator as it
+        return it.iter_batches(self._bundles(), batch_size=batch_size,
+                               batch_format=batch_format,
+                               drop_last=drop_last)
+
+    def iter_jax_batches(self, **kw):
+        from ray_tpu.data import iterator as it
+        return it.iter_jax_batches(self._bundles(), **kw)
+
+    def iter_rows(self):
+        from ray_tpu.data import block as B
+        for ref, _meta in self._bundles():
+            yield from B.block_to_rows(ray_tpu.get(ref))
+
+
+def streaming_split(dataset, n: int, *, equal: bool = False,
+                    locality_hints=None) -> List[DataIterator]:
+    """Split `dataset`'s output stream across n consumers.
+    ``locality_hints`` is accepted for API parity and currently unused
+    (single-coordinator dealing has no per-node placement)."""
+    coord_cls = ray_tpu.remote(num_cpus=0.1)(_SplitCoordinator)
+    coord = coord_cls.remote(dataset._stages, n, equal)
+    ray_tpu.get(coord.ping.remote(), timeout=120)
+    return [DataIterator(coord, i) for i in range(n)]

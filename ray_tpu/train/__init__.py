@@ -1,9 +1,11 @@
 from ray_tpu.train.checkpoint import Checkpoint
-from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
+from ray_tpu.train.config import (CheckpointConfig, DataConfig,
+                                  FailureConfig, RunConfig,
                                   ScalingConfig)
-from ray_tpu.train.session import get_context, report
+from ray_tpu.train.session import (get_checkpoint, get_context,
+                                   get_dataset_shard, report)
 from ray_tpu.train.trainer import JaxTrainer, Result
 
 __all__ = ["JaxTrainer", "Result", "ScalingConfig", "RunConfig",
-           "FailureConfig", "CheckpointConfig", "Checkpoint", "report",
-           "get_context"]
+           "FailureConfig", "CheckpointConfig", "DataConfig", "Checkpoint",
+           "report", "get_context", "get_checkpoint", "get_dataset_shard"]

@@ -42,6 +42,11 @@ class TrainWorker:
             self._session.latest_checkpoint = ckpt
         return True
 
+    def set_dataset_shards(self, shards):
+        if self._session is not None:
+            self._session.dataset_shards = shards
+        return True
+
     def get_node_ip(self):
         from ray_tpu._private.rpc import node_ip_address
         return node_ip_address()
@@ -125,6 +130,32 @@ class BackendExecutor:
         ray_tpu.get([w.set_resume_checkpoint.remote(ckpt)
                      for w in self.workers], timeout=60)
 
+    def setup_datasets(self, datasets, data_config=None):
+        """Streaming-split datasets across the worker gang (reference:
+        DataConfig streaming split into Train, _internal/data_config.py:
+        one executing stream per dataset, one disjoint shard per worker)."""
+        from ray_tpu.data.split import streaming_split
+        split_names = getattr(data_config, "datasets_to_split", "all") \
+            if data_config is not None else "all"
+        n = len(self.workers)
+        per_worker = {i: {} for i in range(n)}
+        for name, ds in datasets.items():
+            split = split_names == "all" or name in split_names
+            if split and n > 1:
+                shards = streaming_split(ds, n)
+                for i in range(n):
+                    per_worker[i][name] = shards[i]
+            else:
+                # replicated: each worker gets its own full stream
+                for i in range(n):
+                    per_worker[i][name] = streaming_split(ds, 1)[0]
+        # the ORIGINAL coordinator handles live in these iterators: they
+        # must outlive the run (worker-side copies are non-owning, and
+        # dropping the originals would kill the coordinators mid-stream)
+        self._dataset_shards = per_worker
+        ray_tpu.get([w.set_dataset_shards.remote(per_worker[i])
+                     for i, w in enumerate(self.workers)], timeout=120)
+
     def start_training(self, fn: Callable, config):
         self.run_refs = [w.run.remote(fn, config) for w in self.workers]
         return self.run_refs
@@ -154,6 +185,7 @@ class BackendExecutor:
             return True, e
 
     def shutdown(self):
+        self._dataset_shards = None
         self.run_refs = []
         self.workers = []
         if self.pg is not None:

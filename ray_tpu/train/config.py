@@ -41,6 +41,14 @@ class ScalingConfig:
 
 
 @dataclasses.dataclass
+class DataConfig:
+    """Which datasets are streaming-split across train workers; others are
+    replicated per worker (reference: ray.train DataConfig,
+    python/ray/train/_internal/data_config.py)."""
+    datasets_to_split: Any = "all"      # "all" | list of names
+
+
+@dataclasses.dataclass
 class FailureConfig:
     max_failures: int = 0
 

@@ -40,6 +40,7 @@ class _Session:
         self.results: List[Dict[str, Any]] = []
         self.lock = threading.Lock()
         self.latest_checkpoint: Optional[Checkpoint] = None
+        self.dataset_shards: Dict[str, Any] = {}
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None):
@@ -92,3 +93,17 @@ def get_context() -> TrainContext:
 def get_checkpoint() -> Optional[Checkpoint]:
     s = _session
     return s.latest_checkpoint if s else None
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's streaming shard of a Dataset passed to the trainer
+    (reference: ray.train.get_dataset_shard — DataIterator per worker)."""
+    s = _session
+    if s is None:
+        raise RuntimeError("not inside a training worker")
+    shard = s.dataset_shards.get(name)
+    if shard is None:
+        raise KeyError(
+            f"no dataset shard {name!r}; trainer datasets: "
+            f"{sorted(s.dataset_shards)}")
+    return shard

@@ -14,8 +14,8 @@ from typing import Any, Callable, Dict, Optional
 
 from ray_tpu.train.backend_executor import BackendExecutor
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
-from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
-                                  ScalingConfig)
+from ray_tpu.train.config import (CheckpointConfig, DataConfig,
+                                  FailureConfig, RunConfig, ScalingConfig)
 
 
 @dataclasses.dataclass
@@ -32,11 +32,15 @@ class JaxTrainer:
                  *, train_loop_config: Optional[Dict] = None,
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 dataset_config: Optional["DataConfig"] = None,
                  resume_from_checkpoint: Optional[Checkpoint] = None):
         self.train_loop = train_loop_per_worker
         self.train_loop_config = train_loop_config
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.dataset_config = dataset_config
         self.resume_from_checkpoint = resume_from_checkpoint
 
     def fit(self) -> Result:
@@ -61,13 +65,16 @@ class JaxTrainer:
         while True:
             executor = BackendExecutor(
                 self.scaling_config,
-                use_jax_distributed=self.scaling_config.jax_distributed_enabled()
-                and self.scaling_config.num_workers > 1)
+                use_jax_distributed=(
+                    self.scaling_config.jax_distributed_enabled()))
             error = None
             try:
                 executor.start()
                 if resume is not None:
                     executor.set_resume_checkpoint(resume)
+                if self.datasets:
+                    executor.setup_datasets(self.datasets,
+                                            self.dataset_config)
                 executor.start_training(self.train_loop,
                                         self.train_loop_config)
                 while True:
