@@ -7,7 +7,7 @@ autodetect :48, TPU_VISIBLE_CHIPS isolation :155, pod-type detection :198,
 pod-slice resources :334). Differences: slice gang scheduling is meant to
 be first-class here — a node in a TPU pod slice advertises
   TPU-{accelerator_type}-head : 1.0   (worker 0 only)
-  {pod_name}                  : 1.0   (every worker in the slice)
+  tpu-slice:{pod_name}        : 1.0   (every worker in the slice)
 so a trainer reserves a whole slice by taking the head resource and then
 fanning out per-host actors pinned by the pod-name resource.
 """
@@ -87,14 +87,18 @@ class TPUAcceleratorManager(AcceleratorManager):
 
     @staticmethod
     def get_current_node_additional_resources() -> Dict[str, float]:
-        """Slice resources: {pod_name}: 1 on every slice host,
-        TPU-{type}-head: 1 on worker 0 (reference: tpu.py:334-397)."""
+        """Slice resources: tpu-slice:{pod_name}: 1 on every slice
+        host, TPU-{type}-head: 1 on worker 0 (reference:
+        tpu.py:334-397)."""
         out: Dict[str, float] = {}
         accel_type = TPUAcceleratorManager.get_current_node_accelerator_type()
         pod_name = os.environ.get(GCE_TPU_NAME_ENV)
         if accel_type and _is_multi_host(accel_type):
             if pod_name:
-                out[pod_name] = 1.0
+                # prefixed so slice-membership markers are recognizable to
+                # the gang scheduler (train/slice.py) among arbitrary
+                # custom resources
+                out[f"tpu-slice:{pod_name}"] = 1.0
             if TPUAcceleratorManager.is_pod_worker_0():
                 out[f"TPU-{accel_type}-head"] = 1.0
         return out

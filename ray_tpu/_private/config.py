@@ -120,6 +120,9 @@ _flag("memory_usage_threshold", float, 0.95,
       "Fraction of system memory above which the node manager kills the "
       "largest retriable worker (OOM defense).")
 
+_flag("slice_wait_timeout_s", float, 60.0,
+      "How long a gang waits for a whole healthy TPU slice before "
+      "failing the attempt.")
 _flag("spill_low_watermark", float, 0.6,
       "Spilling stops once arena utilization falls below this fraction.")
 # NOTE: RPC chaos injection is configured through rpc.py's own

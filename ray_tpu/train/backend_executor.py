@@ -88,21 +88,55 @@ class BackendExecutor:
         self.pg = None
         self.workers: List = []
         self.run_refs: List = []
+        self.slice_pod = None
 
     def start(self):
         n = self.scaling.num_workers
         res = self.scaling.worker_resources()
-        self.pg = placement_group([dict(res) for _ in range(n)],
-                                  strategy=self.scaling.placement_strategy)
+        strategy = self.scaling.placement_strategy
+        bundles = [dict(res) for _ in range(n)]
+        topology = self.scaling.topology
+        if topology:
+            # slice gang: one worker per slice host, pinned to ONE healthy
+            # slice via its pod resource, STRICT_SPREAD across its hosts
+            # (fails-as-a-unit semantics come from the trainer restarting
+            # the whole gang on any worker/node death)
+            from ray_tpu.train import slice as slice_lib
+            n_hosts, chips = slice_lib.slice_shape(topology)
+            if n_hosts > 1:
+                if n != n_hosts:
+                    raise ValueError(
+                        f"topology {topology} has {n_hosts} hosts; "
+                        f"ScalingConfig.num_workers={n} must match")
+                # wait for a whole healthy slice (competing gangs /
+                # restarting nodes make this transient; staying in the
+                # wait also keeps the demand visible instead of burning
+                # the trainer's failure budget instantly)
+                from ray_tpu._private.config import cfg as _cfg
+                deadline = time.monotonic() + _cfg.slice_wait_timeout_s
+                pod = None
+                while pod is None:
+                    pod = slice_lib.pick_slice(ray_tpu.nodes(), topology)
+                    if pod is None:
+                        if time.monotonic() > deadline:
+                            raise RuntimeError(
+                                f"no healthy {topology} slice available "
+                                f"({n_hosts} hosts with {chips} free "
+                                f"chips each)")
+                        time.sleep(1.0)
+                bundles = slice_lib.slice_bundles(pod, topology, res)
+                strategy = "STRICT_SPREAD"
+                self.slice_pod = pod
+        self.pg = placement_group(bundles, strategy=strategy)
         if not self.pg.wait(timeout=60):
             remove_placement_group(self.pg)
             raise RuntimeError(
-                f"placement group for {n}x{res} not schedulable")
+                f"placement group for {bundles} not schedulable")
         actor_cls = ray_tpu.remote(TrainWorker)
         self.workers = [
             actor_cls.options(
                 max_concurrency=2,
-                resources=res,       # consumes its bundle
+                resources=dict(bundles[i]),   # consumes its bundle
                 scheduling_strategy=PlacementGroupSchedulingStrategy(
                     self.pg, placement_group_bundle_index=i),
             ).remote()
@@ -187,6 +221,14 @@ class BackendExecutor:
     def shutdown(self):
         self._dataset_shards = None
         self.run_refs = []
+        # gang teardown: surviving workers of a partially-failed slice
+        # must die with it (a half-dead slice can't run collectives and
+        # its actors would leak leases + chips otherwise)
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
         self.workers = []
         if self.pg is not None:
             try:

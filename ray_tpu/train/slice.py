@@ -1,0 +1,77 @@
+"""TPU slice gang scheduling: reserve a whole pod slice as a unit.
+
+A multi-host slice (e.g. v4-32 = 4 hosts) must be acquired, used, and
+released as one gang: XLA collectives span every host over ICI, so a
+partial slice is useless and a dead host invalidates the whole slice
+(SURVEY §7.3 gang semantics). The reference expresses this with injected
+custom resources (reference: python/ray/_private/accelerators/tpu.py:334
+— ``TPU-{type}-head`` on worker 0 + a per-pod-name resource on every
+slice host); here those resources drive a STRICT_SPREAD placement group
+pinned to one slice's hosts, so the gang schedules one-worker-per-host
+on a single slice or not at all.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.accelerators.tpu import _chips_per_host, slice_hosts
+
+logger = logging.getLogger(__name__)
+
+
+def slice_shape(accel_type: str) -> Tuple[int, int]:
+    """(n_hosts, chips_per_host) for a topology string like 'v4-32'."""
+    return slice_hosts(accel_type), _chips_per_host(accel_type)
+
+
+def find_slices(nodes: List[Dict], accel_type: str) -> Dict[str, List[Dict]]:
+    """pod_name -> alive member nodes, discovered from the slice resources
+    the accelerator manager injects at node start."""
+    pods: Dict[str, List[Dict]] = {}
+    for node in nodes:
+        if not node.get("alive", False):
+            continue
+        for res in node.get("total", {}):
+            if res.startswith("tpu-slice:"):
+                pods.setdefault(res, []).append(node)
+    return pods
+
+
+def pick_slice(nodes: List[Dict], accel_type: str,
+               exclude: Optional[set] = None) -> Optional[str]:
+    """Choose a healthy slice whose shape MATCHES the requested topology:
+    exactly n_hosts alive members, each with the topology's chip count
+    free. A larger or partially-dead slice never qualifies — ICI
+    collectives need every host of the physical slice, so scheduling a
+    v4-16 gang onto half a v4-32 pod would hang at initialization.
+    Returns the pod resource name, or None when no whole slice is
+    available."""
+    n_hosts, chips = slice_shape(accel_type)
+    exclude = exclude or set()
+    for pod, members in sorted(find_slices(nodes, accel_type).items()):
+        if pod in exclude:
+            continue
+        if len(members) != n_hosts:
+            continue
+        if any(m.get("total", {}).get("TPU", 0) != chips for m in members):
+            continue
+        free = [m for m in members
+                if m.get("available", {}).get("TPU", 0) >= chips]
+        if len(free) == n_hosts:
+            return pod
+    return None
+
+
+def slice_bundles(pod_name: str, accel_type: str,
+                  worker_resources: Optional[Dict[str, float]] = None
+                  ) -> List[Dict[str, float]]:
+    """One STRICT_SPREAD bundle per slice host: the pod-name resource
+    pins every bundle onto this slice; TPU claims the host's chips; any
+    other per-worker resources (CPU, memory, custom) ride along."""
+    n_hosts, chips = slice_shape(accel_type)
+    base = dict(worker_resources or {"CPU": 1.0})
+    base["TPU"] = float(chips)
+    base[pod_name] = 0.125
+    return [dict(base) for _ in range(n_hosts)]

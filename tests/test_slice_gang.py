@@ -1,0 +1,157 @@
+"""Slice-aware gang scheduling + fail-as-a-unit restart (SURVEY §7.3:
+a TPU pod slice starts, fails, and restarts as one gang; reference
+resource convention: python/ray/_private/accelerators/tpu.py:334 —
+pod-name + head resources; gang restart: Train FailureConfig +
+BackendExecutor group restart).
+
+CPU-hermetic: fake slice hosts carry the tpu-slice:* resources real TPU
+hosts would inject.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.train import (Checkpoint, FailureConfig, JaxTrainer, RunConfig,
+                           ScalingConfig)
+from ray_tpu.train import slice as slice_lib
+
+
+TOPO = "v4-16"        # 2 hosts x 4 chips
+
+
+def test_slice_shape_and_pick():
+    assert slice_lib.slice_shape(TOPO) == (2, 4)
+    nodes = [
+        {"alive": True, "total": {"TPU": 4, "tpu-slice:podA": 1},
+         "available": {"TPU": 4}},
+        {"alive": True, "total": {"TPU": 4, "tpu-slice:podA": 1},
+         "available": {"TPU": 4}},
+        {"alive": True, "total": {"TPU": 4, "tpu-slice:podB": 1},
+         "available": {"TPU": 0}},     # busy
+        {"alive": False, "total": {"TPU": 4, "tpu-slice:podC": 1},
+         "available": {"TPU": 4}},     # dead host
+        {"alive": True, "total": {"TPU": 4, "tpu-slice:podC": 1},
+         "available": {"TPU": 4}},
+    ]
+    assert slice_lib.pick_slice(nodes, TOPO) == "tpu-slice:podA"
+    assert slice_lib.pick_slice(nodes, TOPO,
+                                exclude={"tpu-slice:podA"}) is None
+
+
+def _gang_train_fn(config):
+    import ray_tpu
+    from ray_tpu import train as rt
+
+    ckpt = rt.get_checkpoint()
+    step = ckpt.to_dict()["step"] if ckpt is not None else 0
+    ctx = rt.get_context()
+    node = ray_tpu.get_runtime_context()["node_id"]
+    progress = ray_tpu.get_actor("gang-progress")
+    while step < 6:
+        step += 1
+        time.sleep(0.5)
+        if ctx.get_world_rank() == 0:
+            ray_tpu.get(progress.update.remote(step, node), timeout=30)
+        rt.report({"step": step, "node": node,
+                   "rank": ctx.get_world_rank()},
+                  checkpoint=(Checkpoint.from_dict({"step": step})
+                              if ctx.get_world_rank() == 0 else None))
+
+
+def test_gang_restart_on_slice_host_death():
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    pod_a = [cluster.add_node(num_cpus=2,
+                              resources={"TPU": 4, "tpu-slice:podA": 1})
+             for _ in range(2)]
+    for _ in range(2):
+        cluster.add_node(num_cpus=2,
+                         resources={"TPU": 4, "tpu-slice:podB": 1})
+    ray_tpu.init(address=cluster.address)
+    cluster.wait_for_nodes()   # deterministic pick: podA (sorted first)
+    try:
+        trainer = JaxTrainer(
+            _gang_train_fn,
+            scaling_config=ScalingConfig(num_workers=2, topology=TOPO),
+            run_config=RunConfig(name="gang-restart",
+                                 failure_config=FailureConfig(max_failures=2)),
+        )
+        @ray_tpu.remote(num_cpus=0.1)
+        class Progress:
+            def __init__(self):
+                self.step = 0
+                self.nodes = set()
+
+            def update(self, step, node):
+                self.step = step
+                self.nodes.add(node)
+                return True
+
+            def get(self):
+                return self.step, sorted(self.nodes)
+
+        from ray_tpu.util.scheduling_strategies import \
+            NodeAffinitySchedulingStrategy
+        progress = Progress.options(
+            name="gang-progress",
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                cluster.nodes[0].node_id)).remote()   # not on a doomed host
+        ray_tpu.get(progress.get.remote(), timeout=60)
+
+        # kill one podA host once the gang has made real progress (a
+        # checkpoint exists): the whole gang must restart from it on the
+        # surviving full slice (podB)
+        def _kill_after_progress():
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                step, _nodes = ray_tpu.get(progress.get.remote(),
+                                           timeout=30)
+                if step >= 2:
+                    cluster.remove_node(pod_a[0])
+                    return
+                time.sleep(0.1)
+
+        killer = threading.Thread(target=_kill_after_progress, daemon=True)
+        killer.start()
+        result = trainer.fit()
+        killer.join(timeout=10)
+        assert result.error is None, result.error
+        assert result.metrics["step"] == 6
+        final_step, nodes_seen = ray_tpu.get(progress.get.remote(),
+                                             timeout=30)
+        assert final_step == 6
+        # rank-0 ran on hosts of BOTH slices across the restart
+        assert len(nodes_seen) >= 2, nodes_seen
+        # and the restart resumed from the checkpoint (history repeats a
+        # step rather than losing all progress; rank0 history only)
+        steps = [m["step"] for m in result.metrics_history]
+        assert steps[-1] == 6 and min(steps) == 1
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_slice_gang_unschedulable_without_whole_slice():
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2, resources={"TPU": 4, "tpu-slice:podA": 1})
+    import os
+    os.environ["RAY_TPU_SLICE_WAIT_TIMEOUT_S"] = "3"
+    ray_tpu.init(address=cluster.address)   # only ONE podA host of two
+    try:
+        trainer = JaxTrainer(
+            _gang_train_fn,
+            scaling_config=ScalingConfig(num_workers=2, topology=TOPO),
+            run_config=RunConfig(name="gang-unsched",
+                                 failure_config=FailureConfig(max_failures=0)),
+        )
+        result = trainer.fit()
+        assert result.error is not None
+        assert "slice" in str(result.error)
+    finally:
+        import os
+        os.environ.pop("RAY_TPU_SLICE_WAIT_TIMEOUT_S", None)
+        ray_tpu.shutdown()
+        cluster.shutdown()
