@@ -1,5 +1,7 @@
 from ray_tpu.serve.api import (batch, delete, deployment, get_app_handle,
-                               run, shutdown, status)
+                               proxies, run, shutdown, start, status)
+from ray_tpu.serve.grpc_proxy import grpc_call
+from ray_tpu.serve.schema import deploy_from_config
 from ray_tpu.serve.deployment import Application, Deployment
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
@@ -7,4 +9,5 @@ from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 __all__ = ["deployment", "run", "shutdown", "status", "batch", "delete",
            "get_app_handle", "Deployment", "Application",
            "DeploymentHandle", "DeploymentResponse", "multiplexed",
-           "get_multiplexed_model_id"]
+           "get_multiplexed_model_id", "start", "proxies", "grpc_call",
+           "deploy_from_config"]

@@ -16,7 +16,6 @@ from ray_tpu.serve.handle import DeploymentHandle
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 _controller_handle = None
-_proxy_handle = None
 
 
 def deployment(_func_or_class=None, *, name: Optional[str] = None,
@@ -52,7 +51,11 @@ def _get_controller():
         actor_cls = ray_tpu.remote(ServeController)
         _controller_handle = actor_cls.options(
             name=CONTROLLER_NAME, namespace="serve", lifetime="detached",
-            max_concurrency=8, num_cpus=0.1).remote()
+            # long-poll listeners park one executor thread each for
+            # up to 30s (proxies + handle clients); size for ~100
+            # nodes of headroom. An asyncio LongPollHost would scale
+            # further (reference does this) if ever needed.
+            max_concurrency=256, num_cpus=0.1).remote()
     return _controller_handle
 
 
@@ -82,7 +85,6 @@ def _app_to_specs(app: Application, app_name: str) -> List[Dict]:
 
 
 _ingress: Dict[str, str] = {}          # app_name -> ingress deployment
-_routes: Dict[str, str] = {}           # route_prefix -> app_name
 
 
 def run(app: Application, *, name: str = "default",
@@ -92,9 +94,12 @@ def run(app: Application, *, name: str = "default",
     specs = _app_to_specs(app, name)
     ray_tpu.get(controller.deploy_application.remote(name, specs),
                 timeout=120)
+    # routes live in the controller and are long-poll-pushed to every
+    # proxy (reference: EndpointState + LongPollHost)
+    ray_tpu.get(controller.set_route.remote(route_prefix, name,
+                                            app.deployment.name),
+                timeout=30)
     _ingress[name] = app.deployment.name
-    if route_prefix:
-        _routes[route_prefix] = name
     handle = DeploymentHandle(app.deployment.name, name)
     # wait for replicas
     deadline = time.monotonic() + 60
@@ -105,8 +110,42 @@ def run(app: Application, *, name: str = "default",
             break
         time.sleep(0.2)
     if _http:
-        _ensure_proxy(http_port)
+        start(http_port=http_port)
     return handle
+
+
+def start(http_port: Optional[int] = None, grpc_port: Optional[int] = None,
+          wait: bool = True, timeout: float = 120.0):
+    """Enable ingress: the controller keeps one HTTP (and optionally
+    gRPC) proxy on every alive node (reference: proxy-per-node,
+    controller ProxyState + gRPCProxy proxy.py:558). Blocks until every
+    alive node has its proxies unless wait=False."""
+    if http_port is None and grpc_port is None:
+        http_port = 8000    # reference default: serve.start() serves HTTP
+    ctrl = _get_controller()
+    ray_tpu.get(ctrl.set_http.remote(http_port, grpc_port), timeout=120)
+    if not wait:
+        return
+    want_http = http_port is not None
+    want_grpc = grpc_port is not None
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        n_alive = len([n for n in ray_tpu.nodes() if n["alive"]])
+        addrs = ray_tpu.get(ctrl.get_proxies.remote(), timeout=30)
+        ok = len(addrs) >= n_alive and all(
+            (not want_http or "http" in a) and (not want_grpc or "grpc" in a)
+            for a in addrs.values())
+        if ok and addrs:
+            return
+        # the reconcile lock may have skipped this round: nudge again
+        ray_tpu.get(ctrl.set_http.remote(None, None), timeout=30)
+        time.sleep(0.3)
+    raise TimeoutError("serve ingress proxies did not come up")
+
+
+def proxies() -> Dict:
+    """node_id -> {"http": addr, "grpc": addr} for every ingress proxy."""
+    return ray_tpu.get(_get_controller().get_proxies.remote(), timeout=30)
 
 
 def get_app_handle(name: str = "default") -> DeploymentHandle:
@@ -130,35 +169,22 @@ def delete(name: str = "default"):
 
 
 def shutdown():
-    global _controller_handle, _proxy_handle
-    try:
-        if _proxy_handle is not None:
-            ray_tpu.kill(_proxy_handle)
-    except Exception:
-        pass
+    global _controller_handle
     try:
         ctrl = _get_controller()
         for app in ray_tpu.get(ctrl.list_applications.remote(), timeout=30):
             ray_tpu.get(ctrl.delete_application.remote(app), timeout=60)
+        try:
+            ray_tpu.get(ctrl.shutdown_proxies.remote(), timeout=60)
+        except Exception:
+            pass
         ray_tpu.kill(ctrl)
     except Exception:
         pass
     _controller_handle = None
-    _proxy_handle = None
     _ingress.clear()
-    _routes.clear()
-
-
-def _ensure_proxy(port: int):
-    global _proxy_handle
-    if _proxy_handle is not None:
-        return
-    from ray_tpu.serve.proxy import HttpProxy
-    actor_cls = ray_tpu.remote(HttpProxy)
-    _proxy_handle = actor_cls.options(
-        name="SERVE_PROXY", namespace="serve", max_concurrency=64,
-        num_cpus=0.1).remote(port, dict(_routes), dict(_ingress))
-    ray_tpu.get(_proxy_handle.ready.remote(), timeout=60)
+    from ray_tpu.serve.handle import _LongPollClient
+    _LongPollClient.reset()
 
 
 # ------------------------------------------------------------------ batching

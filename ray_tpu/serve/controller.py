@@ -27,15 +27,182 @@ class ServeController:
         self._load_ema: Dict[tuple, float] = {}
         self._scale_marks: Dict[tuple, float] = {}
         self._stop = False
+        # routing state is controller-owned so every proxy on every node
+        # serves one authoritative table (reference: EndpointState +
+        # ProxyState in the controller)
+        self.routes: Dict[str, str] = {}        # route_prefix -> app
+        self.ingress: Dict[str, str] = {}       # app -> deployment
+        self.http_port: Optional[int] = None    # None = HTTP disabled
+        self.grpc_port: Optional[int] = None    # None = gRPC disabled
+        self._proxies: Dict[str, Any] = {}      # node_id -> actor handle
+        self._grpc_proxies: Dict[str, Any] = {}
+        self._proxy_addrs: Dict[str, Dict] = {} # node_id -> {http, grpc}
+        # long-poll: every mutation bumps a key's version and wakes
+        # listeners (reference: LongPollHost, _private/long_poll.py:177 —
+        # config push instead of client polling)
+        self._versions: Dict[str, int] = {"routes": 0}
+        self._longpoll = threading.Condition()
+        self._proxy_reconcile_lock = threading.Lock()
         self._thread = threading.Thread(target=self._reconcile_loop,
                                         daemon=True)
         self._thread.start()
+
+    # ------------------------------------------------------- long poll
+    def _bump(self, key: str):
+        with self._longpoll:
+            self._versions[key] = self._versions.get(key, 0) + 1
+            self._longpoll.notify_all()
+
+    def _key_data(self, key: str):
+        if key == "routes":
+            return {"routes": dict(self.routes),
+                    "ingress": dict(self.ingress)}
+        if key.startswith("dep:"):
+            _, app_name, name = key.split(":", 2)
+            return self.get_deployment_info(app_name, name)
+        return None
+
+    def listen_for_change(self, snapshot: Dict[str, int],
+                          timeout_s: float = 30.0) -> Dict[str, Dict]:
+        """Block until any watched key moves past the caller's version,
+        then return {key: {"version": v, "data": ...}} for the changed
+        keys (empty dict on timeout — the caller just re-listens)."""
+        deadline = time.monotonic() + timeout_s
+
+        def changed():
+            return {k: v for k, v in self._versions.items()
+                    if k in snapshot and v > snapshot[k]}
+
+        with self._longpoll:
+            while True:
+                hits = changed()
+                if hits:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {}
+                self._longpoll.wait(timeout=remaining)
+        with self._lock:
+            return {k: {"version": v, "data": self._key_data(k)}
+                    for k, v in hits.items()}
+
+    def set_route(self, route_prefix: Optional[str], app_name: str,
+                  ingress_deployment: str):
+        with self._lock:
+            self.ingress[app_name] = ingress_deployment
+            if route_prefix:
+                self.routes[route_prefix] = app_name
+        self._bump("routes")
+        return True
+
+    def set_http(self, port: Optional[int] = None,
+                 grpc_port: Optional[int] = None):
+        """Enable ingress: the reconcile loop keeps one HTTP (and
+        optionally gRPC) proxy on every alive node (reference: proxy per
+        node, controller ProxyState)."""
+        with self._lock:
+            if port is not None:
+                self.http_port = port
+            if grpc_port is not None:
+                self.grpc_port = grpc_port
+        self._reconcile_proxies()
+        return True
+
+    def shutdown_proxies(self):
+        import ray_tpu
+        with self._lock:
+            proxies = list(self._proxies.values()) + \
+                list(self._grpc_proxies.values())
+            self._proxies.clear()
+            self._grpc_proxies.clear()
+            self._proxy_addrs.clear()
+            self.http_port = None
+            self.grpc_port = None
+        for p in proxies:
+            try:
+                ray_tpu.kill(p)
+            except Exception:
+                pass
+        return True
+
+    def get_proxies(self) -> Dict[str, Dict]:
+        with self._lock:
+            return dict(self._proxy_addrs)
+
+    def _reconcile_proxies(self):
+        import ray_tpu
+        from ray_tpu.util.scheduling_strategies import \
+            NodeAffinitySchedulingStrategy
+        if not self._proxy_reconcile_lock.acquire(blocking=False):
+            return   # another reconcile is already creating proxies
+        try:
+            self._reconcile_proxies_locked(ray_tpu,
+                                           NodeAffinitySchedulingStrategy)
+        finally:
+            self._proxy_reconcile_lock.release()
+
+    def _reconcile_proxies_locked(self, ray_tpu,
+                                  NodeAffinitySchedulingStrategy):
+        with self._lock:
+            http_port = self.http_port
+            grpc_port = self.grpc_port
+        if http_port is None and grpc_port is None:
+            return
+        try:
+            nodes = [n for n in ray_tpu.nodes() if n["alive"]]
+        except Exception:
+            return
+        alive_ids = {n["node_id"] for n in nodes}
+        with self._lock:
+            for nid in list(self._proxies):
+                if nid not in alive_ids:
+                    self._proxies.pop(nid, None)
+                    self._proxy_addrs.pop(nid, None)
+            for nid in list(self._grpc_proxies):
+                if nid not in alive_ids:
+                    self._grpc_proxies.pop(nid, None)
+        me = ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
+        for n in nodes:
+            nid = n["node_id"]
+            if http_port is not None and nid not in self._proxies:
+                try:
+                    from ray_tpu.serve.proxy import HttpProxy
+                    actor_cls = ray_tpu.remote(HttpProxy)
+                    proxy = actor_cls.options(
+                        name=f"SERVE_PROXY:{nid[:12]}", namespace="serve",
+                        max_concurrency=64, num_cpus=0.1,
+                        scheduling_strategy=NodeAffinitySchedulingStrategy(
+                            nid)).remote(http_port, me)
+                    addr = ray_tpu.get(proxy.ready.remote(), timeout=60)
+                    with self._lock:
+                        self._proxies[nid] = proxy
+                        self._proxy_addrs.setdefault(nid, {})["http"] = addr
+                except Exception:
+                    logger.exception("http proxy start failed on %s",
+                                     nid[:12])
+            if grpc_port is not None and nid not in self._grpc_proxies:
+                try:
+                    from ray_tpu.serve.grpc_proxy import GrpcProxy
+                    actor_cls = ray_tpu.remote(GrpcProxy)
+                    proxy = actor_cls.options(
+                        name=f"SERVE_GRPC:{nid[:12]}", namespace="serve",
+                        max_concurrency=64, num_cpus=0.1,
+                        scheduling_strategy=NodeAffinitySchedulingStrategy(
+                            nid)).remote(grpc_port, me)
+                    addr = ray_tpu.get(proxy.ready.remote(), timeout=60)
+                    with self._lock:
+                        self._grpc_proxies[nid] = proxy
+                        self._proxy_addrs.setdefault(nid, {})["grpc"] = addr
+                except Exception:
+                    logger.exception("grpc proxy start failed on %s",
+                                     nid[:12])
 
     def deploy_application(self, app_name: str, specs: List[Dict]):
         """specs: dependencies-first list of deployment specs."""
         with self._lock:
             app = self.apps.setdefault(app_name, {})
             for spec in specs:
+                spec["app_name"] = app_name
                 name = spec["name"]
                 dep = app.get(name)
                 if dep is None:
@@ -83,6 +250,14 @@ class ServeController:
             changed = True
         if changed:
             dep["version"] += 1
+            self._bump_dep(dep)
+
+    def _dep_key(self, dep: Dict) -> str:
+        spec = dep["spec"]
+        return f"dep:{spec.get('app_name', '')}:{spec['name']}"
+
+    def _bump_dep(self, dep: Dict):
+        self._bump(self._dep_key(dep))
 
     def _replace_replicas(self, dep: Dict):
         import ray_tpu
@@ -93,6 +268,7 @@ class ServeController:
                 pass
         dep["replicas"] = []
         dep["version"] += 1
+        self._bump_dep(dep)
 
     def _reconcile_loop(self):
         import ray_tpu
@@ -120,8 +296,10 @@ class ServeController:
                         if len(alive) != len(dep["replicas"]):
                             dep["replicas"] = alive
                             dep["version"] += 1
+                            self._bump_dep(dep)
                         self._autoscale(app_name, name, dep)
                         self._reconcile_deployment(dep)
+                self._reconcile_proxies()
             except Exception:
                 logger.exception("reconcile loop iteration failed")
 
@@ -179,6 +357,11 @@ class ServeController:
         import ray_tpu
         with self._lock:
             app = self.apps.pop(app_name, {})
+            self.ingress.pop(app_name, None)
+            for prefix in [p for p, a in self.routes.items()
+                           if a == app_name]:
+                self.routes.pop(prefix, None)
+        self._bump("routes")
         for dep in app.values():
             for r in dep["replicas"]:
                 try:

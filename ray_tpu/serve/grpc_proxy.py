@@ -1,0 +1,137 @@
+"""gRPC ingress proxy actor, one per node (reference:
+python/ray/serve/_private/proxy.py gRPCProxy :558).
+
+Schema-free generic service so users need no protoc step: requests call
+``/rayserve.Ingress/Call`` with metadata ``("application", name)`` (and
+optionally ``("method", name)``); request/response bodies are msgpack
+(falling back to raw bytes). ``grpc_call()`` is the matching client
+helper. Routing state is long-poll-pushed from the controller like the
+HTTP proxy's.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Dict, Optional
+
+import msgpack
+
+SERVICE = "rayserve.Ingress"
+METHOD = "Call"
+
+
+def _encode(obj) -> bytes:
+    try:
+        return msgpack.packb(obj, use_bin_type=True)
+    except (TypeError, ValueError):
+        import cloudpickle
+        return b"\x00PKL" + cloudpickle.dumps(obj)
+
+
+def _decode(data: bytes):
+    if data[:4] == b"\x00PKL":
+        import cloudpickle
+        return cloudpickle.loads(data[4:])
+    try:
+        return msgpack.unpackb(data, raw=False)
+    except Exception:
+        return data
+
+
+class GrpcProxy:
+    def __init__(self, port: int, controller):
+        import grpc
+
+        self.controller = controller
+        self.ingress: Dict[str, str] = {}
+        self._versions = {"routes": 0}
+        self._handles = {}
+
+        proxy = self
+
+        class _Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                if handler_call_details.method != f"/{SERVICE}/{METHOD}":
+                    return None
+                return grpc.unary_unary_rpc_method_handler(
+                    proxy._call,
+                    request_deserializer=None,
+                    response_serializer=None)
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=16),
+            handlers=(_Handler(),),
+            # REUSEPORT off: several per-node proxies share a host in
+            # tests; each must get its own distinct listener
+            options=(("grpc.so_reuseport", 0),))
+        try:
+            bound = self._server.add_insecure_port(f"0.0.0.0:{port}")
+        except RuntimeError:
+            bound = 0
+        if bound == 0:
+            # port taken (several per-node proxies share a host in tests):
+            # fall back to an ephemeral port
+            bound = self._server.add_insecure_port("0.0.0.0:0")
+        self._server.start()
+        from ray_tpu._private.rpc import node_ip_address
+        self._addr = f"{node_ip_address()}:{bound}"
+        self._poller = threading.Thread(target=self._longpoll_loop,
+                                        daemon=True)
+        self._poller.start()
+
+    def _longpoll_loop(self):
+        from ray_tpu.serve.long_poll import run_longpoll_loop
+        run_longpoll_loop(lambda: self.controller, self._versions,
+                          self._on_update)
+
+    def _on_update(self, key: str, data):
+        if key != "routes":
+            return
+        new_ingress = data["ingress"]
+        for app, dep in list(self._handles.items()):
+            if new_ingress.get(app) != dep.deployment_name:
+                self._handles.pop(app, None)
+        self.ingress = new_ingress
+
+    def ready(self) -> str:
+        return self._addr
+
+    def _call(self, request: bytes, context) -> bytes:
+        import grpc
+        meta = dict(context.invocation_metadata())
+        app_name = meta.get("application", "default")
+        dep = self.ingress.get(app_name)
+        if dep is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no application {app_name!r}")
+        h = self._handles.get(app_name)
+        if h is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+            h = DeploymentHandle(dep, app_name)
+            self._handles[app_name] = h
+        method = meta.get("method")
+        payload = _decode(request)
+        try:
+            target = getattr(h, method) if method else h
+            result = target.remote(payload).result(timeout=60)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
+        return _encode(result)
+
+
+def grpc_call(address: str, payload, application: str = "default",
+              method: Optional[str] = None, timeout: float = 60.0):
+    """Client helper for the generic ingress."""
+    import grpc
+
+    metadata = [("application", application)]
+    if method:
+        metadata.append(("method", method))
+    with grpc.insecure_channel(address) as channel:
+        fn = channel.unary_unary(f"/{SERVICE}/{METHOD}",
+                                 request_serializer=None,
+                                 response_deserializer=None)
+        out = fn(_encode(payload), metadata=metadata, timeout=timeout)
+    return _decode(out)

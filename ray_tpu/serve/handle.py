@@ -89,6 +89,64 @@ class DeploymentResponseGenerator:
             self._router = None
 
 
+class _LongPollClient:
+    """One background listener per process pushing controller config into
+    registered routers (reference: LongPollClient, long_poll.py:64 —
+    replaces interval polling; the 2s refresh in _Router stays as a
+    fallback when the controller is unreachable)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "_LongPollClient":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        """Serve shutdown: stop the listener so a later serve session (new
+        controller identity) starts a fresh client instead of a thread
+        stuck talking to a dead actor."""
+        with cls._lock:
+            inst = cls._instance
+            cls._instance = None
+        if inst is not None:
+            inst._stopped = True
+
+    def __init__(self):
+        self._routers: Dict[str, List] = {}
+        self._versions: Dict[str, int] = {}
+        self._reg_lock = threading.Lock()
+        self._stopped = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def register(self, router: "_Router"):
+        key = f"dep:{router.app_name}:{router.deployment_name}"
+        with self._reg_lock:
+            self._routers.setdefault(key, []).append(router)
+            self._versions.setdefault(key, -1)
+
+    def _loop(self):
+        from ray_tpu.serve.long_poll import run_longpoll_loop
+
+        def get_controller():
+            from ray_tpu.serve.api import _get_controller
+            return _get_controller()
+
+        def on_update(key, data):
+            with self._reg_lock:
+                routers = list(self._routers.get(key, []))
+            for r in routers:
+                r._apply_push(data)
+
+        run_longpoll_loop(get_controller, self._versions, on_update,
+                          should_stop=lambda: self._stopped)
+
+
 class _Router:
     def __init__(self, deployment_name: str, app_name: str):
         self.deployment_name = deployment_name
@@ -99,6 +157,19 @@ class _Router:
         self.lock = threading.Lock()
         self._last_refresh = 0.0
         self.model_map: Dict[str, int] = {}   # multiplexed model -> replica
+        try:
+            _LongPollClient.get().register(self)
+        except Exception:
+            pass   # push is an optimization; polling still works
+
+    def _apply_push(self, info: Dict):
+        with self.lock:
+            self._last_refresh = time.monotonic()
+            if info["version"] != self.version:
+                self.version = info["version"]
+                self.replicas = info["replicas"]
+                self.inflight = {i: 0 for i in range(len(self.replicas))}
+                self.model_map.clear()
 
     def _controller(self):
         from ray_tpu.serve.api import _get_controller

@@ -1,0 +1,48 @@
+"""Shared long-poll client loop (reference: LongPollClient,
+python/ray/serve/_private/long_poll.py:64).
+
+One protocol implementation for every listener (HTTP proxy, gRPC proxy,
+handle routers): snapshot versions -> blocking listen on the controller ->
+apply updates via callback -> re-listen. Errors back off and retry; a
+``should_stop`` hook lets owners retire a loop when the controller
+identity changes (serve.shutdown).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+LISTEN_TIMEOUT_S = 30.0
+CALL_TIMEOUT_S = 60.0
+ERROR_BACKOFF_S = 1.0
+
+
+def run_longpoll_loop(get_controller: Callable,
+                      versions: Dict[str, int],
+                      on_update: Callable[[str, Dict], None],
+                      should_stop: Optional[Callable[[], bool]] = None,
+                      idle_sleep_s: float = 0.2) -> None:
+    """Drive a long-poll listener until should_stop(). ``versions`` is
+    mutated in place; ``on_update(key, data)`` is called per changed key."""
+    import ray_tpu
+
+    while not (should_stop and should_stop()):
+        if not versions:
+            time.sleep(idle_sleep_s)
+            continue
+        try:
+            controller = get_controller()
+            updates = ray_tpu.get(
+                controller.listen_for_change.remote(dict(versions),
+                                                    LISTEN_TIMEOUT_S),
+                timeout=CALL_TIMEOUT_S)
+        except Exception:
+            time.sleep(ERROR_BACKOFF_S)
+            continue
+        for key, item in (updates or {}).items():
+            versions[key] = item["version"]
+            try:
+                on_update(key, item["data"])
+            except Exception:
+                pass

@@ -1,25 +1,34 @@
-"""HTTP ingress proxy actor (reference: python/ray/serve/_private/proxy.py
-HTTPProxy :779 — uvicorn/ASGI there; aiohttp here, same role: terminate
-HTTP, route by prefix, forward to the ingress deployment handle)."""
+"""HTTP ingress proxy actor, one per node (reference:
+python/ray/serve/_private/proxy.py HTTPProxy :779 — uvicorn/ASGI there;
+aiohttp here, same role: terminate HTTP, route by prefix, forward to the
+ingress deployment handle). Routing state arrives by long-poll push from
+the controller (reference: LongPollClient, _private/long_poll.py:64), so
+a config change is visible here within one notify, not a poll interval.
+"""
 
 from __future__ import annotations
 
 import asyncio
 import json
-from typing import Dict
+import threading
+from typing import Dict, Optional
 
 
 class HttpProxy:
-    def __init__(self, port: int, routes: Dict[str, str],
-                 ingress: Dict[str, str]):
+    def __init__(self, port: int, controller):
         self.port = port
-        self.routes = routes          # route_prefix -> app_name
-        self.ingress = ingress        # app_name -> deployment name
+        self.controller = controller
+        self.routes: Dict[str, str] = {}      # route_prefix -> app_name
+        self.ingress: Dict[str, str] = {}     # app_name -> deployment
+        self._versions = {"routes": 0}
         self._handles = {}
-        self._ready = False
+        self._addr: Optional[str] = None
         from ray_tpu._private.worker import global_worker
         asyncio.run_coroutine_threadsafe(
             self._start(), global_worker.core.loop).result(timeout=30)
+        self._poller = threading.Thread(target=self._longpoll_loop,
+                                        daemon=True)
+        self._poller.start()
 
     async def _start(self):
         from aiohttp import web
@@ -28,18 +37,38 @@ class HttpProxy:
         app.router.add_route("*", "/{tail:.*}", self._handle)
         runner = web.AppRunner(app)
         await runner.setup()
-        site = web.TCPSite(runner, "0.0.0.0", self.port)
-        await site.start()
-        self._ready = True
+        try:
+            site = web.TCPSite(runner, "0.0.0.0", self.port)
+            await site.start()
+            bound = self.port
+        except OSError:
+            # port taken (several proxies share a host in tests / when
+            # multiple nodes run on one machine): fall back to ephemeral
+            site = web.TCPSite(runner, "0.0.0.0", 0)
+            await site.start()
+            bound = site._server.sockets[0].getsockname()[1]
+        from ray_tpu._private.rpc import node_ip_address
+        self._addr = f"{node_ip_address()}:{bound}"
 
-    def ready(self):
-        return self._ready
+    def _longpoll_loop(self):
+        from ray_tpu.serve.long_poll import run_longpoll_loop
+        run_longpoll_loop(lambda: self.controller, self._versions,
+                          self._on_update)
 
-    def update_routes(self, routes: Dict[str, str],
-                      ingress: Dict[str, str]):
-        self.routes = routes
-        self.ingress = ingress
-        return True
+    def _on_update(self, key: str, data):
+        if key != "routes":
+            return
+        self.routes = data["routes"]
+        new_ingress = data["ingress"]
+        # drop cached handles whose app's ingress deployment changed —
+        # a stale handle would keep routing to the old deployment
+        for app, dep in list(self._handles.items()):
+            if new_ingress.get(app) != dep.deployment_name:
+                self._handles.pop(app, None)
+        self.ingress = new_ingress
+
+    def ready(self) -> str:
+        return self._addr
 
     def _handle_for(self, app_name: str):
         h = self._handles.get(app_name)

@@ -1,0 +1,148 @@
+"""Serve hardening: proxy-per-node, long-poll config push, gRPC ingress,
+declarative YAML deploys (reference: _private/long_poll.py:177 LongPollHost,
+proxy.py:558 gRPCProxy + :1153 one ProxyActor per node, serve/schema.py).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def two_node_cluster():
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+    cluster.wait_for_nodes()
+    yield cluster
+    serve.shutdown()
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+@serve.deployment
+def echo(payload):
+    return {"echo": payload}
+
+
+@serve.deployment
+class Version:
+    def __init__(self, tag):
+        self.tag = tag
+
+    def __call__(self, payload):
+        return self.tag
+
+
+def _http_get(addr, path, payload="x"):
+    req = urllib.request.Request(
+        f"http://{addr}{path}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        body = resp.read()
+    try:
+        return json.loads(body)
+    except json.JSONDecodeError:
+        return body.decode()     # plain-text responses (string results)
+
+
+def test_proxy_per_node_and_grpc(two_node_cluster):
+    serve.run(echo.bind(), name="app1", route_prefix="/")
+    serve.start(http_port=18123, grpc_port=19123)
+
+    n_nodes = len([n for n in ray_tpu.nodes() if n["alive"]])
+    assert n_nodes == 2
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        proxies = serve.proxies()
+        if len(proxies) >= n_nodes and all("http" in p and "grpc" in p
+                                           for p in proxies.values()):
+            break
+        time.sleep(0.5)
+    # one proxy pair on EVERY node
+    assert len(proxies) == n_nodes, proxies
+    http_addrs = {p["http"] for p in proxies.values()}
+    grpc_addrs = {p["grpc"] for p in proxies.values()}
+    assert len(http_addrs) == n_nodes     # distinct listeners
+    assert len(grpc_addrs) == n_nodes
+
+    # every node's HTTP proxy serves the app
+    for node_id, addrs in proxies.items():
+        out = _http_get(addrs["http"], "/", payload="hi")
+        assert out == {"echo": "hi"}, (node_id, out)
+
+    # gRPC ingress round trip on each node
+    for node_id, addrs in proxies.items():
+        out = serve.grpc_call(addrs["grpc"], {"k": 1}, application="app1")
+        assert out == {"echo": {"k": 1}}, (node_id, out)
+
+
+def test_longpoll_push_latency(two_node_cluster):
+    handle = serve.run(Version.bind("v1"), name="vapp", route_prefix="/v")
+    assert handle.remote("x").result(timeout=30) == "v1"
+    router = handle._router
+    v_before = router.version
+
+    # DISABLE the router's polling fallback: any update it sees from here
+    # on can only arrive via the controller's long-poll push
+    router._last_refresh = time.monotonic() + 3600
+
+    serve.run(Version.bind("v2"), name="vapp", route_prefix="/v")
+    # wait until the new replica is actually running (replica startup is
+    # not config-propagation latency)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        dep = serve.status()["vapp"]["Version"]
+        if dep["running"] >= 1 and dep["version"] > v_before:
+            break
+        time.sleep(0.1)
+    # push-only propagation into the live handle
+    t0 = time.time()
+    while time.time() < t0 + 10:
+        if router.version >= dep["version"]:
+            break
+        time.sleep(0.02)
+    latency = time.time() - t0
+    # propagation (push into the live router) beats the 2s poll fallback
+    # by an order of magnitude; the request itself is timed separately
+    # (first call to a cold replica is startup cost, not config latency)
+    assert router.version >= dep["version"], (router.version, dep)
+    assert latency < 1.0, f"push propagation took {latency:.2f}s"
+    assert handle.remote("x").result(timeout=30) == "v2"
+
+
+def _build_yaml_app(tag="yaml-v1"):
+    return Version.bind(tag)
+
+
+def test_declarative_config_deploy(two_node_cluster):
+    config = {
+        "http_options": {"port": 18240},
+        "applications": [
+            {
+                "name": "yam",
+                "route_prefix": "/yam",
+                "import_path": "tests.test_serve_harden:_build_yaml_app",
+                "args": {"tag": "from-yaml"},
+                "deployments": [{"name": "Version", "num_replicas": 2}],
+            }
+        ],
+    }
+    handles = serve.deploy_from_config(config)
+    assert handles[0].remote("x").result(timeout=30) == "from-yaml"
+    st = serve.status()
+    assert st["yam"]["Version"]["target"] == 2
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        proxies = serve.proxies()
+        if proxies and all("http" in p for p in proxies.values()):
+            break
+        time.sleep(0.5)
+    addr = next(iter(proxies.values()))["http"]
+    assert _http_get(addr, "/yam", payload="q") == "from-yaml"
