@@ -121,6 +121,8 @@ class NodeManager:
         self._spill_mutex = threading.Lock()
         # pid -> [(path, stream_name, offset), ...] for the log monitor
         self._log_files: Dict[int, list] = {}
+        # compiled-DAG channel mirrors this daemon writes into
+        self._dag_channels: Dict[str, object] = {}
 
     # -------------------------------------------------------------- lifecycle
     async def start(self) -> str:
@@ -142,6 +144,9 @@ class NodeManager:
             "free_object": self.h_free_object,
             "free_remote_object": self.h_free_remote_object,
             "get_node_info": self.h_get_node_info,
+            "channel_push": self.h_channel_push,
+            "channel_publish": self.h_channel_publish,
+            "channel_close": self.h_channel_close,
             "ping": lambda conn: "pong",
         }
         self.server = rpc.Server(handlers, name=f"nm-{self.node_id[:8]}")
@@ -324,6 +329,96 @@ class NodeManager:
             if candidates:
                 return max(candidates, key=lambda w: self._proc_rss_bytes(w.pid))
         return None
+
+    # ------------------------------------------- compiled-DAG channels
+    # Cross-node mutable-object push (reference: raylet PushMutableObject,
+    # node_manager.proto:442 + experimental_mutable_object_provider.h:30):
+    # the writer's node manager fans a published version out to reader
+    # nodes, whose node managers write it into a local mirror channel that
+    # local readers mmap. Only refs-to-bytes travel the wire; readers stay
+    # zero-copy against their node-local shm.
+    def _dag_channel(self, path: str, num_readers: int, max_size: int):
+        from ray_tpu.experimental.channel import Channel, node_local_path
+        local = node_local_path(path, self.node_id)
+        ch = self._dag_channels.get(local)
+        if ch is None:
+            import os as _os
+            if _os.path.exists(local):
+                ch = Channel(local)
+            else:
+                ch = Channel(local, max_size=max_size,
+                             num_readers=num_readers, create=True)
+            self._dag_channels[local] = ch
+        return ch
+
+    async def h_channel_push(self, conn, path: str, payload: bytes,
+                             num_readers: int = 1,
+                             max_size: int = 1 << 20,
+                             write_timeout_s: float = 60.0):
+        ch = self._dag_channel(path, num_readers, max_size)
+        loop = asyncio.get_event_loop()
+        # blocking writer-semaphore wait must not stall the daemon loop
+        await loop.run_in_executor(None, ch.write_bytes, payload,
+                                   write_timeout_s)
+        return True
+
+    async def h_channel_publish(self, conn, path: str, payload: bytes,
+                                targets: Dict[str, int],
+                                max_size: int = 1 << 20,
+                                write_timeout_s: float = 60.0):
+        """Fan one published version out to the target nodes' mirrors;
+        ``targets`` maps node id -> that node's local reader count (each
+        mirror is created with its own node's count). All pushes run to
+        completion before any failure is raised, so mirrors don't end up
+        at divergent versions behind a detached coroutine."""
+        async def push(nid, readers):
+            view = self.cluster_view.get(nid)
+            if view is None or not view.get("alive", True):
+                raise rpc.RpcError(f"channel target node {nid[:12]} gone")
+            nm = await self.pool.get(view["address"])
+            await nm.call("channel_push", path=path, payload=payload,
+                          num_readers=readers, max_size=max_size,
+                          write_timeout_s=write_timeout_s,
+                          timeout=write_timeout_s + 60.0)
+
+        results = await asyncio.gather(
+            *(push(n, r) for n, r in targets.items()),
+            return_exceptions=True)
+        errs = [r for r in results if isinstance(r, BaseException)]
+        if errs:
+            raise errs[0]
+        return True
+
+    async def h_channel_close(self, conn, path: str,
+                              targets: Optional[List[str]] = None):
+        """Close the local mirror (readers see ChannelClosed) and
+        propagate to target nodes."""
+        from ray_tpu.experimental.channel import Channel, node_local_path
+        local = node_local_path(path, self.node_id)
+        ch = self._dag_channels.pop(local, None)
+        if ch is None:
+            import os as _os
+            if _os.path.exists(local):
+                try:
+                    ch = Channel(local)
+                except OSError:
+                    ch = None
+        if ch is not None:
+            try:
+                ch.close()
+                ch.destroy()   # drop the shm-backed file too
+            except Exception:
+                pass
+        for nid in targets or []:
+            view = self.cluster_view.get(nid)
+            if view is None:
+                continue
+            try:
+                nm = await self.pool.get(view["address"])
+                await nm.call("channel_close", path=path)
+            except Exception:
+                pass
+        return True
 
     def h_pubsub(self, conn, channel, key, payload):
         if channel == "NODE":

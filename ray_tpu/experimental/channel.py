@@ -145,3 +145,81 @@ class Channel:
 
     def __reduce__(self):
         return (Channel, (self.path,))
+
+
+def node_local_path(path: str, node_id: str) -> str:
+    """Physical file for a logical channel on one node. Logical channel
+    ids are cluster-wide; each node materializes its own local file (on
+    real clusters paths never meet, but single-machine test clusters
+    share /tmp — without the suffix a producer's channel and its pushed
+    mirror would collide on one file)."""
+    return f"{path}.{node_id[:12]}"
+
+
+def open_wait(path: str, timeout_s: float = 30.0) -> Channel:
+    """Open a channel that a remote producer (or the node manager, for
+    pushed mirrors) may not have created yet."""
+    import time
+    deadline = time.monotonic() + timeout_s
+    while True:
+        if os.path.exists(path):
+            try:
+                return Channel(path)
+            except OSError:
+                pass   # mid-creation
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"channel {path} never appeared")
+        time.sleep(0.005)
+
+
+class ChannelWriter:
+    """Writer side of a (possibly cross-node) compiled-DAG edge.
+
+    Local readers share the node-local mutable channel (zero-copy);
+    remote reader nodes receive each published version through the node
+    managers (reference: PushMutableObject fan-out,
+    experimental_mutable_object_provider.h:30). spec:
+    {"path", "max_size", "local_readers": int,
+     "remote": {node_id: reader_count}}.
+    """
+
+    def __init__(self, spec: dict, node_call=None):
+        self.spec = spec
+        self.path = spec["path"]
+        self._node_call = node_call
+        self.local: Optional[Channel] = None
+        if spec.get("local_readers", 0) > 0:
+            local_path = node_local_path(self.path, spec["producer_node"])
+            os.makedirs(os.path.dirname(local_path), exist_ok=True)
+            self.local = Channel(local_path, max_size=spec["max_size"],
+                                 num_readers=spec["local_readers"],
+                                 create=True)
+        self._remote = dict(spec.get("remote") or {})
+
+    def write(self, value: Any, timeout_s: float = 60.0):
+        payload = pickle.dumps(value, protocol=5)
+        if self.local is not None:
+            self.local.write_bytes(payload, timeout_s=timeout_s)
+        if self._remote:
+            if self._node_call is None:
+                from ray_tpu import _get_worker
+                self._node_call = _get_worker().node_call
+            self._node_call(
+                "channel_publish", path=self.path, payload=payload,
+                targets=dict(self._remote),
+                max_size=self.spec["max_size"],
+                write_timeout_s=timeout_s)
+
+    def close(self):
+        if self.local is not None:
+            self.local.close()
+            self.local.destroy()
+        if self._remote:
+            try:
+                if self._node_call is None:
+                    from ray_tpu import _get_worker
+                    self._node_call = _get_worker().node_call
+                self._node_call("channel_close", path=self.path,
+                                targets=list(self._remote))
+            except Exception:
+                pass
