@@ -120,6 +120,9 @@ _flag("memory_usage_threshold", float, 0.95,
       "Fraction of system memory above which the node manager kills the "
       "largest retriable worker (OOM defense).")
 
+_flag("pip_worker_idle_timeout_s", float, 300.0,
+      "Idle eviction for workers dedicated to a pip runtime env (they "
+      "serve exactly one env and would otherwise live forever).")
 _flag("slice_wait_timeout_s", float, 60.0,
       "How long a gang waits for a whole healthy TPU slice before "
       "failing the attempt.")

@@ -409,7 +409,9 @@ class GcsServer:
         except (rpc.RpcError, rpc.ConnectionLost) as e:
             logger.warning("actor %s creation on %s failed: %s",
                            actor_id[:12], target[:12], e)
-            await self._handle_actor_failure(actor_id, f"creation failed: {e}")
+            await self._handle_actor_failure(actor_id,
+                                             f"creation failed: {e}",
+                                             from_scheduler=True)
             return
         row = self.actors.get(actor_id)
         if row is None or row["state"] == DEAD:
@@ -420,9 +422,15 @@ class GcsServer:
         row["worker_id"] = result["worker_id"]
         self._publish("ACTOR", actor_id, _actor_public(row))
 
-    async def _handle_actor_failure(self, actor_id: str, reason: str):
+    async def _handle_actor_failure(self, actor_id: str, reason: str,
+                                    from_scheduler: bool = False):
         row = self.actors.get(actor_id)
         if row is None or row["state"] == DEAD:
+            return
+        if row["state"] == RESTARTING and not from_scheduler:
+            # a restart is already scheduled (kill/death race); the
+            # scheduler's own failure reports must pass through or a
+            # failed re-creation would strand the actor in RESTARTING
             return
         if row["restarts_remaining"] != 0:
             if row["restarts_remaining"] > 0:
@@ -463,28 +471,43 @@ class GcsServer:
     def h_get_all_actors(self, conn):
         return [_actor_public(r) for r in self.actors.values()]
 
-    async def h_report_actor_failure(self, conn, actor_id: str, reason: str):
+    async def h_report_actor_failure(self, conn, actor_id: str,
+                                     reason: str,
+                                     worker_id: Optional[str] = None):
+        row = self.actors.get(actor_id)
+        if (row is not None and worker_id is not None
+                and row.get("worker_id") not in (None, worker_id)):
+            # stale report about a PREVIOUS incarnation's worker (e.g. the
+            # kill_worker death race): the current instance is healthy
+            return True
         await self._handle_actor_failure(actor_id, reason)
         return True
 
     async def h_kill_actor(self, conn, actor_id: str, no_restart: bool = True):
+        """no_restart=False kills the running instance but lets the
+        normal restart path bring it back if max_restarts remain
+        (reference: ray.kill(no_restart=False) semantics,
+        gcs_actor_manager.cc DestroyActor vs RestartActor)."""
         row = self.actors.get(actor_id)
         if row is None:
             return False
-        if no_restart:
-            row["restarts_remaining"] = 0
         node_conn = self.node_conns.get(row.get("node_id"))
-        row["state"] = DEAD
-        row["death_cause"] = "ray_tpu.kill"
-        if row.get("name"):
-            self.named_actors.pop((row["namespace"], row["name"]), None)
-        self._publish("ACTOR", actor_id, _actor_public(row))
+        if no_restart or row["restarts_remaining"] == 0:
+            row["restarts_remaining"] = 0
+            row["state"] = DEAD
+            row["death_cause"] = "ray_tpu.kill"
+            if row.get("name"):
+                self.named_actors.pop((row["namespace"], row["name"]), None)
+            self._publish("ACTOR", actor_id, _actor_public(row))
         if node_conn is not None and not node_conn.closed:
             try:
                 await node_conn.call("kill_worker", worker_id=row.get("worker_id"),
                                      reason="actor killed")
             except (rpc.RpcError, rpc.ConnectionLost):
                 pass
+        # no_restart=False: the worker's death report (incarnation-aware)
+        # drives the restart; restarting here directly would double-
+        # schedule a PENDING_CREATION actor or one whose kill RPC failed
         return True
 
     # ---------------------------------------------------------- task events

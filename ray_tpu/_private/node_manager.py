@@ -40,7 +40,8 @@ logger = logging.getLogger(__name__)
 
 class WorkerProc:
     __slots__ = ("worker_id", "address", "pid", "conn", "proc", "state",
-                 "actor_id", "lease_id", "registered")
+                 "actor_id", "lease_id", "registered", "env_hash",
+                 "idle_since")
 
     def __init__(self, proc=None):
         self.worker_id = None
@@ -52,6 +53,11 @@ class WorkerProc:
         self.actor_id: Optional[str] = None
         self.lease_id: Optional[str] = None
         self.registered = asyncio.Event()
+        # runtime-env pool key: once a worker materializes a pip env it
+        # serves ONLY that env (reference: per-env worker pools,
+        # worker_pool.h:174)
+        self.env_hash: Optional[str] = None
+        self.idle_since: float = 0.0
 
 
 class NodeManager:
@@ -257,6 +263,18 @@ class NodeManager:
                 if w.proc is not None and w.proc.poll() is not None \
                         and w.state != "dead":
                     await self._on_worker_death(w, f"exit code {w.proc.returncode}")
+            # env-tagged workers serve exactly one pip env: evict them
+            # after sitting idle so cycling through many envs can't pin
+            # a process per env forever
+            now = time.monotonic()
+            for w in list(self._idle):
+                if (w.state == "idle" and w.env_hash is not None
+                        and w.idle_since
+                        and now - w.idle_since
+                        > cfg.pip_worker_idle_timeout_s):
+                    self._idle.remove(w)
+                    await self._on_worker_death(
+                        w, "idle pip-env worker evicted")
 
     # ------------------------------------------------------ memory monitor
     @staticmethod
@@ -549,17 +567,32 @@ class NodeManager:
         if prev_state == "actor" and w.actor_id is not None:
             try:
                 await self.gcs.call("report_actor_failure", actor_id=w.actor_id,
-                                    reason=f"worker died: {reason}")
+                                    reason=f"worker died: {reason}",
+                                    worker_id=w.worker_id)
             except (rpc.RpcError, rpc.ConnectionLost):
                 pass
 
-    async def _obtain_worker(self, timeout: float = 60.0) -> WorkerProc:
-        """Pop an idle worker, spawning a new process if needed."""
+    async def _obtain_worker(self, timeout: float = 60.0,
+                             env_hash: Optional[str] = None) -> WorkerProc:
+        """Pop an idle worker compatible with the requested runtime env
+        (matching env, or a fresh untagged worker that becomes tagged),
+        spawning a new process if none fits."""
         while True:
-            while self._idle:
-                w = self._idle.pop()
-                if w.state == "idle":
-                    return w
+            picked = fallback = None
+            for w in list(self._idle):
+                if w.state != "idle":
+                    self._idle.remove(w)
+                    continue
+                if w.env_hash == env_hash:
+                    picked = w          # exact env match wins
+                    break
+                if w.env_hash is None and fallback is None:
+                    fallback = w        # untagged: taggable if no match
+            picked = picked or fallback
+            if picked is not None:
+                self._idle.remove(picked)
+                picked.env_hash = env_hash or picked.env_hash
+                return picked
             w = self._spawn_worker()
             # temporary key until registration rebinds by worker_id
             self.workers[f"spawn-{w.proc.pid}"] = w
@@ -571,6 +604,7 @@ class NodeManager:
             self.workers.pop(f"spawn-{w.proc.pid}", None)
             if w.state == "idle" and w in self._idle:
                 self._idle.remove(w)
+                w.env_hash = env_hash
                 return w
             # else someone else grabbed it; loop
 
@@ -590,6 +624,7 @@ class NodeManager:
 
     async def h_request_lease(self, conn, resources: Dict[str, float],
                               scheduling: Dict, worker_id: str,
+                              env_hash: Optional[str] = None,
                               spilled: bool = False):
         """Grant a worker lease, queue, or redirect (spillback). A request
         that has already been redirected once is grant-or-queue here — never
@@ -629,7 +664,7 @@ class NodeManager:
                 scheduling_sub(pool_avail, resources)
                 chips = self._allocate_chips(resources)
                 try:
-                    w = await self._obtain_worker()
+                    w = await self._obtain_worker(env_hash=env_hash)
                 except RuntimeError as e:
                     self._free_chips.extend(chips)
                     scheduling_addback(pool_avail, resources)
@@ -747,6 +782,7 @@ class NodeManager:
         w.lease_id = None
         if not worker_dead and w.state == "leased":
             w.state = "idle"
+            w.idle_since = time.monotonic()
             self._idle.append(w)
         self._wake_lease_waiters()
 
@@ -812,6 +848,17 @@ class NodeManager:
         await asyncio.sleep(0.1)
         self._kill_proc(w)
         self.workers.pop(worker_id, None)
+        if w.actor_id is not None:
+            # this handler removes the worker before the reaper can see
+            # it die, so the actor-failure report (which drives restart
+            # when max_restarts remain) must come from here
+            try:
+                await self.gcs.call("report_actor_failure",
+                                    actor_id=w.actor_id,
+                                    reason=f"worker killed: {reason}",
+                                    worker_id=w.worker_id)
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass
         return True
 
     # --------------------------------------------------------------- bundles

@@ -64,7 +64,7 @@ def _encode_arg(arg, ref_hook) -> list:
 
 class PendingTask:
     __slots__ = ("spec", "return_ids", "retries_left", "arg_refs", "done",
-                 "cancelled", "current_worker")
+                 "cancelled", "current_worker", "seq")
 
     def __init__(self, spec, return_ids, retries_left, arg_refs):
         self.spec = spec
@@ -74,6 +74,7 @@ class PendingTask:
         self.done = False
         self.cancelled = False
         self.current_worker = None
+        self.seq = 0          # per-actor submission order (actor tasks)
 
 
 class Lease:
@@ -97,7 +98,15 @@ class ActorHandleState:
         self.address: Optional[str] = None
         self.ready = asyncio.Event()
         self.death_cause: Optional[str] = None
-        self.queue: "asyncio.Queue[PendingTask]" = asyncio.Queue()
+        # submission-ordered pipeline: fresh sends carry a sequence
+        # number; retries of in-flight calls that died with a connection
+        # re-enter by seq AHEAD of later submissions (the reference keeps
+        # the same guarantee with explicit seq-nos,
+        # sequential_actor_submit_queue.cc)
+        self.pending = __import__("collections").deque()
+        self.retry: list = []          # heap of (seq, PendingTask)
+        self.work = asyncio.Event()
+        self.seq_counter = 0
         self.sender: Optional[asyncio.Task] = None
 
 
@@ -989,12 +998,13 @@ class CoreWorker:
                     pass   # the executor surfaces the fetch error
 
     def _enqueue_task(self, pt: PendingTask, resources, scheduling):
-        sig = self._lease_sig(resources, scheduling)
+        env_hash = self._runtime_env_hash(pt.spec.get("runtime_env"))
+        sig = self._lease_sig(resources, scheduling, env_hash)
         st = self._sig_queues.get(sig)
         if st is None:
             st = {"queue": __import__("collections").deque(),
                   "dispatchers": 0, "busy": 0, "resources": resources,
-                  "scheduling": scheduling}
+                  "scheduling": scheduling, "env_hash": env_hash}
             self._sig_queues[sig] = st
         st["queue"].append(pt)
         self._maybe_spawn_dispatcher(sig, st)
@@ -1018,8 +1028,9 @@ class CoreWorker:
         try:
             while st["queue"]:
                 try:
-                    lease = await self._acquire_lease(st["resources"],
-                                                      st["scheduling"])
+                    lease = await self._acquire_lease(
+                        st["resources"], st["scheduling"],
+                        st.get("env_hash"))
                 except Exception as e:
                     if st["queue"]:
                         pt = st["queue"].popleft()
@@ -1156,12 +1167,30 @@ class CoreWorker:
         return True
 
     # ---------------------------------------------------------------- leases
-    def _lease_sig(self, resources: Dict, scheduling: Dict) -> tuple:
+    def _lease_sig(self, resources: Dict, scheduling: Dict,
+                   env_hash: Optional[str] = None) -> tuple:
         return (tuple(sorted(resources.items())),
-                tuple(sorted((k, str(v)) for k, v in scheduling.items())))
+                tuple(sorted((k, str(v)) for k, v in scheduling.items())),
+                env_hash)
 
-    async def _acquire_lease(self, resources: Dict, scheduling: Dict) -> Lease:
-        sig = self._lease_sig(resources, scheduling)
+    @staticmethod
+    def _runtime_env_hash(renv) -> Optional[str]:
+        """Workers are pooled per runtime env (reference: WorkerPool keyed
+        by runtime-env hash, worker_pool.h:174): a pip env permanently
+        shapes a worker's sys.path, so such workers are never handed to
+        tasks of other envs."""
+        if not renv or not renv.get("pip"):
+            return None
+        import hashlib
+        pip = renv.get("pip")
+        if isinstance(pip, dict):
+            pip = pip.get("packages") or []
+        return hashlib.sha1("\n".join(sorted(map(str, pip)))
+                            .encode()).hexdigest()[:16]
+
+    async def _acquire_lease(self, resources: Dict, scheduling: Dict,
+                             env_hash: Optional[str] = None) -> Lease:
+        sig = self._lease_sig(resources, scheduling, env_hash)
         pool = self._idle_leases.get(sig)
         while pool:
             lease = pool.pop()
@@ -1174,7 +1203,7 @@ class CoreWorker:
                 resp = await target_conn.call(
                     "request_lease", resources=resources,
                     scheduling=scheduling, worker_id=self.worker_id,
-                    spilled=addr_chain > 0)
+                    env_hash=env_hash, spilled=addr_chain > 0)
             except (rpc.RpcError, rpc.ConnectionLost) as e:
                 # transient control-plane failure (or injected chaos):
                 # back off and retry (reference: retryable lease clients,
@@ -1384,18 +1413,35 @@ class CoreWorker:
         if st.sender is None:
             st.sender = asyncio.ensure_future(
                 self._actor_sender(actor_id, st))
-        st.queue.put_nowait(pt)
+        pt.seq = st.seq_counter
+        st.seq_counter += 1
+        st.pending.append(pt)
+        st.work.set()
 
     async def _actor_sender(self, actor_id: str, st: ActorHandleState):
         """Per-actor ordered submission pipeline: sends are serialized (so
         method calls start in submission order, the reference's
         SequentialActorSubmitQueue guarantee); responses are awaited
-        concurrently so calls pipeline."""
+        concurrently so calls pipeline. Retries of calls that died with a
+        connection re-enter by sequence number ahead of later fresh
+        submissions."""
+        import heapq
         while True:
-            pt = await st.queue.get()
+            while not st.retry and not st.pending:
+                st.work.clear()
+                await st.work.wait()
+            if st.retry:
+                _, pt = heapq.heappop(st.retry)
+            else:
+                pt = st.pending.popleft()
             await self._resolve_dependencies(pt.arg_refs)
             while True:
                 await st.ready.wait()
+                if st.retry and st.retry[0][0] < pt.seq:
+                    # while we were blocked, earlier in-flight calls
+                    # failed into the retry heap: they must go first
+                    heapq.heappush(st.retry, (pt.seq, pt))
+                    _, pt = heapq.heappop(st.retry)
                 if st.state == "DEAD":
                     self._fail_task(pt, ActorDiedError(
                         f"actor {actor_id[:12]} is dead: {st.death_cause}"))
@@ -1437,7 +1483,13 @@ class CoreWorker:
             if pt.retries_left != 0:
                 if pt.retries_left > 0:
                     pt.retries_left -= 1
-                st.queue.put_nowait(pt)   # re-run after restart
+                # re-run after restart IN SUBMISSION ORDER: a dying
+                # connection fails a pipeline of in-flight calls in
+                # arbitrary completion order; the seq heap restores it
+                # and jumps ahead of later fresh submissions
+                import heapq
+                heapq.heappush(st.retry, (pt.seq, pt))
+                st.work.set()
                 return
             self._fail_task(pt, ActorDiedError(
                 f"actor {actor_id[:12]} died mid-call: {e}"))
@@ -1601,11 +1653,51 @@ class CoreWorker:
             shutil.rmtree(tmp, ignore_errors=True)   # raced another worker
         return mod_root
 
+    _PIP_ENV_ROOT = "/tmp/raytpu/runtime_envs"
+
+    def _ensure_pip_env(self, packages: List[str]) -> str:
+        """Materialize a cached package dir for a pip runtime env and
+        return it (reference: _private/runtime_env/pip.py — hashed-spec
+        isolated installs; here `pip install --target` into a per-spec
+        dir layered onto sys.path, which composes with the base install
+        the way the reference's --system-site-packages venv does and
+        works when the interpreter itself lives in a venv). A file lock
+        serializes concurrent workers; the dir is only marked ready once
+        the install succeeded."""
+        import hashlib
+        import subprocess
+        import sys
+
+        key = hashlib.sha1("\n".join(sorted(packages)).encode()).hexdigest()
+        env_dir = os.path.join(self._PIP_ENV_ROOT, f"pip_{key[:16]}")
+        ready = os.path.join(env_dir, ".ready")
+        site = os.path.join(env_dir, "pkgs")
+        if os.path.exists(ready):
+            return site
+        os.makedirs(self._PIP_ENV_ROOT, exist_ok=True)
+        import fcntl
+        with open(os.path.join(self._PIP_ENV_ROOT,
+                               f".lock_{key[:16]}"), "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if os.path.exists(ready):
+                return site
+            proc = subprocess.run(
+                [sys.executable, "-m", "pip", "install",
+                 "--no-build-isolation", "--target", site, *packages],
+                capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"pip runtime env install failed: {proc.stderr[-2000:]}")
+            with open(ready, "w") as f:
+                f.write("ok")
+        return site
+
     def _apply_runtime_env(self, spec: Dict):
-        """env_vars / working_dir / py_modules for this execution
-        (reference: python/ray/runtime_env/runtime_env.py:152; conda/pip/
-        container materialization is a later round). Runs on the executor
-        thread, so blocking KV fetches are safe."""
+        """env_vars / working_dir / py_modules / pip for this execution
+        (reference: python/ray/runtime_env/runtime_env.py:152; conda and
+        containers are out of scope for a TPU-host runtime). Runs on the
+        executor thread, so blocking KV fetches and pip installs are
+        safe."""
         import sys
         renv = spec.get("runtime_env")
         if not renv:
@@ -1630,6 +1722,16 @@ class CoreWorker:
             parent = os.path.dirname(root)
             sys.path.insert(0, parent)
             added_paths.append(parent)
+        pip_spec = renv.get("pip")
+        if pip_spec:
+            if isinstance(pip_spec, dict):
+                pip_spec = pip_spec.get("packages") or []
+            site = self._ensure_pip_env([str(x) for x in pip_spec])
+            if site not in sys.path:
+                sys.path.insert(0, site)
+            # NOT added_paths: the pip env is permanent for this worker's
+            # life — the node manager only ever reuses it for the same
+            # env hash (reference: per-env worker pools)
         return (saved, saved_cwd, added_paths)
 
     def _restore_runtime_env(self, token):

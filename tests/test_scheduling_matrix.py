@@ -1,0 +1,104 @@
+"""Scheduler correctness matrix (reference:
+src/ray/raylet/cluster_task_manager_test.cc — infeasible tasks become
+feasible on node arrival, infeasible requests eventually fail, remote-only
+resources spill back, draining nodes are avoided).
+"""
+
+import os
+import time
+from contextlib import contextmanager
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@contextmanager
+def _grace(seconds):
+    """Cluster daemons inherit the env override at spawn."""
+    os.environ["RAY_TPU_INFEASIBLE_GRACE_S"] = str(seconds)
+    try:
+        yield
+    finally:
+        os.environ.pop("RAY_TPU_INFEASIBLE_GRACE_S", None)
+
+
+def test_infeasible_becomes_feasible_on_node_add():
+    with _grace(120):
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 2})
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote(resources={"accel": 1.0}, num_cpus=0.5)
+        def where():
+            return ray_tpu.get_runtime_context()["node_id"]
+
+        ref = where.remote()
+        ready, rest = ray_tpu.wait([ref], timeout=2.0)
+        assert not ready            # no node has "accel" yet: stays queued
+        node = cluster.add_node(num_cpus=2, resources={"accel": 2.0})
+        assert ray_tpu.get(ref, timeout=90) == node.node_id
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_infeasible_forever_fails_after_grace():
+    with _grace(2.0):
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 2})
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote(resources={"never": 1.0}, max_retries=0)
+        def impossible():
+            return 1
+
+        with pytest.raises(Exception) as ei:
+            ray_tpu.get(impossible.remote(), timeout=60)
+        assert "unschedulable" in str(ei.value)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_remote_only_resource_spills_back():
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    n2 = cluster.add_node(num_cpus=2, resources={"special": 1.0})
+    ray_tpu.init(address=cluster.address)
+    cluster.wait_for_nodes()
+    try:
+        @ray_tpu.remote(resources={"special": 0.5}, num_cpus=0.5)
+        def where():
+            return ray_tpu.get_runtime_context()["node_id"]
+
+        assert ray_tpu.get(where.remote(), timeout=60) == n2.node_id
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_draining_node_receives_no_new_work():
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    n2 = cluster.add_node(num_cpus=4)
+    ray_tpu.init(address=cluster.address)
+    cluster.wait_for_nodes()
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def where():
+            time.sleep(0.1)
+            return ray_tpu.get_runtime_context()["node_id"]
+
+        # sanity: with 4 free CPUs, n2 takes work
+        spots = set(ray_tpu.get([where.remote() for _ in range(6)],
+                                timeout=60))
+        assert n2.node_id in spots
+        ray_tpu._get_worker().gcs_call("drain_node", node_id=n2.node_id)
+        time.sleep(1.5)   # view refresh
+        spots = set(ray_tpu.get(
+            [where.options(scheduling_strategy="SPREAD").remote()
+             for _ in range(6)], timeout=90))
+        assert n2.node_id not in spots, spots
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
