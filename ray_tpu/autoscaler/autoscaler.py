@@ -57,11 +57,31 @@ class Autoscaler:
         actions = {"launched": [], "terminated": []}
 
         # reconcile in-flight launches: once a launched node registers it
-        # counts through the real node table instead
+        # counts through the real node table instead. Slice providers
+        # (GCE queued resources) name a whole slice; its hosts register
+        # with their own node ids but advertise tpu-slice:{provider_id},
+        # which is how provider ids map back to cluster nodes.
         alive_ids = {n["node_id"] for n in alive}
+        slice_of = {}                    # provider_id -> [cluster node]
+        for n in alive:
+            for res in n.get("total", {}):
+                if res.startswith("tpu-slice:"):
+                    slice_of.setdefault(res[len("tpu-slice:"):],
+                                        []).append(n)
         for nid in list(self._inflight):
-            if nid in alive_ids:
+            if nid in alive_ids or nid in slice_of:
                 del self._inflight[nid]
+        # drop launches the provider declared dead (FAILED queued
+        # resources etc.) so the demand can relaunch
+        try:
+            live_provider = set(self.provider.non_terminated_nodes())
+        except Exception:
+            live_provider = None
+        if live_provider is not None:
+            for nid in list(self._inflight):
+                if nid not in live_provider:
+                    self._inflight.pop(nid, None)
+                    self._launched.pop(nid, None)
 
         # --- scale up: binpack unmet demand onto live + in-flight +
         # hypothetical new nodes (one launch can absorb many requests)
@@ -103,7 +123,16 @@ class Autoscaler:
         now = time.monotonic()
         for n in alive:
             nid = n["node_id"]
-            if nid not in self._launched or nid in self.protected:
+            # slice hosts terminate at slice granularity via provider id
+            provider_id = nid
+            if nid not in self._launched:
+                provider_id = next(
+                    (pid for pid, members in slice_of.items()
+                     if pid in self._launched
+                     and any(m["node_id"] == nid for m in members)), None)
+                if provider_id is None:
+                    continue
+            if nid in self.protected:
                 continue
             busy = any(n["available"].get(k, 0) < n["total"].get(k, 0) - 1e-9
                        for k in n["total"]
@@ -113,10 +142,23 @@ class Autoscaler:
                 continue
             first_idle = self._idle_since.setdefault(nid, now)
             if now - first_idle > self.config.idle_timeout_s:
-                self.provider.terminate_node(nid)
-                self._launched.pop(nid, None)
+                # a slice only terminates when EVERY member host is idle
+                if provider_id != nid:
+                    members = slice_of.get(provider_id, [])
+                    if not all(
+                            now - self._idle_since.get(m["node_id"], now)
+                            > self.config.idle_timeout_s
+                            for m in members):
+                        continue
+                try:
+                    self.provider.terminate_node(provider_id)
+                except Exception:
+                    logger.exception("terminate %s failed; will retry",
+                                     provider_id)
+                    continue
+                self._launched.pop(provider_id, None)
                 self._idle_since.pop(nid, None)
-                actions["terminated"].append(nid)
+                actions["terminated"].append(provider_id)
         return actions
 
     def run(self, stop_event=None):

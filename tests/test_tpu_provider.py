@@ -1,0 +1,107 @@
+"""GCE TPU queued-resources provider state machine, driven through a fake
+transport (reference: python/ray/autoscaler/_private/gcp/ node provider +
+v2 instance manager reconciliation; no credentials or egress needed).
+"""
+
+import pytest
+
+from ray_tpu.autoscaler.node_provider import GcpTpuNodeProvider
+
+
+class FakeTpuApi:
+    """Simulates the Cloud TPU queued-resources API surface."""
+
+    def __init__(self):
+        self.resources = {}      # name -> state
+        self.calls = []
+
+    def __call__(self, method, path, body=None):
+        self.calls.append((method, path))
+        if method == "POST":
+            name = path.split("queuedResourceId=")[1]
+            self.resources[name] = "WAITING_FOR_RESOURCES"
+            assert body["tpu"]["nodeSpec"][0]["node"]["acceleratorType"]
+            assert "startup-script" in \
+                body["tpu"]["nodeSpec"][0]["node"]["metadata"]
+            return {"name": name}
+        if method == "GET":
+            # LIST endpoint
+            return {"queuedResources": [
+                {"name": f"{path}/{n}", "state": {"state": st}}
+                for n, st in self.resources.items()]}
+        if method == "DELETE":
+            name = path.rsplit("/", 1)[1].split("?")[0]
+            self.resources.pop(name, None)
+            return {}
+        raise AssertionError(method)
+
+
+@pytest.fixture
+def provider():
+    api = FakeTpuApi()
+    p = GcpTpuNodeProvider("proj", "us-central2-b", "10.0.0.1:6379",
+                           accelerator_type="v4-32", api=api)
+    return p, api
+
+
+def test_queued_resource_lifecycle(provider):
+    p, api = provider
+    name = p.create_node("tpu_slice", {"TPU": 16}, {"team": "ml"})
+    assert name in api.resources
+    # queued: counted as in-flight capacity, reported as pending
+    assert p.non_terminated_nodes() == [name]
+    assert p.pending_nodes() == [name]
+
+    api.resources[name] = "PROVISIONING"
+    p.non_terminated_nodes()
+    assert p.pending_nodes() == [name]
+
+    api.resources[name] = "ACTIVE"
+    p.non_terminated_nodes()
+    assert p.pending_nodes() == []                 # slice is up
+    assert p.non_terminated_nodes() == [name]
+
+    p.terminate_node(name)
+    assert p.non_terminated_nodes() == []
+    assert name not in api.resources
+
+
+def test_failed_queued_resource_drops_out(provider):
+    p, api = provider
+    name = p.create_node("tpu_slice", {"TPU": 16}, {})
+    api.resources[name] = "FAILED"
+    assert p.non_terminated_nodes() == []          # pruned
+    # a fresh demand pass may create a new request
+    name2 = p.create_node("tpu_slice", {"TPU": 16}, {})
+    assert name2 != name
+
+
+def test_api_outage_keeps_last_known_state(provider):
+    p, api = provider
+    name = p.create_node("tpu_slice", {"TPU": 16}, {})
+
+    def broken(method, path, body=None):
+        raise OSError("no egress")
+
+    p.api = broken
+    # can't verify -> keep the node rather than double-launching
+    assert p.non_terminated_nodes() == [name]
+    # ...and a failed DELETE must not forget a live billing slice
+    with pytest.raises(OSError):
+        p.terminate_node(name)
+    p.api = api
+    assert p.non_terminated_nodes() == [name]
+
+
+def test_out_of_band_deletion_marks_dead(provider):
+    p, api = provider
+    name = p.create_node("tpu_slice", {"TPU": 16}, {})
+    del api.resources[name]          # deleted via gcloud
+    assert p.non_terminated_nodes() == []
+
+
+def test_node_ids_are_gce_safe(provider):
+    p, api = provider
+    name = p.create_node("Tpu_Slice.v4", {"TPU": 16}, {"Team": "ML_infra"})
+    assert name == name.lower()
+    assert all(c.isalnum() or c == "-" for c in name)
