@@ -37,11 +37,19 @@ class DashboardServer:
         r.add_get("/api/version", self._version)
         r.add_get("/metrics", self._metrics)
         r.add_get("/healthz", self._healthz)
+        r.add_get("/", self._index)
         runner = web.AppRunner(app)
         await runner.setup()
         site = web.TCPSite(runner, "0.0.0.0", self.port)
         await site.start()
         self._ready = True
+
+    async def _index(self, request):
+        """Single-page UI over the JSON API (reference: the dashboard
+        frontend, python/ray/dashboard/ — a full React app there; a
+        dependency-free live table view here)."""
+        from aiohttp import web
+        return web.Response(text=_INDEX_HTML, content_type="text/html")
 
     def ready(self):
         return self._ready
@@ -155,3 +163,48 @@ def start_dashboard(port: int = 8265):
                         num_cpus=0.1).remote(port)
         ray_tpu.get(h.ready.remote(), timeout=60)
         return h
+
+
+_INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title><style>
+body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
+h1{font-size:1.2rem} h2{font-size:1rem;margin:1.2rem 0 .4rem}
+table{border-collapse:collapse;width:100%;background:#fff;font-size:.85rem}
+th,td{border:1px solid #ddd;padding:.3rem .5rem;text-align:left}
+th{background:#f0f0f0} .ALIVE{color:#0a7d34} .DEAD,.FAILED{color:#c0322f}
+#err{color:#c0322f}
+</style></head><body>
+<h1>ray_tpu dashboard</h1><div id="err"></div>
+<h2>Cluster</h2><div id="cluster"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<h2>Recent tasks</h2><table id="tasks"></table>
+<script>
+function esc(s){return s.replace(/[&<>"']/g,
+ m=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[m]))}
+function cell(v){if(v===null||v===undefined)return"";
+ if(typeof v==="object")return JSON.stringify(v);return String(v)}
+function render(id, rows, cols){const t=document.getElementById(id);
+ if(!rows||!rows.length){t.innerHTML="<tr><td>none</td></tr>";return}
+ cols=cols||Object.keys(rows[0]);
+ t.innerHTML="<tr>"+cols.map(c=>"<th>"+esc(c)+"</th>").join("")+"</tr>"+
+  rows.map(r=>"<tr>"+cols.map(c=>{const v=cell(r[c]);
+   let cls="";
+   if(c==="state"||c==="status"){cls=" class='"+esc(v).replace(/[^A-Za-z]/g,"")+"'"}
+   if(c==="alive"){cls=v==="true"?" class='ALIVE'":" class='DEAD'"}
+   return "<td"+cls+">"+esc(v)+"</td>"}).join("")+"</tr>").join("")}
+async function refresh(){try{
+ const [cl,no,ac,jo,ta]=await Promise.all(
+  ["cluster_status","nodes","actors","jobs","tasks"].map(
+   p=>fetch("/api/"+p).then(r=>r.json())));
+ document.getElementById("cluster").textContent=JSON.stringify(cl);
+ render("nodes",no,["node_id","alive","node_ip","total","available"]);
+ render("actors",ac,["actor_id","state","name","node_id","num_restarts"]);
+ render("jobs",jo);
+ render("tasks",(ta||[]).slice(0,50),
+        ["task_id","name","state","type","node_id"]);
+ document.getElementById("err").textContent="";
+}catch(e){document.getElementById("err").textContent="refresh failed: "+e}}
+refresh();setInterval(refresh,2000);
+</script></body></html>"""

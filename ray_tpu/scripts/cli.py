@@ -101,6 +101,36 @@ def cmd_list(args):
     print(json.dumps(fn(), indent=2, default=str))
 
 
+def cmd_timeline(args):
+    """Export the task timeline as a chrome://tracing JSON (reference:
+    `ray timeline`)."""
+    import ray_tpu
+    ray_tpu.init(address=_load_address(args))
+    out = args.output or "ray-tpu-timeline.json"
+    ray_tpu.timeline(out)
+    print(f"wrote {out} (open in chrome://tracing or Perfetto)")
+
+
+def cmd_memory(args):
+    """Object-store + ownership dump for this node/process (reference:
+    `ray memory` — store contents merged with the core worker's refcount
+    table)."""
+    import ray_tpu
+    from ray_tpu.util import state
+    ray_tpu.init(address=_load_address(args))
+    rows = state.list_objects()
+    total = 0
+    print(f"{'OBJECT ID':<34} {'KIND':<10} {'SIZE':>10} "
+          f"{'PINS':>5} {'BORROWERS':>9}  LOCATION")
+    for r in rows:
+        size = r.get("size_bytes") or 0
+        total += size
+        print(f"{r.get('object_id', '?'):<34} {r.get('kind', '?'):<10} "
+              f"{size:>10} {r.get('task_pins', 0):>5} "
+              f"{r.get('borrowers', 0):>9}  {r.get('location') or '-'}")
+    print(f"-- {len(rows)} entries, {total / 1e6:.1f} MB in local shm")
+
+
 def cmd_submit(args):
     import ray_tpu
     from ray_tpu.job_submission import JobSubmissionClient
@@ -141,6 +171,15 @@ def main(argv=None):
                                      "placement-groups"])
     pl.add_argument("--address", default=None)
     pl.set_defaults(fn=cmd_list)
+
+    pt = sub.add_parser("timeline")
+    pt.add_argument("--address", default=None)
+    pt.add_argument("--output", "-o", default=None)
+    pt.set_defaults(fn=cmd_timeline)
+
+    pm = sub.add_parser("memory")
+    pm.add_argument("--address", default=None)
+    pm.set_defaults(fn=cmd_memory)
 
     pj = sub.add_parser("submit")
     pj.add_argument("--address", default=None)

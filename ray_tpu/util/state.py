@@ -38,14 +38,34 @@ def list_named_actors(namespace: Optional[str] = None) -> List[Dict]:
 
 
 def list_objects(limit: int = 1000) -> List[Dict]:
-    """Objects in this node's shared-memory store."""
+    """Objects in this node's shared-memory store plus this process's
+    ownership entries (reference: `ray memory` merges the store view with
+    per-worker refcount tables)."""
     core = _w().core
-    if core.store is None:
-        return []
     out = []
-    for oid in core.store.list_objects(max_n=limit):
-        out.append({"object_id": oid.hex(), "node_id": core.node_id})
-    return out
+    seen = set()
+    if core.store is not None:
+        for oid in core.store.list_objects(max_n=limit):
+            size = 0
+            buf = core.store.get(oid)
+            if buf is not None:
+                size = len(buf.data) + len(buf.metadata or b"")
+                buf.close()
+            seen.add(oid)
+            out.append({"object_id": oid.hex(), "node_id": core.node_id,
+                        "size_bytes": size, "kind": "shm"})
+    for oid, entry in list(core.owned.items())[:limit]:
+        row = {
+            "object_id": oid.hex(), "node_id": core.node_id,
+            "kind": "owned", "complete": bool(entry.get("complete")),
+            "location": entry.get("location"),
+            "borrowers": len(entry.get("borrowers") or ()),
+            "task_pins": entry.get("submitted", 0),
+        }
+        if oid in seen:
+            row["kind"] = "owned+shm"
+        out.append(row)
+    return out[:limit * 2]
 
 
 def summarize_tasks() -> Dict[str, Dict[str, int]]:
