@@ -25,6 +25,20 @@ def main():
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "WARNING"),
         format=f"[worker {os.getpid()}] %(levelname)s %(message)s")
 
+    # Worker-side jax platform pin. Some environments register device
+    # plugins through sitecustomize and override the JAX_PLATFORMS env
+    # var with jax.config at interpreter start; tests (and CPU-only
+    # deployments) need workers pinned to a platform the same way the
+    # driver pins itself with jax.config.update.
+    plat = os.environ.get("RAY_TPU_JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "jax platform pin %r failed", plat)
+
     from ray_tpu._private import worker as worker_mod
     from ray_tpu._private.worker import CoreWorker, Worker
 

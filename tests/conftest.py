@@ -9,6 +9,10 @@ config route still wins."""
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# worker processes pin themselves through worker_main (the axon
+# sitecustomize overrides the env var with jax.config at startup, so the
+# env alone doesn't stick in children)
+os.environ["RAY_TPU_JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
