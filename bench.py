@@ -199,36 +199,50 @@ def bench_train_step_mfu():
     from ray_tpu.parallel import MeshConfig, make_mesh
     from ray_tpu.parallel.train_step import make_train_fns
 
-    name, B, L = "llama-125m", 16, 1024
-    cfg_m = MODEL_REGISTRY[name]
-    model = TransformerLM(cfg_m)
-    mesh = make_mesh(MeshConfig(data=1, fsdp=1), devices=devs[:1])
-    init_fn, step_fn, _ = make_train_fns(model, optax.adamw(3e-4), mesh,
-                                         batch_shape=(B, L + 1))
-    state = init_fn(jax.random.PRNGKey(0))
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, L + 1), 0,
-                                cfg_m.vocab_size)
-    for _ in range(3):
-        state, m = step_fn(state, tokens)
-    float(m["loss"])                       # full sync
-    steps = 20
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, m = step_fn(state, tokens)
-    float(m["loss"])
-    dt = (time.perf_counter() - t0) / steps
+    def run_config(name, B, L):
+        cfg_m = MODEL_REGISTRY[name]
+        model = TransformerLM(cfg_m)
+        mesh = make_mesh(MeshConfig(data=1, fsdp=1), devices=devs[:1])
+        init_fn, step_fn, _ = make_train_fns(model, optax.adamw(3e-4),
+                                             mesh, batch_shape=(B, L + 1))
+        state = init_fn(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (B, L + 1), 0,
+                                    cfg_m.vocab_size)
+        for _ in range(3):
+            state, m = step_fn(state, tokens)
+        float(m["loss"])                       # full sync
+        steps = 20
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step_fn(state, tokens)
+        float(m["loss"])
+        dt = (time.perf_counter() - t0) / steps
 
-    n_layer = cfg_m.n_layers * (
-        cfg_m.d_model * cfg_m.d_model * 2
-        + cfg_m.d_model * (cfg_m.n_kv_heads * cfg_m.head_dim) * 2
-        + 3 * cfg_m.d_model * cfg_m.d_ff)
-    n_unembed = cfg_m.d_model * cfg_m.vocab_size
-    flops = 6 * (n_layer + n_unembed) * B * L \
-        + cfg_m.n_layers * 4 * B * L * L * cfg_m.d_model * 3 / 2
-    mfu = flops / dt / V5E_PEAK_FLOPS
-    log(f"train_step: {name} B={B} L={L} {dt*1e3:.1f} ms/step "
-        f"{B*L/dt:.0f} tok/s MFU={mfu*100:.1f}%")
-    return {"mfu": mfu, "tokens_per_s": B * L / dt, "ms_per_step": dt * 1e3}
+        n_layer = cfg_m.n_layers * (
+            cfg_m.d_model * cfg_m.d_model * 2
+            + cfg_m.d_model * (cfg_m.n_kv_heads * cfg_m.head_dim) * 2
+            + 3 * cfg_m.d_model * cfg_m.d_ff)
+        n_unembed = cfg_m.d_model * cfg_m.vocab_size
+        flops = 6 * (n_layer + n_unembed) * B * L \
+            + cfg_m.n_layers * 4 * B * L * L * cfg_m.d_model * 3 / 2
+        mfu = flops / dt / V5E_PEAK_FLOPS
+        log(f"train_step: {name} B={B} L={L} {dt*1e3:.1f} ms/step "
+            f"{B*L/dt:.0f} tok/s MFU={mfu*100:.1f}%")
+        return {"mfu": mfu, "tokens_per_s": B * L / dt,
+                "ms_per_step": dt * 1e3, "model": name,
+                "batch": B, "seq_len": L}
+
+    # MFU ladder: larger models use the MXU better; fall back if a
+    # config doesn't fit/compile on this chip
+    last_err = None
+    for name, B, L in [("llama-350m", 16, 1024), ("llama-125m", 16, 1024)]:
+        try:
+            return run_config(name, B, L)
+        except Exception as e:       # OOM / compile failure on this chip
+            last_err = e
+            log(f"MFU config {name} B={B} failed: {e}")
+    log(f"all MFU configs failed: {last_err}")
+    return None
 
 
 def main():

@@ -8,6 +8,9 @@ MODEL_REGISTRY = {
     "llama-125m": TransformerConfig(
         vocab_size=32000, d_model=768, n_layers=12, n_heads=12, n_kv_heads=12,
         d_ff=2048, max_seq_len=2048),
+    "llama-350m": TransformerConfig(
+        vocab_size=32000, d_model=1024, n_layers=24, n_heads=16,
+        n_kv_heads=16, d_ff=2816, max_seq_len=2048),
     "llama-1b": TransformerConfig(
         vocab_size=32000, d_model=2048, n_layers=16, n_heads=32, n_kv_heads=8,
         d_ff=5632, max_seq_len=4096),
