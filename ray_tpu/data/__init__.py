@@ -1,9 +1,13 @@
 from ray_tpu.data import preprocessors
 from ray_tpu.data.dataset import Dataset, GroupedData
-from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
-                                   from_pandas, range, read_csv, read_json,
-                                   read_parquet, read_text)
+from ray_tpu.data.read_api import (from_arrow, from_huggingface,
+                                   from_items, from_numpy, from_pandas,
+                                   range, read_binary_files, read_csv,
+                                   read_images, read_json, read_numpy,
+                                   read_parquet, read_text, read_tfrecords)
 
 __all__ = ["Dataset", "GroupedData", "range", "from_items", "from_numpy",
-           "from_pandas", "from_arrow", "read_parquet", "read_csv",
-           "read_json", "read_text", "preprocessors"]
+           "from_pandas", "from_arrow", "from_huggingface", "read_parquet",
+           "read_csv", "read_json", "read_text", "read_numpy",
+           "read_binary_files", "read_images", "read_tfrecords",
+           "preprocessors"]

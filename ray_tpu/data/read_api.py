@@ -136,3 +136,107 @@ def read_text(paths, **kwargs) -> Dataset:
         return read
 
     return Dataset([exe.ReadStage([make(f) for f in files])])
+
+
+def read_numpy(paths, *, column: str = "data", **kwargs) -> Dataset:
+    """.npy files, one block per file (reference: read_numpy /
+    NumpyDatasource)."""
+    files = _expand_paths(paths, ".npy")
+
+    def make(path):
+        def read():
+            import numpy as np
+            import pyarrow as pa
+            arr = np.load(path)
+            if arr.ndim == 1:
+                return pa.table({column: arr})
+            return pa.table({column: [row.tolist() for row in arr]})
+        return read
+
+    return Dataset([exe.ReadStage([make(f) for f in files], **kwargs)])
+
+
+def read_binary_files(paths, *, include_paths: bool = False,
+                      suffix: str = "", **kwargs) -> Dataset:
+    """Whole files as bytes rows (reference: read_binary_files /
+    BinaryDatasource — the raw substrate for images/audio/etc.)."""
+    files = _expand_paths(paths, suffix)
+
+    def make(path):
+        def read():
+            import pyarrow as pa
+            with open(path, "rb") as f:
+                data = f.read()
+            cols = {"bytes": [data]}
+            if include_paths:
+                cols["path"] = [path]
+            return pa.table(cols)
+        return read
+
+    return Dataset([exe.ReadStage([make(f) for f in files], **kwargs)])
+
+
+def read_images(paths, *, size=None, mode: str = "RGB",
+                include_paths: bool = False, **kwargs) -> Dataset:
+    """Image files decoded to arrays (reference: read_images /
+    ImageDatasource; decoding via PIL when available, else raw bytes
+    with a clear error)."""
+    files = _expand_paths(paths, "")
+
+    exts = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".tif", ".tiff",
+            ".webp")
+    files = [f for f in files
+             if os.path.isfile(f) and f.lower().endswith(exts)]
+
+    def make(path):
+        def read():
+            import numpy as np
+            try:
+                from PIL import Image
+            except ImportError as e:
+                raise ImportError(
+                    "read_images requires pillow; use read_binary_files "
+                    "for raw bytes") from e
+            img = Image.open(path).convert(mode)
+            if size is not None:
+                img = img.resize(tuple(size))
+            row = {"image": np.asarray(img)}   # tensor column, unboxed
+            if include_paths:
+                row["path"] = path
+            return block_lib.block_from_rows([row])
+        return read
+
+    return Dataset([exe.ReadStage([make(f) for f in files], **kwargs)])
+
+
+def read_tfrecords(paths, **kwargs) -> Dataset:
+    """TFRecord files of tf.train.Example records (reference:
+    read_tfrecords / TFRecordDatasource). Parses the record framing and
+    Example protos directly — no TensorFlow dependency."""
+    files = _expand_paths(paths, ".tfrecord")
+
+    def make(path):
+        def read():
+            import pyarrow as pa
+
+            from ray_tpu.data import tfrecord as tfr
+            rows = [tfr.example_to_row(rec)
+                    for rec in tfr.read_records(path)]
+            return block_lib.block_from_rows(rows) if rows else \
+                pa.table({})
+        return read
+
+    return Dataset([exe.ReadStage([make(f) for f in files], **kwargs)])
+
+
+def from_huggingface(dataset, *, parallelism: int = 8) -> Dataset:
+    """A loaded `datasets.Dataset` (reference: from_huggingface). The
+    zero-copy arrow path only applies when no lazy _indices mapping is
+    pending (select/shuffle/split keep the FULL table in .data and remap
+    rows lazily — reading .data directly would return the wrong rows)."""
+    if getattr(dataset, "_indices", None) is None \
+            and hasattr(dataset, "data"):
+        table = getattr(dataset.data, "table", None)
+        if table is not None:
+            return from_arrow(table.combine_chunks())
+    return from_items([dict(r) for r in dataset], parallelism=parallelism)
