@@ -85,10 +85,13 @@ def run(root: StepNode, *, workflow_id: str,
         def resolve(a):
             return results[id(a)] if isinstance(a, StepNode) else a
 
-        args = [resolve(a) for a in node.args]
-        kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
-        remote_fn = ray_tpu.remote(node.fn)
-        value = ray_tpu.get(remote_fn.remote(*args, **kwargs))
+        if isinstance(node, EventNode):
+            value = _await_event(wf_dir, node)
+        else:
+            args = [resolve(a) for a in node.args]
+            kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+            remote_fn = ray_tpu.remote(node.fn)
+            value = ray_tpu.get(remote_fn.remote(*args, **kwargs))
         tmp = done_path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(value, f)
@@ -106,3 +109,109 @@ def list_workflows(storage: str = DEFAULT_STORAGE) -> List[str]:
 def delete(workflow_id: str, storage: str = DEFAULT_STORAGE):
     import shutil
     shutil.rmtree(os.path.join(storage, workflow_id), ignore_errors=True)
+
+
+# --------------------------------------------------------------- events
+class EventNode(StepNode):
+    """A step that blocks the workflow until an external event arrives
+    (reference: python/ray/workflow/ event system — HTTP/manual event
+    providers resolved through durable storage). The event value is
+    checkpointed like any step result, so a resumed run does not wait
+    again."""
+
+    def __init__(self, event_key: str, timeout_s: Optional[float] = None):
+        super().__init__(fn=None, args=(), kwargs={},
+                         name=f"event:{event_key}")
+        self.event_key = event_key
+        self.timeout_s = timeout_s
+
+
+def wait_for_event(event_key: str,
+                   timeout_s: Optional[float] = None) -> EventNode:
+    return EventNode(event_key, timeout_s)
+
+
+def send_event(workflow_id: str, event_key: str, value: Any = True,
+               storage: str = DEFAULT_STORAGE) -> None:
+    """Deliver an event to a (possibly waiting) workflow. Durable: events
+    sent before the workflow reaches its wait step are consumed on
+    arrival at the step."""
+    ev_dir = os.path.join(storage, workflow_id, "events")
+    os.makedirs(ev_dir, exist_ok=True)
+    tmp = os.path.join(ev_dir, f".{event_key}.tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump(value, f)
+    os.replace(tmp, os.path.join(ev_dir, event_key + ".pkl"))
+
+
+def _await_event(wf_dir: str, node: "EventNode") -> Any:
+    import time as _time
+    path = os.path.join(wf_dir, "events", node.event_key + ".pkl")
+    deadline = None if node.timeout_s is None else \
+        _time.monotonic() + node.timeout_s
+    while True:
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        if deadline is not None and _time.monotonic() > deadline:
+            raise TimeoutError(
+                f"workflow event {node.event_key!r} never arrived")
+        _time.sleep(0.05)
+
+
+# ------------------------------------------------------- virtual actors
+class VirtualActor:
+    """Durable stateful entity addressed by id: every method call loads
+    the persisted state, executes as a task, and checkpoints the new
+    state (reference: ray.workflow virtual actors — long-lived state
+    machines that survive cluster restarts)."""
+
+    def __init__(self, cls, actor_id: str, storage: str = DEFAULT_STORAGE):
+        self._cls = cls
+        self._actor_id = actor_id
+        self._dir = os.path.join(storage, "virtual_actors",
+                                 f"{cls.__name__}:{actor_id}")
+        os.makedirs(self._dir, exist_ok=True)
+
+    def _state_path(self) -> str:
+        return os.path.join(self._dir, "state.pkl")
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        cls = self._cls
+        state_path = self._state_path()
+
+        def call(*args, **kwargs):
+            import ray_tpu
+
+            def run_method(state_blob, method, args, kwargs):
+                import pickle as p
+                inst = cls.__new__(cls)
+                if state_blob is not None:
+                    inst.__dict__.update(p.loads(state_blob))
+                else:
+                    inst.__init__()
+                out = getattr(inst, method)(*args, **kwargs)
+                return p.dumps(inst.__dict__), out
+
+            blob = None
+            if os.path.exists(state_path):
+                with open(state_path, "rb") as f:
+                    blob = f.read()
+            remote = ray_tpu.remote(run_method)
+            new_blob, out = ray_tpu.get(
+                remote.remote(blob, name, args, kwargs))
+            tmp = state_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(new_blob)
+            os.replace(tmp, state_path)
+            return out
+
+        return call
+
+
+def get_actor(cls, actor_id: str,
+              storage: str = DEFAULT_STORAGE) -> VirtualActor:
+    """Get-or-create a durable virtual actor."""
+    return VirtualActor(cls, actor_id, storage)

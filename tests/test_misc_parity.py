@@ -199,3 +199,54 @@ def test_multiprocessing_pool(ray_start):
         assert list(p.imap(sq, range(4))) == [0, 1, 4, 9]
         assert sorted(p.imap_unordered(sq, range(4))) == [0, 1, 4, 9]
         assert p.starmap(addmul, [(1, 2), (3, 4)]) == [12, 34]
+
+
+def test_workflow_events_and_virtual_actors(ray_start, tmp_path):
+    """Workflow event steps block durably until send_event; virtual
+    actors persist state per method call (reference: ray.workflow events
+    + virtual actors)."""
+    import threading
+
+    from ray_tpu import workflow
+
+    @workflow.step
+    def before():
+        return "ready"
+
+    @workflow.step
+    def combine(a, ev):
+        return f"{a}:{ev}"
+
+    node = combine.bind(before.bind(), workflow.wait_for_event("go"))
+
+    out = {}
+
+    def runner():
+        out["v"] = workflow.run(node, workflow_id="ev-wf",
+                                storage=str(tmp_path))
+
+    t = threading.Thread(target=runner)
+    t.start()
+    time.sleep(0.5)
+    assert t.is_alive()            # blocked on the event
+    workflow.send_event("ev-wf", "go", "signal", storage=str(tmp_path))
+    t.join(timeout=60)
+    assert out["v"] == "ready:signal"
+    # resume consumes the checkpoint, not the event again
+    assert workflow.run(node, workflow_id="ev-wf",
+                        storage=str(tmp_path)) == "ready:signal"
+
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def add(self, k):
+            self.n += k
+            return self.n
+
+    a = workflow.get_actor(Counter, "c1", storage=str(tmp_path))
+    assert a.add(2) == 2
+    assert a.add(3) == 5
+    # a fresh handle (fresh process in real life) sees durable state
+    b = workflow.get_actor(Counter, "c1", storage=str(tmp_path))
+    assert b.add(1) == 6
