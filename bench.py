@@ -173,15 +173,18 @@ def _tpu_reachable(timeout=120):
     try:
         out = subprocess.run(
             [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
+             "import jax; d = jax.devices()[0]; "
+             "print(d.platform, '|', getattr(d, 'device_kind', ''))"],
             capture_output=True, text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
         log("TPU probe timed out; skipping MFU")
         return False
     plat = (out.stdout or "").strip().splitlines()[-1:] or [""]
-    if out.returncode == 0 and plat[0] == "tpu":
+    # device plugins (e.g. tunneled backends) report their own platform
+    # name; the device kind still names the TPU generation
+    if out.returncode == 0 and "tpu" in plat[0].lower():
         return True
-    log(f"TPU probe: rc={out.returncode} platform={plat[0]!r}; skipping MFU")
+    log(f"TPU probe: rc={out.returncode} device={plat[0]!r}; skipping MFU")
     return False
 
 

@@ -285,7 +285,8 @@ class GcsServer:
 
     def _cluster_view(self) -> Dict[str, Dict]:
         return {nid: {"total": n["total"], "available": n["available"],
-                      "alive": n["alive"], "address": n["address"],
+                      "alive": n["alive"], "draining": n["draining"],
+                      "address": n["address"],
                       "object_store_address": n["object_store_address"],
                       "node_ip": n["node_ip"], "labels": n["labels"]}
                 for nid, n in self.nodes.items()}

@@ -725,8 +725,10 @@ class NodeManager:
                     pass
 
     def _live_view(self) -> Dict[str, Dict]:
+        # draining nodes take no NEW work (reference: node draining in
+        # cluster_task_manager — schedulable set excludes draining)
         view = {nid: v for nid, v in self.cluster_view.items()
-                if v.get("alive", True)}
+                if v.get("alive", True) and not v.get("draining", False)}
         if self.node_id in view:
             view[self.node_id] = {**view[self.node_id],
                                   "available": self._reported_available(),

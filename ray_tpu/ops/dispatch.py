@@ -21,6 +21,19 @@ from ray_tpu.parallel import mesh as mesh_lib
 from ray_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR
 
 
+def _on_tpu() -> bool:
+    """True on real TPU hardware, including device plugins whose platform
+    string isn't literally "tpu" (the device kind names the generation)."""
+    if jax.default_backend() == "tpu":
+        return True
+    try:
+        d = jax.devices()[0]
+    except Exception:
+        return False
+    return "tpu" in (getattr(d, "device_kind", "") or "").lower() \
+        or "tpu" in (d.platform or "").lower()
+
+
 def attention(q, k, v, causal: bool = True, impl: str = "auto"):
     """q[B,L,H,D], k/v[B,L,Hkv,D] — global (logical) shapes."""
     mesh = mesh_lib.current_mesh()
@@ -31,7 +44,7 @@ def attention(q, k, v, causal: bool = True, impl: str = "auto"):
             impl = "ring"
         elif multi:
             impl = "sharded_local"   # per-shard flash/ref under shard_map
-        elif jax.default_backend() == "tpu":
+        elif _on_tpu():
             impl = "flash"
         else:
             impl = "reference"
