@@ -52,6 +52,19 @@ WORKER = "worker"
 # max_dispatchers_per_sig, actor_restart_probe_s)
 
 
+def _import_ref(ref: str):
+    """Resolve a cross-language "module:attr" reference."""
+    import importlib
+    mod_name, sep, attr = ref.partition(":")
+    if not sep or not attr:
+        raise ValueError(f"bad cross-language ref {ref!r}; "
+                         f"expected 'module:attr'")
+    target = importlib.import_module(mod_name)
+    for part in attr.split("."):
+        target = getattr(target, part)
+    return target
+
+
 def _encode_arg(arg, ref_hook) -> list:
     if isinstance(arg, ObjectRef):
         if ref_hook is not None:
@@ -829,12 +842,7 @@ class CoreWorker:
         descriptors)."""
         ref = spec.get("func_ref")
         if ref:
-            import importlib
-            mod_name, _, attr = ref.partition(":")
-            fn = importlib.import_module(mod_name)
-            for part in attr.split("."):
-                fn = getattr(fn, part)
-            return fn
+            return _import_ref(ref)
         return await self._load_function(spec["func_id"],
                                          spec.get("owner_address"))
 
@@ -1827,6 +1835,16 @@ class CoreWorker:
         if not isinstance(exc, TaskError):
             logger.debug("task %s raised", spec.get("name"),
                          exc_info=exc)
+        if spec.get("xlang"):
+            # cross-language callers can't unpickle Python exceptions:
+            # ship the message as msgpack text (kind 1 marks an error)
+            import msgpack
+            cause = exc.cause if isinstance(exc, TaskError) and \
+                getattr(exc, "cause", None) else exc
+            payload = msgpack.packb(
+                f"{type(cause).__name__}: {cause}", use_bin_type=True)
+            ret = ["wire", 1, b"", [payload]]
+            return {"returns": [ret for _ in spec["return_ids"]]}
         s = serialization.serialize_error(exc)
         ret = ["wire"] + list(s.to_wire())
         return {"returns": [ret for _ in spec["return_ids"]]}
@@ -1847,8 +1865,14 @@ class CoreWorker:
     async def h_become_actor(self, conn, spec: Dict):
         self._apply_accelerator_ids(spec)
         self._apply_runtime_env(spec)   # permanent for the actor's life
-        cls = await self._load_function(spec["class_id"],
-                                        spec.get("owner_address"))
+        if spec.get("class_ref"):
+            # cross-language actor: importable "module:Class" instead of
+            # a shipped pickle (reference: cross-language actor class
+            # descriptors, java/cpp frontends)
+            cls = _import_ref(spec["class_ref"])
+        else:
+            cls = await self._load_function(spec["class_id"],
+                                            spec.get("owner_address"))
         args, kwargs = await self._resolve_args(
             {"args": spec["init_args"], "kwargs": spec["init_kwargs"]})
         self.actor_id = spec["actor_id"]

@@ -31,6 +31,24 @@ int main(int argc, char** argv) {
   }
   if (!raised) return 1;
 
+  // cross-language actor: create, call methods, observe state, kill
+  rt::Client::ActorHandle acc = client.CreateActor(
+      "ray_tpu.util.xlang_demo:Accumulator", {rt::Value::Int(100)});
+  rt::Value r1 = client.CallActor(acc, "add", {rt::Value::Int(5)});
+  rt::Value r2 = client.CallActor(acc, "add", {rt::Value::Int(7)});
+  rt::Value r3 = client.CallActor(acc, "get", {});
+  std::printf("actor_total=%lld\n", static_cast<long long>(r3.i));
+  if (r1.i != 105 || r2.i != 112 || r3.i != 112) return 1;
+  bool actor_err = false;
+  try {
+    client.CallActor(acc, "add", {rt::Value::Str("not-a-number")});
+  } catch (const std::exception& e) {
+    actor_err = true;
+    std::printf("actor error propagated: %s\n", e.what());
+  }
+  if (!actor_err) return 1;
+  client.KillActor(acc);
+
   std::printf("CPP_API_OK\n");
   return 0;
 }

@@ -28,6 +28,8 @@
 #include <random>
 #include <stdexcept>
 #include <string>
+#include <thread>
+#include <chrono>
 #include <vector>
 
 namespace rt {
@@ -408,14 +410,142 @@ class Client {
     const Value& ret = resp.obj.at("returns").arr.at(0);
     // ["wire", kind, pkl, [payloads]]
     int64_t kind = ret.arr.at(1).i;
-    if (kind == 1)
-      throw std::runtime_error("remote task failed: " + func_ref);
     const auto& payload = ret.arr.at(3).arr.at(0).bin;
     Cursor c{payload.data(), payload.size()};
+    if (kind == 1) {
+      Value msg = Unpack(&c);   // xlang errors arrive as msgpack text
+      throw std::runtime_error("remote task failed: " + func_ref + ": " +
+                               msg.s);
+    }
     return Unpack(&c);
   }
 
+  // ----------------------------------------------------------- actors
+  // Cross-language actors: the class is named by an importable
+  // "module:Class" reference (reference: cpp/java actor class
+  // descriptors); instance state lives in a Python worker, methods are
+  // pushed directly to it like the Python ActorTaskSubmitter.
+  struct ActorHandle {
+    std::string actor_id;
+    std::string address;
+    std::shared_ptr<RpcConn> conn;   // cached per-handle connection
+  };
+
+  ActorHandle CreateActor(const std::string& class_ref,
+                          const std::vector<Value>& init_args,
+                          double num_cpus = 1.0,
+                          double timeout_s = 60.0) {
+    std::mt19937_64 rng(std::random_device{}());
+    std::string actor_id;
+    for (int k = 0; k < 32; k++)
+      actor_id += "0123456789abcdef"[rng() % 16];
+    std::vector<Value> enc_args;
+    for (const auto& a : init_args) enc_args.push_back(EncodeArg(a));
+    Value spec = Value::Map({
+        {"actor_id", Value::Str(actor_id)},
+        {"job_id", Value::Int(0)},
+        {"class_ref", Value::Str(class_ref)},
+        {"name", Value::Str("")},
+        {"namespace", Value::Str("default")},
+        {"init_args", Value::Arr(std::move(enc_args))},
+        {"init_kwargs", Value::Map({})},
+        {"resources",
+         Value::Map({{"CPU", Value::Float(num_cpus)}})},
+        {"max_restarts", Value::Int(0)},
+        {"max_concurrency", Value::Int(1)},
+        {"scheduling", Value::Map({})},
+        {"owner_address", Value::Str("cpp-client")},
+        {"method_names", Value::Arr({})},
+    });
+    gcs_.Call("create_actor", Value::Map({{"spec", spec}}));
+    // wait for placement (reference: actor creation is async; handles
+    // resolve the address from the GCS actor table)
+    for (int i = 0; i < int(timeout_s / 0.1); i++) {
+      Value info = gcs_.Call(
+          "get_actor_info",
+          Value::Map({{"actor_id", Value::Str(actor_id)}}));
+      if (!info.obj.empty()) {
+        const std::string& state = info.obj.at("state").s;
+        if (state == "ALIVE")
+          return ActorHandle{actor_id, info.obj.at("address").s, nullptr};
+        if (state == "DEAD") {
+          std::string cause;
+          auto it = info.obj.find("death_cause");
+          if (it != info.obj.end()) cause = ": " + it->second.s;
+          throw std::runtime_error("actor creation failed: " + class_ref +
+                                   cause);
+        }
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
+    // the registration was accepted; without a kill the GCS would place
+    // the actor later and leak its resources with no reachable handle
+    try {
+      KillActor(ActorHandle{actor_id, "", nullptr});
+    } catch (...) {
+    }
+    throw std::runtime_error("actor never became ALIVE: " + class_ref);
+  }
+
+  Value CallActor(ActorHandle& h, const std::string& method,
+                  const std::vector<Value>& args) {
+    std::mt19937_64 rng(std::random_device{}());
+    std::vector<uint8_t> task_id(16), ret_id;
+    for (auto& b : task_id) b = uint8_t(rng());
+    ret_id = task_id;
+    ret_id.push_back(0);
+    ret_id.push_back(0);
+    ret_id.push_back(0);
+    ret_id.push_back(1);
+    std::vector<Value> enc_args;
+    for (const auto& a : args) enc_args.push_back(EncodeArg(a));
+    Value spec = Value::Map({
+        {"task_id", Value::Bin(task_id)},
+        {"job_id", Value::Int(0)},
+        {"name", Value::Str(method)},
+        {"actor_id", Value::Str(h.actor_id)},
+        {"method", Value::Str(method)},
+        {"args", Value::Arr(std::move(enc_args))},
+        {"kwargs", Value::Map({})},
+        {"return_ids", Value::Arr({Value::Bin(ret_id)})},
+        {"owner_address", Value::Str("cpp-client")},
+        {"owner_node", Value::Str(node_id_)},
+        {"xlang", Value::Bool(true)},
+    });
+    if (!h.conn) {
+      h.conn = std::make_shared<RpcConn>();
+      h.conn->Connect(h.address);
+    }
+    Value resp = h.conn->Call("push_task", Value::Map({{"spec", spec}}));
+    const Value& ret = resp.obj.at("returns").arr.at(0);
+    int64_t kind = ret.arr.at(1).i;
+    const auto& payload = ret.arr.at(3).arr.at(0).bin;
+    Cursor c{payload.data(), payload.size()};
+    if (kind == 1) {
+      // xlang errors arrive as msgpack text
+      Value msg = Unpack(&c);
+      throw std::runtime_error("actor method failed: " + method + ": " +
+                               msg.s);
+    }
+    return Unpack(&c);
+  }
+
+  void KillActor(const ActorHandle& h) {
+    gcs_.Call("kill_actor",
+              Value::Map({{"actor_id", Value::Str(h.actor_id)},
+                          {"no_restart", Value::Bool(true)}}));
+  }
+
  private:
+  static Value EncodeArg(const Value& a) {
+    std::string payload;
+    PackTo(a, &payload);
+    return Value::Arr(
+        {Value::Str("v"), Value::Int(3) /* KIND_MSGPACK */, Value::Bin({}),
+         Value::Arr({Value::Bin(std::vector<uint8_t>(
+             payload.begin(), payload.end()))})});
+  }
+
   Value RequestLease(double num_cpus) {
     RpcConn* target = &node_;
     std::unique_ptr<RpcConn> spill_conn;

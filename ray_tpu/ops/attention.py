@@ -91,7 +91,8 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 256,
     B, Lq, H, D = q.shape
     _, Lk, Hkv, _ = k.shape
     scale = scale if scale is not None else D ** -0.5
-    on_tpu = jax.default_backend() == "tpu"
+    from ray_tpu.ops.dispatch import _on_tpu
+    on_tpu = _on_tpu()
     if not (on_tpu or interpret) or Lq % 128 or Lk % 128 or D % 128:
         return mha_reference(q, k, v, causal=causal, scale=scale)
     block_q = min(block_q, Lq)

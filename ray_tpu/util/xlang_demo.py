@@ -1,0 +1,24 @@
+"""Cross-language demo/test targets: importable callables and classes
+that non-Python frontends (C++ API) reference by "module:attr"
+(reference: cross-language function/actor descriptors in the cpp/java
+frontends)."""
+
+from __future__ import annotations
+
+
+class Accumulator:
+    """Stateful target for cross-language actor calls."""
+
+    def __init__(self, start=0):
+        self.total = int(start)
+
+    def add(self, x):
+        self.total += int(x)
+        return self.total
+
+    def get(self):
+        return self.total
+
+
+def scale(x, k):
+    return x * k

@@ -47,6 +47,7 @@ def test_cpp_client_cross_language(tmp_path):
         assert "CPP_API_OK" in out.stdout, out.stdout + out.stderr
         assert "pow=1024" in out.stdout
         assert "error propagated" in out.stdout
+        assert "actor_total=112" in out.stdout
     finally:
         host.terminate()
         host.wait(timeout=10)

@@ -128,16 +128,43 @@ def test_placement_group_pack_actor(cluster):
 
 def test_spillback_when_local_full(cluster):
     """More parallel tasks than any single node's CPUs: they must land on
-    several nodes (hybrid policy spillback)."""
-    @ray_tpu.remote(num_cpus=1)
-    def hold():
-        import time
-        import ray_tpu
-        time.sleep(1.0)
-        return ray_tpu.get_runtime_context()["node_id"]
+    several nodes (hybrid policy spillback). A rendezvous barrier makes
+    the requirement deterministic — 3 tasks must run CONCURRENTLY, which
+    the 2-CPU head alone cannot do, so spillback has to happen (serial
+    reuse of local leases would deadlock the barrier, not flake)."""
+    @ray_tpu.remote(num_cpus=0.1)
+    class Barrier:
+        def __init__(self, n):
+            self.n = n
+            self.count = 0
 
-    refs = [hold.remote() for _ in range(6)]
-    got = ray_tpu.get(refs, timeout=30)
+        def arrive(self):
+            self.count += 1
+            return self.count
+
+        def ready(self):
+            return self.count >= self.n
+
+    bar = Barrier.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            cluster.nodes[0].node_id)).remote(3)
+
+    @ray_tpu.remote(num_cpus=1)
+    def hold(bar):
+        import time
+
+        import ray_tpu
+        ray_tpu.get(bar.arrive.remote(), timeout=30)
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            if ray_tpu.get(bar.ready.remote(), timeout=30):
+                return ray_tpu.get_runtime_context()["node_id"]
+            time.sleep(0.05)
+        raise TimeoutError("fewer than 3 tasks ran concurrently "
+                           "(no spillback happened)")
+
+    refs = [hold.remote(bar) for _ in range(6)]
+    got = ray_tpu.get(refs, timeout=90)
     assert len(set(got)) >= 2
 
 
