@@ -16,6 +16,10 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
+
+import logging
+
+logger = logging.getLogger(__name__)
 from ray_tpu.tune.schedulers import CONTINUE, EXPLOIT, STOP, FIFOScheduler
 from ray_tpu.tune.search import BasicVariantGenerator
 
@@ -125,6 +129,7 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Any = None
+    search_alg: Any = None        # sequential suggest/report (e.g. TPE)
     metric: Optional[str] = None
     mode: str = "max"
     seed: Optional[int] = None
@@ -143,19 +148,57 @@ class Tuner:
 
     def fit(self) -> ResultGrid:
         tc = self.tune_config
-        variants = BasicVariantGenerator(
-            self.param_space, num_samples=tc.num_samples,
-            seed=tc.seed).variants()
+        if tc.search_alg is not None:
+            # sequential suggestion (reference: SearchAlgorithm-driven
+            # trials — Optuna/HyperOpt adapters; here the native TPE):
+            # configs are proposed lazily as slots free and completed
+            # scores feed back into the model
+            variants = None
+        else:
+            variants = BasicVariantGenerator(
+                self.param_space, num_samples=tc.num_samples,
+                seed=tc.seed).variants()
         scheduler = tc.scheduler or FIFOScheduler()
         max_conc = tc.max_concurrent_trials or max(
             1, int(ray_tpu.cluster_resources().get("CPU", 1)) - 1)
 
+        search_metric = None
+        if tc.search_alg is not None:
+            search_metric = tc.metric or getattr(tc.search_alg, "metric",
+                                                 None)
+            if not search_metric:
+                raise ValueError(
+                    "search_alg requires a metric (TuneConfig.metric or "
+                    "the algorithm's metric=...) — without it every "
+                    "suggestion would be a blind random draw")
+
+        def report_to_search(res: TrialResult):
+            if tc.search_alg is None or res.error:
+                return
+            score = (res.metrics or {}).get(search_metric)
+            if score is None:
+                logger.warning(
+                    "trial %s reported no %r metric; search model "
+                    "unchanged", res.trial_id, search_metric)
+                return
+            tc.search_alg.report(res.config, score)
+
         actor_cls = ray_tpu.remote(TrialActor)
-        pending = [(f"trial_{i:05d}", cfg) for i, cfg in enumerate(variants)]
+        if variants is not None:
+            pending = [(f"trial_{i:05d}", cfg)
+                       for i, cfg in enumerate(variants)]
+            budget = 0
+        else:
+            pending = []
+            budget = tc.num_samples       # suggestions left to draw
         running: Dict[str, Dict] = {}
         done: List[TrialResult] = []
 
-        while pending or running:
+        while pending or running or budget > 0:
+            while budget > 0 and len(pending) + len(running) < max_conc:
+                pending.append((f"trial_{tc.num_samples - budget:05d}",
+                                tc.search_alg.suggest()))
+                budget -= 1
             while pending and len(running) < max_conc:
                 trial_id, cfg = pending.pop(0)
                 actor = actor_cls.options(
@@ -213,7 +256,9 @@ class Tuner:
                 if decision == STOP and not t["stopped"]:
                     t["stopped"] = True
                     ray_tpu.kill(t["actor"])
-                    done.append(self._finish(trial_id, t, None))
+                    res = self._finish(trial_id, t, None)
+                    report_to_search(res)
+                    done.append(res)
                     del running[trial_id]
                     continue
                 ready, _ = ray_tpu.wait([t["run_ref"]], timeout=0)
@@ -237,7 +282,9 @@ class Tuner:
                         ray_tpu.kill(t["actor"])
                     except Exception:
                         pass
-                    done.append(self._finish(trial_id, t, err))
+                    res = self._finish(trial_id, t, err)
+                    report_to_search(res)
+                    done.append(res)
                     del running[trial_id]
         return ResultGrid(done)
 

@@ -158,3 +158,35 @@ def test_pbt_exploit_and_checkpoint(ray_start):
     # high-weight checkpoints or kept compounding a strong lr
     final_scores = sorted(r.metrics.get("score", 0.0) for r in grid)
     assert final_scores[0] > 1.0, final_scores
+
+
+def test_tpe_search(ray_start):
+    """Native TPE beats its own random warmup on a smooth objective
+    (reference: the Optuna/HyperOpt search-algorithm integrations)."""
+    from ray_tpu.tune.search import TPESearch
+
+    def objective(config):
+        x = config["x"]
+        bonus = 0.0 if config["kind"] == "good" else 2.0
+        tune.report({"loss": (x - 3.0) ** 2 + bonus})
+
+    space = {"x": tune.uniform(-10.0, 10.0),
+             "kind": tune.choice(["good", "bad"])}
+    alg = TPESearch(space, metric="loss", mode="min", n_initial=8, seed=7)
+    tuner = tune.Tuner(
+        objective, param_space=space,
+        tune_config=tune.TuneConfig(num_samples=32, metric="loss",
+                                    mode="min", search_alg=alg,
+                                    max_concurrent_trials=2))
+    grid = tuner.fit()
+    best = grid.get_best_result(metric="loss", mode="min")
+    assert best.metrics["loss"] < 1.0, best.metrics
+    assert best.config["kind"] == "good"
+    # the model phase concentrated samples near the optimum: the best of
+    # the suggested (post-warmup) trials beats the random warmup's best
+    ordered = sorted(grid, key=lambda r: r.trial_id)
+    warmup = ordered[:8]
+    suggested = ordered[8:]
+    best_warm = min(r.metrics["loss"] for r in warmup if r.metrics)
+    best_sugg = min(r.metrics["loss"] for r in suggested if r.metrics)
+    assert best_sugg <= best_warm + 1e-9
