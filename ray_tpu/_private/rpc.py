@@ -99,6 +99,7 @@ class Connection:
         self._closed = False
         self._writer_lock = asyncio.Lock()
         self._task: Optional[asyncio.Task] = None
+        self._dispatch_tasks: set = set()
         self.on_close: Optional[Callable[["Connection"], None]] = None
         # opaque slot for servers to stash peer identity (node id, worker id)
         self.peer_info: Dict[str, Any] = {}
@@ -122,7 +123,9 @@ class Connection:
                 msg = _unpack(body)
                 mtype = msg[0]
                 if mtype == REQUEST or mtype == NOTIFY:
-                    asyncio.ensure_future(self._dispatch(msg))
+                    t = asyncio.ensure_future(self._dispatch(msg))
+                    self._dispatch_tasks.add(t)
+                    t.add_done_callback(self._dispatch_tasks.discard)
                 elif mtype == RESPONSE:
                     _, seq, ok, payload = msg
                     fut = self._pending.pop(seq, None)
@@ -223,8 +226,13 @@ class Connection:
         await self._send([NOTIFY, 0, method, kwargs])
 
     async def close(self):
-        if self._task is not None:
-            self._task.cancel()
+        me = asyncio.current_task()
+        victims = [t for t in [self._task, *self._dispatch_tasks]
+                   if t is not None and t is not me and not t.done()]
+        for t in victims:
+            t.cancel()
+        if victims:
+            await asyncio.gather(*victims, return_exceptions=True)
         await self._shutdown()
 
 
@@ -343,6 +351,7 @@ class ConnectionPool:
         self.name = name
         self._conns: Dict[str, Connection] = {}
         self._locks: Dict[str, asyncio.Lock] = {}
+        self._closing: set = set()
 
     async def get(self, addr: str) -> Connection:
         conn = self._conns.get(addr)
@@ -365,9 +374,15 @@ class ConnectionPool:
     def invalidate(self, addr: str):
         conn = self._conns.pop(addr, None)
         if conn is not None and not conn.closed:
-            asyncio.ensure_future(conn.close())
+            t = asyncio.ensure_future(conn.close())
+            self._closing.add(t)
+            t.add_done_callback(self._closing.discard)
 
     async def close(self):
-        for conn in self._conns.values():
-            await conn.close()
-        self._conns.clear()
+        conns, self._conns = list(self._conns.values()), {}
+        if conns:
+            await asyncio.gather(*(c.close() for c in conns),
+                                 return_exceptions=True)
+        if self._closing:
+            await asyncio.gather(*list(self._closing),
+                                 return_exceptions=True)

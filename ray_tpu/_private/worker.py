@@ -191,6 +191,16 @@ class CoreWorker:
         self._visible_dirty: set = set()
         self._cancelled_tasks: set = set()
         self._shutdown = False
+        # every fire-and-forget coroutine is tracked here so stop_async can
+        # cancel-and-await it — shutdown must leave zero pending tasks
+        # (the asyncio analogue of the reference's tsan-clean shutdown)
+        self._bg: set = set()
+
+    def _spawn(self, coro) -> "asyncio.Task":
+        t = asyncio.ensure_future(coro)
+        self._bg.add(t)
+        t.add_done_callback(self._bg.discard)
+        return t
 
     # -------------------------------------------------------------- startup
     async def start_async(self):
@@ -235,10 +245,10 @@ class CoreWorker:
                     os._exit(1)
                 self.node_conn.on_close = _nm_lost
         self._exec_queue = asyncio.Queue()
-        self._consumers = [asyncio.ensure_future(self._exec_consumer())]
-        self._lease_reaper = asyncio.ensure_future(self._reap_leases())
+        self._consumers = [self._spawn(self._exec_consumer())]
+        self._lease_reaper = self._spawn(self._reap_leases())
         self._task_events: List[Dict] = []
-        self._event_flusher = asyncio.ensure_future(self._flush_task_events())
+        self._event_flusher = self._spawn(self._flush_task_events())
         self._install_ref_hooks()
         self._subscribed_actor_channel = False
         self._subscribed_channels = set()
@@ -293,7 +303,7 @@ class CoreWorker:
         elif owner_address and owner_address != self.address:
             cnt = self.borrowed_counts.pop(oid, 0)
             if cnt > 0:
-                asyncio.ensure_future(self._send_remove_borrow(oid, owner_address))
+                self._spawn(self._send_remove_borrow(oid, owner_address))
             self.memory_store.pop(oid, None)
 
     async def _send_remove_borrow(self, oid, owner_address):
@@ -320,7 +330,7 @@ class CoreWorker:
                 except Exception:
                     pass
             elif loc is not None:
-                asyncio.ensure_future(self._free_remote(oid, loc))
+                self._spawn(self._free_remote(oid, loc))
 
     async def _free_remote(self, oid: bytes, node_id: str):
         try:
@@ -565,7 +575,7 @@ class CoreWorker:
                     pass
             _done()
 
-        asyncio.ensure_future(_watch())
+        self._spawn(_watch())
         await fut
         return True
 
@@ -767,7 +777,7 @@ class CoreWorker:
         # than silently collapsed (ray.wait raises on duplicate refs)
         if len({r.id for r in refs}) != len(refs):
             raise ValueError("wait() expects a list of distinct ObjectRefs")
-        pending = {asyncio.ensure_future(self._resolve(r)): r for r in refs}
+        pending = {self._spawn(self._resolve(r)): r for r in refs}
         ready_ids = set()
         deadline = None if timeout is None else time.monotonic() + timeout
         while pending and len(ready_ids) < num_returns:
@@ -814,7 +824,7 @@ class CoreWorker:
                    and len(self._func_blobs) > 1):
                 _, old_blob = self._func_blobs.popitem(last=False)
                 self._func_blob_bytes -= len(old_blob)
-            asyncio.ensure_future(self.gcs_call_async(
+            self._spawn(self.gcs_call_async(
                 "kv_put", ns="funcs", key=fid, value=pickled,
                 overwrite=False))
         else:
@@ -911,7 +921,7 @@ class CoreWorker:
             func, args, kwargs, num_returns, name)
 
         def _kickoff():
-            asyncio.ensure_future(self._finish_task_submit(
+            self._spawn(self._finish_task_submit(
                 func, spec, return_ids, arg_refs, resources, max_retries,
                 scheduling, runtime_env))
 
@@ -1030,7 +1040,7 @@ class CoreWorker:
                 and (len(st["queue"]) > free
                      or self._idle_leases.get(sig))):
             st["dispatchers"] += 1
-            asyncio.ensure_future(self._dispatch_loop(sig, st))
+            self._spawn(self._dispatch_loop(sig, st))
 
     async def _dispatch_loop(self, sig, st):
         try:
@@ -1076,11 +1086,13 @@ class CoreWorker:
                         logger.exception("lease return failed")
         finally:
             st["dispatchers"] -= 1
-            if st["queue"] and st["dispatchers"] == 0:
+            if st["queue"] and st["dispatchers"] == 0 and not self._shutdown:
                 # we were the last dispatcher and tasks remain (e.g. an
                 # exception escaped above): respawn so callers never hang
+                # (never during shutdown: a task spawned while stop_async
+                # is cancelling would escape its victim snapshot)
                 st["dispatchers"] += 1
-                asyncio.ensure_future(self._dispatch_loop(sig, st))
+                self._spawn(self._dispatch_loop(sig, st))
             elif not st["queue"] and st["dispatchers"] == 0:
                 self._sig_queues.pop(sig, None)
 
@@ -1259,7 +1271,7 @@ class CoreWorker:
                 keep = []
                 for lease in pool:
                     if now - lease.last_used > cfg.lease_idle_timeout_s:
-                        asyncio.ensure_future(self._drop_lease(lease))
+                        self._spawn(self._drop_lease(lease))
                     else:
                         keep.append(lease)
                 self._idle_leases[sig] = keep
@@ -1417,9 +1429,9 @@ class CoreWorker:
             # blocks on st.ready until it lands)
             st = ActorHandleState(actor_id)
             self.actor_handles[actor_id] = st
-            asyncio.ensure_future(self._actor_state(actor_id))
+            self._spawn(self._actor_state(actor_id))
         if st.sender is None:
-            st.sender = asyncio.ensure_future(
+            st.sender = self._spawn(
                 self._actor_sender(actor_id, st))
         pt.seq = st.seq_counter
         st.seq_counter += 1
@@ -1468,7 +1480,7 @@ class CoreWorker:
                     self._fail_task(pt, ActorDiedError(
                         f"actor {actor_id[:12]} connection lost: {e}"))
                     break
-                asyncio.ensure_future(
+                self._spawn(
                     self._finish_actor_task(pt, fut, actor_id, st, address))
                 break
 
@@ -1479,7 +1491,7 @@ class CoreWorker:
         if st.address == address and st.ready.is_set():
             st.ready.clear()
             st.state = "RESTARTING?"
-        asyncio.ensure_future(self._probe_actor(st.actor_id))
+        self._spawn(self._probe_actor(st.actor_id))
         return True
 
     async def _finish_actor_task(self, pt: PendingTask, fut, actor_id: str,
@@ -1883,7 +1895,7 @@ class CoreWorker:
                 max_workers=maxc, thread_name_prefix="actor-exec")
             for _ in range(maxc - 1):
                 self._consumers.append(
-                    asyncio.ensure_future(self._exec_consumer()))
+                    self._spawn(self._exec_consumer()))
         inner = cls.__ray_tpu_actual_class__ if hasattr(
             cls, "__ray_tpu_actual_class__") else cls
         instance = await self.loop.run_in_executor(
@@ -1899,12 +1911,29 @@ class CoreWorker:
     def as_future(self, ref: ObjectRef) -> concurrent.futures.Future:
         return asyncio.run_coroutine_threadsafe(self.get_async(ref), self.loop)
 
-    async def stop_async(self):
+    async def stop_async(self, private_loop: bool = True):
         self._shutdown = True
-        for c in self._consumers:
-            c.cancel()
-        if self._lease_reaper:
-            self._lease_reaper.cancel()
+        # flush buffered task events so the GCS timeline isn't truncated
+        if self._task_events and self.gcs is not None and not self.gcs.closed:
+            batch, self._task_events = self._task_events, []
+            try:
+                await asyncio.wait_for(
+                    self.gcs.notify("add_task_events", events=batch), 1.0)
+            except Exception:
+                pass
+        # cancel-and-await every background task (senders, dispatchers,
+        # flushers, probes) BEFORE closing connections: nothing may outlive
+        # shutdown (no "Task was destroyed but it is pending!")
+        me = asyncio.current_task()
+        # drain in rounds: a task cancelled mid-cleanup may spawn another
+        # (it lands in _bg and is caught by the next round)
+        for _ in range(10):
+            victims = [t for t in self._bg if t is not me and not t.done()]
+            if not victims:
+                break
+            for t in victims:
+                t.cancel()
+            await asyncio.gather(*victims, return_exceptions=True)
         if self.server:
             await self.server.close()
         if self.gcs:
@@ -1914,6 +1943,16 @@ class CoreWorker:
         await self.pool.close()
         if self.store is not None:
             self.store.close()
+        # surface anything that escaped tracking (test hook: must be empty).
+        # on a private loop every task belongs to this worker, so check the
+        # whole loop (catches rpc-layer escapes too); on a shared loop
+        # (owns_loop=False) only our tracked tasks are ours to judge
+        pool = asyncio.all_tasks() if private_loop else self._bg
+        leaked = [t for t in pool if t is not me and not t.done()]
+        if leaked:
+            logger.warning("shutdown leaked %d pending tasks: %s",
+                           len(leaked), [t.get_name() for t in leaked][:8])
+        return [t.get_name() for t in leaked]
 
 
 global_worker: Optional["Worker"] = None
@@ -1997,8 +2036,10 @@ class Worker:
         return self._run(self.core.node_conn.call(method, **kw))
 
     def stop(self):
+        self.leaked_tasks: Optional[list] = None
         try:
-            self._run(self.core.stop_async(), timeout=5)
+            self.leaked_tasks = self._run(
+                self.core.stop_async(private_loop=self.owns_loop), timeout=5)
         except Exception:
             pass
         if self.owns_loop and self.core.loop is not None:
