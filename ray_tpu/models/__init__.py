@@ -17,6 +17,19 @@ MODEL_REGISTRY = {
     "llama-7b": TransformerConfig(
         vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=32,
         d_ff=11008, max_seq_len=4096),
+    # TPU-native flagship geometry: 128-lane heads (head_dim=128) fill the
+    # MXU's 128-wide systolic tiles; the classic hd=64 llama layout leaves
+    # half the array idle on QK^T/PV. Measured +10pts MFU on v5e
+    # (reports/mfu_ablation.jsonl: 42.8% vs 32.1% for the same 350m FLOPs)
+    "tpu-125m": TransformerConfig(
+        vocab_size=32000, d_model=768, n_layers=12, n_heads=6, n_kv_heads=6,
+        d_ff=2048, max_seq_len=2048),
+    "tpu-350m": TransformerConfig(
+        vocab_size=32000, d_model=1024, n_layers=24, n_heads=8, n_kv_heads=8,
+        d_ff=2816, max_seq_len=2048),
+    "tpu-1b": TransformerConfig(
+        vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
+        n_kv_heads=16, d_ff=5632, max_seq_len=4096),
     # MoE family (models/moe.py): expert-parallel over the mesh `expert` axis
     "moe-debug": TransformerConfig(
         vocab_size=1024, d_model=256, n_layers=2, n_heads=4, n_kv_heads=2,

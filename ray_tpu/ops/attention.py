@@ -1,9 +1,13 @@
 """Attention kernels.
 
-`flash_attention` is a Pallas TPU kernel (tiled online-softmax attention,
-VMEM-blocked for the MXU; see /opt/skills/guides/pallas_guide.md
-conventions); on non-TPU backends it falls back to the XLA reference
-implementation so the same model code runs on the CPU test mesh.
+`flash_attention` is a Pallas TPU kernel pair (tiled online-softmax forward
++ FlashAttention-2-style backward, VMEM-blocked for the MXU; see
+/opt/skills/guides/pallas_guide.md conventions) wired up as a
+`jax.custom_vjp`, so it is usable inside `jax.grad` train steps. Head dims
+that aren't lane-aligned (e.g. 64) are zero-padded to 128 outside the
+custom_vjp — padding q/k with zeros leaves the logits unchanged and AD
+slices the gradients back. On non-TPU backends it falls back to the XLA
+reference implementation so the same model code runs on the CPU test mesh.
 
 The reference framework has no attention kernels at all (it orchestrates
 torch models); these exist because long-context parallelism is first-class
@@ -46,8 +50,9 @@ def mha_reference(q, k, v, causal: bool = True,
     return out
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, Lk: int,
-                  causal: bool, scale: float, block_q: int):
+# --------------------------------------------------------------- forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                Lk: int, causal: bool, scale: float, block_q: int):
     qi = pl.program_id(1)
     q = q_ref[...]                      # [block_q, D]
     acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
@@ -76,24 +81,194 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, Lk: int,
 
     if causal:
         # only blocks up to (and including) the diagonal contribute
-        hi = jax.lax.min(n_kblocks,
-                         (qi + 1) * block_q // block_k + 1)
+        hi = jax.lax.min(n_kblocks, (qi + 1) * block_q // block_k + 1)
     else:
         hi = n_kblocks
     acc, m, l = jax.lax.fori_loop(0, hi, body, (acc, m, l))
-    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[...] = (acc / l).astype(o_ref.dtype)
+    lse_ref[...] = m + jnp.log(l)
 
 
+# -------------------------------------------------------------- backward
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, block_k: int, Lk: int, causal: bool, scale: float,
+                   block_q: int):
+    qi = pl.program_id(1)
+    q = q_ref[...]                          # [block_q, D]
+    do = do_ref[...]
+    lse = lse_ref[...]                      # [block_q, 1] f32
+    delta = delta_ref[...]
+    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    n_kblocks = Lk // block_k
+
+    def body(ki, acc):
+        k = k_ref[pl.ds(ki * block_k, block_k), :]
+        v = v_ref[pl.ds(ki * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse)                # [block_q, block_k]
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return acc + jnp.dot(ds.astype(k.dtype), k,
+                             preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        hi = jax.lax.min(n_kblocks, (qi + 1) * block_q // block_k + 1)
+    else:
+        hi = n_kblocks
+    acc = jax.lax.fori_loop(0, hi, body, acc)
+    dq_ref[...] = acc.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q: int, Lq: int, causal: bool,
+                    scale: float, block_k: int):
+    ki = pl.program_id(1)
+    k = k_ref[...]                          # [block_k, D]
+    v = v_ref[...]
+    D = k.shape[-1]
+    dk = jnp.zeros((k.shape[0], D), jnp.float32)
+    dv = jnp.zeros((k.shape[0], D), jnp.float32)
+    n_qblocks = Lq // block_q
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(qi * block_q, block_q), :]
+        do = do_ref[pl.ds(qi * block_q, block_q), :]
+        lse = lse_ref[pl.ds(qi * block_q, block_q), :]
+        delta = delta_ref[pl.ds(qi * block_q, block_q), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse)                # [block_q, block_k]
+        dv = dv + jnp.dot(p.astype(do.dtype).T, do,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jnp.dot(ds.astype(q.dtype).T, q,
+                          preferred_element_type=jnp.float32) * scale
+        return dk, dv
+
+    # causal: q blocks strictly before this k block contribute nothing
+    lo = (ki * block_k) // block_q if causal else 0
+    dk, dv = jax.lax.fori_loop(lo, n_qblocks, body, (dk, dv))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+# ------------------------------------------------- custom_vjp core (BH,L,D)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _flash_core(causal, block_q, block_k, scale, interpret, qf, kf, vf):
+    o, _ = _flash_fwd(causal, block_q, block_k, scale, interpret,
+                      qf, kf, vf)
+    return o
+
+
+def _flash_fwd(causal, block_q, block_k, scale, interpret, qf, kf, vf):
+    BH, Lq, D = qf.shape
+    _, Lk, _ = kf.shape
+    kernel = functools.partial(_fwd_kernel, block_k=block_k, Lk=Lk,
+                               causal=causal, scale=scale, block_q=block_q)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(BH, Lq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Lk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Lk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Lq, D), qf.dtype),
+            jax.ShapeDtypeStruct((BH, Lq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return o, (qf, kf, vf, o, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, scale, interpret, res, do):
+    qf, kf, vf, o, lse = res
+    BH, Lq, D = qf.shape
+    _, Lk, _ = kf.shape
+    # delta_i = rowsum(dO_i * O_i) — cheap, XLA fuses it
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, block_k=block_k, Lk=Lk, causal=causal, scale=scale,
+        block_q=block_q)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(BH, Lq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Lk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Lk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Lq, D), qf.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, do, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, block_q=block_q, Lq=Lq, causal=causal, scale=scale,
+        block_k=block_k)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(BH, Lk // block_k),
+        in_specs=[
+            pl.BlockSpec((None, Lq, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Lq, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Lq, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Lq, 1), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Lk, D), kf.dtype),
+            jax.ShapeDtypeStruct((BH, Lk, D), vf.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash_core.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ------------------------------------------------------------ public entry
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 256,
                     block_k: int = 256, scale: Optional[float] = None,
                     interpret: bool = False):
-    """Tiled attention. q[B,Lq,H,D], k/v[B,Lk,Hkv,D] (GQA ok)."""
+    """Tiled attention, differentiable. q[B,Lq,H,D], k/v[B,Lk,Hkv,D]
+    (GQA ok). Head dim is zero-padded up to a multiple of 128 lanes."""
     B, Lq, H, D = q.shape
     _, Lk, Hkv, _ = k.shape
     scale = scale if scale is not None else D ** -0.5
     from ray_tpu.ops.dispatch import _on_tpu
     on_tpu = _on_tpu()
-    if not (on_tpu or interpret) or Lq % 128 or Lk % 128 or D % 128:
+    if not (on_tpu or interpret) or Lq % 128 or Lk % 128:
         return mha_reference(q, k, v, causal=causal, scale=scale)
     block_q = min(block_q, Lq)
     block_k = min(block_k, Lk)
@@ -102,23 +277,17 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 256,
     if Hkv != H:
         k = jnp.repeat(k, H // Hkv, axis=2)
         v = jnp.repeat(v, H // Hkv, axis=2)
+    Dp = (D + 127) // 128 * 128
+    if Dp != D:
+        pad = [(0, 0), (0, 0), (0, 0), (0, Dp - D)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
     # layout: [B*H, L, D] so each grid cell works on one head's q block
-    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Lq, D)
-    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
-    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
-
-    kernel = functools.partial(_flash_kernel, block_k=block_k, Lk=Lk,
-                               causal=causal, scale=scale, block_q=block_q)
-    out = pl.pallas_call(
-        kernel,
-        grid=(B * H, Lq // block_q),
-        in_specs=[
-            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, Lk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, Lk, D), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
-        interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(B, H, Lq, D).transpose(0, 2, 1, 3)
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Lq, Dp)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Lk, Dp)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Lk, Dp)
+    out = _flash_core(causal, block_q, block_k, scale, interpret,
+                      qf, kf, vf)
+    out = out.reshape(B, H, Lq, Dp).transpose(0, 2, 1, 3)
+    return out[..., :D] if Dp != D else out

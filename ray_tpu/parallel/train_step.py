@@ -109,6 +109,12 @@ def state_shardings(abstract_state, mesh: Mesh, rules=None):
             return NamedSharding(mesh, P())
         leaves = jax.tree.leaves(node)
         shape = leaves[0].shape if leaves else None
+        if shape is not None and len(sp) > len(shape):
+            # logical axes outnumber the value's rank: a factored optimizer
+            # state (e.g. adafactor's row/col second-moment vectors) that
+            # inherited the param's boxes. Which axis was reduced away is
+            # unknowable here; the vectors are tiny — replicate
+            return NamedSharding(mesh, P())
         mesh_spec = _prune_indivisible(
             logical_pspec_to_mesh(sp, rules), shape, mesh)
         return NamedSharding(mesh, mesh_spec)
