@@ -78,9 +78,33 @@ _flag("lineage_max_depth", int, 16,
       "lineage re-execution storms; reference caps lineage similarly via "
       "max_lineage_bytes / task retry budgets).")
 
+# Worker hot paths
+_flag("actor_push_batch", int, 32,
+      "Max actor calls coalesced into one wire frame by the per-actor "
+      "sender (amortizes frame + dispatch overhead; reference pipelines "
+      "per-call over C++ gRPC, actor_task_submitter.h:75 — Python pays "
+      "more per frame, so we batch).")
+_flag("task_push_batch", int, 8,
+      "Max queued same-signature tasks pushed to a leased worker in one "
+      "frame.")
+_flag("inline_exec_threshold_s", float, 0.002,
+      "Actor/task methods whose running-average duration is below this "
+      "execute inline on the event loop instead of a thread-pool hop "
+      "(adaptive: first call always measures on the pool; a method that "
+      "turns slow migrates back).")
+
 # Node manager
-_flag("transfer_chunk_bytes", int, 64 * 1024 * 1024,
-      "Chunk size for node-to-node object transfer.")
+_flag("transfer_chunk_bytes", int, 8 * 1024 * 1024,
+      "Chunk size for node-to-node object transfer (reference default is "
+      "5 MiB, object_manager.h).")
+_flag("push_window_chunks", int, 4,
+      "Chunks in flight per push stream: pipelines the wire without "
+      "unbounded receiver buffering (reference: PushManager per-push "
+      "in-flight cap, push_manager.h:30).")
+_flag("pull_inflight_bytes", int, 256 * 1024 * 1024,
+      "Admission budget for concurrent inbound object transfers on one "
+      "node; pulls past it queue FIFO (reference: PullManager "
+      "admission-controlled bundles, pull_manager.h:52).")
 _flag("heartbeat_interval_s", float, 0.5,
       "Node manager -> GCS heartbeat period (also carries the resource "
       "view).")

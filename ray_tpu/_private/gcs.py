@@ -56,6 +56,7 @@ class GcsServer:
 
         self.kv: Dict[str, Dict[bytes, bytes]] = {}          # namespace -> {k: v}
         self.nodes: Dict[str, Dict] = {}                     # node_id -> info
+        self._view_version = 0        # bumps on any node-state change
         self.node_conns: Dict[str, rpc.Connection] = {}      # node_id -> conn
         self.actors: Dict[str, Dict] = {}                    # actor_id -> table row
         self.named_actors: Dict[tuple, str] = {}             # (ns, name) -> actor_id
@@ -83,6 +84,7 @@ class GcsServer:
             "drain_node": self.h_drain_node,
             "get_all_nodes": self.h_get_all_nodes,
             "get_cluster_view": self.h_get_cluster_view,
+            "get_cluster_view_delta": self.h_get_cluster_view_delta,
             "register_job": self.h_register_job,
             "finish_job": self.h_finish_job,
             "get_all_jobs": self.h_get_all_jobs,
@@ -253,28 +255,44 @@ class GcsServer:
             "last_heartbeat": time.monotonic(),
             "start_time": time.time(),
         }
+        self._touch_node(node_id)
         logger.info("node %s registered at %s (%s)", node_id[:12], address, resources)
         self._publish("NODE", node_id, {"state": "ALIVE", **_node_public(self.nodes[node_id])})
         return {"node_id": node_id, "cluster_view": self._cluster_view(),
+                "view_version": self._view_version,
                 "system_config": cfg.snapshot()}
 
-    def h_heartbeat(self, conn, node_id: str, available: Dict[str, float],
+    def h_heartbeat(self, conn, node_id: str,
+                    available: Optional[Dict[str, float]] = None,
                     total: Optional[Dict[str, float]] = None,
                     pending: Optional[List[Dict[str, float]]] = None):
+        """available=None is a liveness-only beat: the node's resource view
+        is unchanged since its last report, so the payload stays constant
+        size under idle (reference: versioned delta gossip instead of full
+        resource broadcast, src/ray/common/ray_syncer/ray_syncer.h:88)."""
         info = self.nodes.get(node_id)
         if info is None or not info["alive"]:
             return {"ok": False, "reason": "unknown or dead node"}
         info["last_heartbeat"] = time.monotonic()
-        info["available"] = available
-        info["pending_demand"] = pending or []
-        if total is not None:
+        changed = False
+        if available is not None and available != info["available"]:
+            info["available"] = available
+            changed = True
+        if pending is not None and pending != info.get("pending_demand", []):
+            info["pending_demand"] = pending
+            changed = True
+        if total is not None and total != info["total"]:
             info["total"] = total
+            changed = True
+        if changed:
+            self._touch_node(node_id)
         return {"ok": True}
 
     def h_drain_node(self, conn, node_id: str):
         info = self.nodes.get(node_id)
         if info:
             info["draining"] = True
+            self._touch_node(node_id)
         return True
 
     def h_get_all_nodes(self, conn):
@@ -283,13 +301,27 @@ class GcsServer:
     def h_get_cluster_view(self, conn):
         return self._cluster_view()
 
+    def _touch_node(self, node_id: str):
+        self._view_version += 1
+        info = self.nodes.get(node_id)
+        if info is not None:
+            info["_ver"] = self._view_version
+
+    def h_get_cluster_view_delta(self, conn, since: Optional[int] = None):
+        """Versioned view sync (reference: RaySyncer, ray_syncer.h:88).
+        since=None -> full view; otherwise only nodes whose state changed
+        after `since`. Payload is empty when nothing changed."""
+        if since is None:
+            return {"version": self._view_version,
+                    "full": self._cluster_view()}
+        # build entries only for changed nodes: with N pollers at steady
+        # state this handler must be O(changes), not O(nodes)
+        delta = {nid: _node_view(n) for nid, n in self.nodes.items()
+                 if n.get("_ver", 0) > since}
+        return {"version": self._view_version, "delta": delta}
+
     def _cluster_view(self) -> Dict[str, Dict]:
-        return {nid: {"total": n["total"], "available": n["available"],
-                      "alive": n["alive"], "draining": n["draining"],
-                      "address": n["address"],
-                      "object_store_address": n["object_store_address"],
-                      "node_ip": n["node_ip"], "labels": n["labels"]}
-                for nid, n in self.nodes.items()}
+        return {nid: _node_view(n) for nid, n in self.nodes.items()}
 
     async def _check_node_deaths(self):
         while True:
@@ -304,6 +336,7 @@ class GcsServer:
         if info is None or not info["alive"]:
             return
         info["alive"] = False
+        self._touch_node(node_id)
         logger.warning("node %s dead: %s", node_id[:12], reason)
         self.node_conns.pop(node_id, None)
         self._publish("NODE", node_id, {"state": "DEAD", "reason": reason,
@@ -660,6 +693,15 @@ class GcsServer:
     def h_get_all_placement_groups(self, conn):
         return [self.h_get_placement_group(conn, pid)
                 for pid in self.placement_groups]
+
+
+def _node_view(n: Dict) -> Dict:
+    """One node's entry in the cluster resource view."""
+    return {"total": n["total"], "available": n["available"],
+            "alive": n["alive"], "draining": n["draining"],
+            "address": n["address"],
+            "object_store_address": n["object_store_address"],
+            "node_ip": n["node_ip"], "labels": n["labels"]}
 
 
 def _node_public(n: Dict) -> Dict:

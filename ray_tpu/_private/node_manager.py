@@ -119,9 +119,14 @@ class NodeManager:
         self._lease_seq = 0
         self.bundles: Dict[tuple, Dict] = {}   # (pg_id, idx) -> {resources, available, committed}
         self.cluster_view: Dict[str, Dict] = {}
+        self._view_version: Optional[int] = None
         self._tasks: List[asyncio.Task] = []
         self._draining = False
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
+        self._pull_bytes_inflight = 0
+        self._pull_waiters: "deque" = __import__("collections").deque()
+        self._receiving: Dict[bytes, Dict] = {}
+        self._recv_done: Dict[bytes, asyncio.Future] = {}
         # queued lease demand, reported in heartbeats for the autoscaler
         self._pending_demand: List[Dict[str, float]] = []
         self._spill_mutex = threading.Lock()
@@ -145,6 +150,10 @@ class NodeManager:
             "return_bundle": self.h_return_bundle,
             "pull_object": self.h_pull_object,
             "fetch_object": self.h_fetch_object,
+            "request_push": self.h_request_push,
+            "push_begin": self.h_push_begin,
+            "push_chunk": self.h_push_chunk,
+            "broadcast_object": self.h_broadcast_object,
             "restore_object": self.h_restore_object,
             "spill_now": self.h_spill_now,
             "free_object": self.h_free_object,
@@ -175,6 +184,7 @@ class NodeManager:
             resources=self.total, labels=self.labels,
             node_ip=rpc.node_ip_address())
         self.cluster_view = resp["cluster_view"]
+        self._view_version = resp.get("view_version")
         # one head-side config governs the cluster (reference:
         # GetSystemConfig handshake, node_manager.proto:432)
         cfg.apply(resp.get("system_config") or {})
@@ -218,13 +228,25 @@ class NodeManager:
                 pass
 
     async def _heartbeat_loop(self):
+        # the resource payload rides the heartbeat only when it CHANGED
+        # since the last acked beat; idle beats are constant-size liveness
+        # pings (reference: versioned deltas over bidi streams instead of
+        # full resource broadcast, ray_syncer.h:88)
+        last_sent = None
         while True:
+            avail = self._reported_available()
+            pending = list(self._pending_demand)
+            payload = (avail, pending)
             try:
-                await self.gcs.call("heartbeat", node_id=self.node_id,
-                                    available=self._reported_available(),
-                                    pending=list(self._pending_demand))
+                if payload == last_sent:
+                    await self.gcs.call("heartbeat", node_id=self.node_id)
+                else:
+                    await self.gcs.call("heartbeat", node_id=self.node_id,
+                                        available=avail, pending=pending)
+                    last_sent = payload
             except (rpc.RpcError, rpc.ConnectionLost):
                 logger.warning("heartbeat failed; reconnecting to GCS")
+                last_sent = None
                 try:
                     self.gcs = await rpc.connect(
                         self.gcs_address, handlers=self.gcs.handlers,
@@ -249,12 +271,42 @@ class NodeManager:
         return avail
 
     async def _view_refresh_loop(self):
+        # versioned delta pull with a periodic full resync as drift guard;
+        # steady-state refreshes carry an empty delta (O(changes), not
+        # O(nodes) — reference: ray_syncer.h:88)
+        n = 0
         while True:
             await asyncio.sleep(cfg.view_refresh_s)
             try:
-                self.cluster_view = await self.gcs.call("get_cluster_view")
-            except (rpc.RpcError, rpc.ConnectionLost):
-                pass
+                since = None if (self._view_version is None
+                                 or n % 30 == 29) else self._view_version
+                resp = await self.gcs.call("get_cluster_view_delta",
+                                           since=since)
+                self._view_version = resp["version"]
+                if "full" in resp:
+                    self.cluster_view = resp["full"]
+                elif resp["delta"]:
+                    self.cluster_view.update(resp["delta"])
+                n += 1
+            except rpc.ConnectionLost:
+                self._view_version = None     # resync after reconnect
+            except rpc.RpcError:
+                # older GCS without the delta handler: fall back to full
+                try:
+                    self.cluster_view = await self.gcs.call(
+                        "get_cluster_view")
+                except Exception:
+                    pass
+            # reap half-received transfers whose pusher died mid-stream
+            # (their unsealed buffers would otherwise pin arena space)
+            now = time.monotonic()
+            for oid, rst in list(self._receiving.items()):
+                if now - rst["t"] > 60.0:
+                    self._receiving.pop(oid, None)
+                    try:
+                        self.store.abort(oid)
+                    except Exception:
+                        pass
 
     async def _reap_children_loop(self):
         while True:
@@ -399,11 +451,23 @@ class NodeManager:
                           write_timeout_s=write_timeout_s,
                           timeout=write_timeout_s + 60.0)
 
+        nids = list(targets)
         results = await asyncio.gather(
-            *(push(n, r) for n, r in targets.items()),
+            *(push(n, targets[n]) for n in nids),
             return_exceptions=True)
         errs = [r for r in results if isinstance(r, BaseException)]
         if errs:
+            # partial success leaves mirrors one version ahead of failed
+            # targets; a writer retry would then double-publish to the
+            # survivors. Close the edge instead: every reader sees
+            # ChannelClosed deterministically rather than diverging
+            ok = [n for n, r in zip(nids, results)
+                  if not isinstance(r, BaseException)]
+            if ok:
+                try:
+                    await self.h_channel_close(conn, path=path, targets=ok)
+                except Exception:
+                    pass
             raise errs[0]
         return True
 
@@ -654,6 +718,7 @@ class NodeManager:
                         return {"status": "error",
                                 "reason": "affinity node unavailable"}
                 elif target != self.node_id:
+                    self._debit_view(target, resources)
                     return {"status": "spill",
                             "spill_to": view[target]["address"]}
             if scheduling_fits(pool_avail, resources) \
@@ -691,6 +756,7 @@ class NodeManager:
                 view = self._live_view()
                 target = scheduling_pick(view, resources, scheduling, self.node_id)
                 if target is not None and target != self.node_id:
+                    self._debit_view(target, resources)
                     return {"status": "spill",
                             "spill_to": view[target]["address"]}
                 if target is None and not scheduling_feasible_anywhere(
@@ -723,6 +789,21 @@ class NodeManager:
                     self._pending_demand.remove(resources)
                 except ValueError:
                     pass
+
+    def _debit_view(self, target: str, resources: Dict[str, float]):
+        """Optimistically debit a remote node's availability in the local
+        view after deciding to spill there: a burst of lease requests
+        must not all pick the same (stale-view) target before the next
+        sync corrects it (reference: ClusterResourceScheduler's local
+        resource-view adjustment on spillback decisions)."""
+        v = self.cluster_view.get(target)
+        if v is None:
+            return
+        avail = dict(v.get("available") or {})
+        for k, amt in (resources or {}).items():
+            if k in avail:
+                avail[k] = avail[k] - amt
+        self.cluster_view[target] = {**v, "available": avail}
 
     def _live_view(self) -> Dict[str, Dict]:
         # draining nodes take no NEW work (reference: node draining in
@@ -888,9 +969,38 @@ class NodeManager:
         return True
 
     # ------------------------------------------------------- object transfer
+    # Push-based, reference-shaped (pull_manager.h:52, push_manager.h:30):
+    # a "pull" is a request for the holder to PUSH — chunks stream one-way
+    # with a bounded in-flight window instead of a request/response round
+    # trip per chunk, and inbound transfers pass a node-wide byte-budget
+    # admission gate so gang arg feeding can't blow out store memory.
+
+    async def _node_addr(self, node_id: str) -> str:
+        view = self.cluster_view.get(node_id)
+        if view is None:
+            self.cluster_view = await self.gcs.call("get_cluster_view")
+            view = self.cluster_view.get(node_id)
+        if view is None:
+            raise RuntimeError(f"unknown node {node_id}")
+        return view["address"]
+
+    async def _pull_admit(self, size: int):
+        cap = max(cfg.pull_inflight_bytes, size)   # one pull always fits
+        while self._pull_bytes_inflight > 0 and \
+                self._pull_bytes_inflight + size > cap:
+            ev = asyncio.Event()
+            self._pull_waiters.append(ev)
+            await ev.wait()
+        self._pull_bytes_inflight += size
+
+    def _pull_release(self, size: int):
+        self._pull_bytes_inflight -= size
+        while self._pull_waiters:
+            self._pull_waiters.popleft().set()
+
     async def h_pull_object(self, conn, oid: bytes, node_id: str):
-        """Pull an object from a remote node into the local store
-        (admission-deduplicated like the reference's PullManager)."""
+        """Ensure `oid` is in the local store, requesting a push from the
+        holder node (deduplicated; admission-controlled)."""
         if self.store.contains(oid):
             return True
         inflight = self._pulls_inflight.get(oid)
@@ -898,45 +1008,159 @@ class NodeManager:
             return await asyncio.shield(inflight)
         fut = asyncio.get_event_loop().create_future()
         self._pulls_inflight[oid] = fut
+        admitted = 0
         try:
-            view = self.cluster_view.get(node_id)
-            if view is None:
-                self.cluster_view = await self.gcs.call("get_cluster_view")
-                view = self.cluster_view.get(node_id)
-            if view is None:
-                raise RuntimeError(f"unknown node {node_id}")
-            addr = view["address"]
+            addr = await self._node_addr(node_id)
             meta = await self.pool.call(addr, "fetch_object", oid=oid,
                                         part="meta")
             if meta is None:
-                raise RuntimeError(f"{oid.hex()[:16]} not on node {node_id[:12]}")
-            data_size = meta["data_size"]
-            bufs = self.store.create(oid, data_size, len(meta["meta"]))
-            if bufs is not None:
-                data, meta_view = bufs
-                meta_view[:] = meta["meta"]
-                off = 0
-                while off < data_size:
-                    n = min(cfg.transfer_chunk_bytes, data_size - off)
-                    chunk = await self.pool.call(addr, "fetch_object", oid=oid,
-                                                 part="data", offset=off,
-                                                 length=n)
-                    data[off:off + len(chunk)] = chunk
-                    off += len(chunk)
-                self.store.seal(oid)
+                raise RuntimeError(
+                    f"{oid.hex()[:16]} not on node {node_id[:12]}")
+            size = meta["data_size"]
+            await self._pull_admit(size)
+            admitted = size
+            if not self.store.contains(oid):    # re-check post-admission
+                done = asyncio.get_event_loop().create_future()
+                self._recv_done[oid] = done
+                try:
+                    await self.pool.call(addr, "request_push", oid=oid,
+                                         to_node=self.node_id)
+                    if not self.store.contains(oid):
+                        await asyncio.wait_for(done, timeout=300)
+                finally:
+                    self._recv_done.pop(oid, None)
             fut.set_result(True)
             return True
         except Exception as e:
-            try:
-                self.store.abort(oid)
-            except Exception:
-                pass
+            # do NOT abort the receive state here: a concurrent broadcast
+            # may own it (push_begin "have" path); stale half-received
+            # buffers are reaped by the idle sweep in _view_refresh_loop
             fut.set_exception(e)
             raise
         finally:
+            if admitted:
+                self._pull_release(admitted)
             self._pulls_inflight.pop(oid, None)
             if not fut.done():
                 fut.cancel()
+
+    async def h_request_push(self, conn, oid: bytes, to_node: str,
+                             relay: Optional[List[str]] = None):
+        """Holder side: stream `oid` to `to_node` with a bounded chunk
+        window. `relay` rides along for tree broadcast — the receiver
+        re-broadcasts to its half of the target list after sealing."""
+        buf = self.store.get(oid)
+        if buf is None and oid in self.spilled:
+            await self.h_restore_object(conn, oid)
+            buf = self.store.get(oid)
+        if buf is None:
+            raise RuntimeError(f"{oid.hex()[:16]} not on this node")
+        try:
+            addr = await self._node_addr(to_node)
+            peer = await self.pool.get(addr)
+            size = len(buf.data)
+            status = await peer.call("push_begin", oid=oid, data_size=size,
+                                     meta=bytes(buf.metadata),
+                                     relay=relay or [])
+            if status == "full":
+                raise RuntimeError(
+                    f"receiver {to_node[:12]} has no room for "
+                    f"{oid.hex()[:16]} ({size} bytes)")
+            if status != "ok":
+                return True     # receiver already has it (or is receiving)
+            chunk = cfg.transfer_chunk_bytes
+            window = __import__("collections").deque()
+            off = 0
+
+            def _check(accepted):
+                if accepted is False:
+                    raise RuntimeError(
+                        f"receiver {to_node[:12]} aborted transfer of "
+                        f"{oid.hex()[:16]} mid-stream")
+
+            while off < size:
+                n = min(chunk, size - off)
+                f = peer.call_start_nowait(
+                    "push_chunk", {"oid": oid, "offset": off,
+                                   "data": bytes(buf.data[off:off + n])})
+                window.append(f)
+                off += n
+                if len(window) >= cfg.push_window_chunks:
+                    _check(await window.popleft())
+            for f in window:
+                _check(await f)
+            return True
+        finally:
+            buf.close()
+
+    def h_push_begin(self, conn, oid: bytes, data_size: int, meta: bytes,
+                     relay: Optional[List[str]] = None):
+        """Receiver side: allocate the arena region for an incoming push.
+        Status: "ok" (send chunks), "have" (already present/receiving),
+        "full" (no arena room — the pusher must error, not silently skip)."""
+        if self.store.contains(oid) or oid in self._receiving:
+            return "have"
+        bufs = self.store.create(oid, data_size, len(meta))
+        if bufs is None:
+            return "full"
+        data, meta_view = bufs
+        meta_view[:] = meta
+        self._receiving[oid] = {"data": data, "remaining": data_size,
+                                "relay": list(relay or []),
+                                "t": time.monotonic()}
+        if data_size == 0:
+            self._finish_receive(oid)
+        return "ok"
+
+    def h_push_chunk(self, conn, oid: bytes, offset: int, data: bytes):
+        st = self._receiving.get(oid)
+        if st is None:
+            return False
+        st["t"] = time.monotonic()
+        st["data"][offset:offset + len(data)] = data
+        st["remaining"] -= len(data)
+        if st["remaining"] <= 0:
+            # the LAST chunk's response resolves only after this node's
+            # relay subtree completes — the broadcast root's await covers
+            # the whole tree, and a subtree failure surfaces at the root
+            return self._finish_receive(oid)
+        return True
+
+    def _finish_receive(self, oid: bytes):
+        st = self._receiving.pop(oid)
+        self.store.seal(oid)
+        done = self._recv_done.get(oid)
+        if done is not None and not done.done():
+            done.set_result(True)
+        if st["relay"]:
+            relay_task = asyncio.ensure_future(
+                self.h_broadcast_object(None, oid, st["relay"]))
+            self._tasks.append(relay_task)
+            relay_task.add_done_callback(
+                lambda t: self._tasks.remove(t)
+                if t in self._tasks else None)
+            return relay_task
+        return True
+
+    async def h_broadcast_object(self, conn, oid: bytes,
+                                 targets: List[str]):
+        """Binomial-tree broadcast: push to the head of each half with the
+        rest of that half delegated as `relay` — the source sends
+        O(log n) copies instead of n (reference pattern:
+        release object_store broadcast benchmarks; reference core is
+        point-to-point only)."""
+        targets = [t for t in targets if t != self.node_id]
+        pushes = []
+        while targets:
+            mid = (len(targets) + 1) // 2
+            head, rest = targets[0], targets[1:mid]
+            pushes.append(self.h_request_push(None, oid, head, relay=rest))
+            targets = targets[mid:]
+        results = await asyncio.gather(*pushes, return_exceptions=True)
+        errs = [r for r in results if isinstance(r, BaseException)]
+        if errs:
+            raise errs[0]
+        return True
 
     async def h_fetch_object(self, conn, oid: bytes, part: str = "meta",
                              offset: int = 0, length: int = 0):

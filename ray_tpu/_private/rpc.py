@@ -31,6 +31,7 @@ logger = logging.getLogger(__name__)
 REQUEST = 0
 RESPONSE = 1
 NOTIFY = 2
+PARTIAL = 3     # [3, seq, idx, ok, payload] — streamed per-item response
 
 _MAX_FRAME = 1 << 31
 
@@ -96,8 +97,10 @@ class Connection:
         self.name = name
         self._seq = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
+        self._part_handlers: Dict[int, Callable] = {}
+        self._out: list = []          # frames awaiting the per-turn flush
         self._closed = False
-        self._writer_lock = asyncio.Lock()
+        self._drainer: Optional[asyncio.Task] = None
         self._task: Optional[asyncio.Task] = None
         self._dispatch_tasks: set = set()
         self.on_close: Optional[Callable[["Connection"], None]] = None
@@ -123,17 +126,24 @@ class Connection:
                 msg = _unpack(body)
                 mtype = msg[0]
                 if mtype == REQUEST or mtype == NOTIFY:
-                    t = asyncio.ensure_future(self._dispatch(msg))
-                    self._dispatch_tasks.add(t)
-                    t.add_done_callback(self._dispatch_tasks.discard)
+                    self._dispatch_msg(msg)
                 elif mtype == RESPONSE:
                     _, seq, ok, payload = msg
+                    self._part_handlers.pop(seq, None)
                     fut = self._pending.pop(seq, None)
                     if fut is not None and not fut.done():
                         if ok:
                             fut.set_result(payload)
                         else:
                             fut.set_exception(RpcError(*payload))
+                elif mtype == PARTIAL:
+                    _, seq, idx, ok, payload = msg
+                    h = self._part_handlers.get(seq)
+                    if h is not None:
+                        try:
+                            h(idx, ok, payload)
+                        except Exception:
+                            logger.exception("partial handler failed")
         except (asyncio.IncompleteReadError, ConnectionResetError,
                 ConnectionLost, BrokenPipeError, OSError):
             pass
@@ -145,6 +155,7 @@ class Connection:
     async def _shutdown(self):
         if self._closed:
             return
+        self._flush_out()      # last frames (e.g. a final error response)
         self._closed = True
         for fut in self._pending.values():
             if not fut.done():
@@ -164,70 +175,207 @@ class Connection:
             except Exception:
                 logger.exception("on_close callback failed for %s", self.name)
 
-    async def _dispatch(self, msg):
+    def _dispatch_msg(self, msg):
+        """Run a request/notify. Sync handlers and Future-returning handlers
+        complete without spawning a task (the hot actor-call path); only
+        true coroutines get one (reference keeps its hot path allocation-
+        free the same way, src/ray/rpc/grpc_server.h ServerCall reuse)."""
         mtype, seq, method, kwargs = msg
         handler = self.handlers.get(method)
         if handler is None:
             if mtype == REQUEST:
-                await self._send([RESPONSE, seq, False,
-                                  ("NotImplementedError", f"no handler {method!r}", "")])
+                self._respond(seq, False, ("NotImplementedError",
+                                           f"no handler {method!r}", ""))
+            return
+        if getattr(handler, "streaming", False) and mtype == REQUEST:
+            # streaming handler: receives its seq and answers with
+            # send_partial(...) + send_final(...) itself
+            try:
+                handler(self, seq, **kwargs)
+            except Exception as e:
+                self._handler_error(REQUEST, seq, method, e)
             return
         try:
             result = handler(self, **kwargs)
-            if asyncio.iscoroutine(result) or isinstance(result, Awaitable):
-                result = await result
+        except Exception as e:
+            self._handler_error(mtype, seq, method, e)
+            return
+        if isinstance(result, asyncio.Future):
             if mtype == REQUEST:
-                await self._send([RESPONSE, seq, True, result])
+                result.add_done_callback(
+                    lambda f, s=seq, m=method: self._finish_request(s, m, f))
+            return
+        if asyncio.iscoroutine(result) or isinstance(result, Awaitable):
+            t = asyncio.ensure_future(
+                self._dispatch_async(mtype, seq, method, result))
+            self._dispatch_tasks.add(t)
+            t.add_done_callback(self._dispatch_tasks.discard)
+            return
+        if mtype == REQUEST:
+            self._respond(seq, True, result)
+
+    def _finish_request(self, seq, method, fut: asyncio.Future):
+        if fut.cancelled():
+            self._handler_error(REQUEST, seq, method,
+                                asyncio.CancelledError("cancelled"))
+            return
+        exc = fut.exception()
+        if exc is not None:
+            self._handler_error(REQUEST, seq, method, exc)
+        else:
+            self._respond(seq, True, fut.result())
+
+    async def _dispatch_async(self, mtype, seq, method, coro):
+        try:
+            result = await coro
         except asyncio.CancelledError:
             raise
         except Exception as e:
-            if mtype == REQUEST:
-                await self._send([RESPONSE, seq, False,
-                                  (type(e).__name__, str(e), traceback.format_exc())])
-            else:
-                logger.exception("notify handler %s failed", method)
+            self._handler_error(mtype, seq, method, e)
+            return
+        if mtype == REQUEST:
+            self._respond(seq, True, result)
+
+    def _handler_error(self, mtype, seq, method, e: BaseException):
+        if mtype == REQUEST:
+            try:
+                self._respond(seq, False, (type(e).__name__, str(e),
+                                           traceback.format_exc()))
+            except (ConnectionLost, ConnectionError):
+                pass
+        else:
+            logger.error("notify handler %s failed: %s", method, e)
+
+    def _respond(self, seq, ok, payload):
+        try:
+            self._send_nowait([RESPONSE, seq, ok, payload])
+        except (ConnectionLost, ConnectionError):
+            pass   # peer gone; response undeliverable
+
+    def _send_nowait(self, obj):
+        """Serialize and queue for the next loop-iteration flush: every
+        frame produced in one event-loop turn (pipelined requests, a
+        burst of PARTIAL acks) leaves in ONE writelines/syscall. All
+        sends happen on the event-loop thread, so frames never
+        interleave. TCP backpressure: async senders await maybe_drain();
+        a background drainer backstops fire-and-forget sends (round-2's
+        drain()-per-message was the 0.1x pipelined-path bottleneck)."""
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} closed")
+        data = _pack(obj)
+        out = self._out
+        out.append(len(data).to_bytes(4, "little"))
+        out.append(data)
+        if len(out) == 2:       # first frame this turn: schedule the flush
+            asyncio.get_event_loop().call_soon(self._flush_out)
+
+    def _flush_out(self):
+        out = self._out
+        if not out or self._closed:
+            out.clear()
+            return
+        self._out = []
+        try:
+            self.writer.writelines(out)
+        except Exception:
+            return
+        if self._drainer is None:
+            transport = self.writer.transport
+            if transport is not None and \
+                    transport.get_write_buffer_size() > (1 << 20):
+                self._drainer = asyncio.ensure_future(self._drain_bg())
+
+    async def _drain_bg(self):
+        try:
+            await self.writer.drain()
+        except Exception:
+            pass
+        finally:
+            self._drainer = None
+
+    def over_highwater(self) -> bool:
+        transport = self.writer.transport
+        return transport is not None and \
+            transport.get_write_buffer_size() > (1 << 20)
+
+    async def maybe_drain(self):
+        """Await real TCP backpressure when the write buffer is past the
+        high-water mark — async senders call this so a slow peer throttles
+        them instead of buffering without bound."""
+        if self._out:
+            self._flush_out()
+        if self.over_highwater():
+            try:
+                await self.writer.drain()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                raise ConnectionLost(f"connection {self.name} lost")
 
     async def _send(self, obj):
-        data = _pack(obj)
-        async with self._writer_lock:
-            if self._closed:
-                raise ConnectionLost(f"connection {self.name} closed")
-            if len(data) < 65536:
-                # one buffer -> one syscall for the common small message
-                self.writer.write(len(data).to_bytes(4, "little") + data)
-            else:
-                self.writer.write(len(data).to_bytes(4, "little"))
-                self.writer.write(data)
-            await self.writer.drain()
+        self._send_nowait(obj)
+        await self.maybe_drain()
 
     async def call(self, method: str, timeout: Optional[float] = None, **kwargs) -> Any:
-        _maybe_inject_failure(method)
-        fut = await self.call_start(method, **kwargs)
+        fut = self.call_start_nowait(method, kwargs)
+        await self.maybe_drain()
         if timeout is not None:
             return await asyncio.wait_for(fut, timeout)
         return await fut
 
-    async def call_start(self, method: str, **kwargs) -> asyncio.Future:
-        """Issue the request and return the response future without awaiting
-        it — callers that must preserve send order serialize on this, then
-        pipeline the responses."""
+    def call_start_nowait(self, method: str, kwargs) -> asyncio.Future:
+        """Issue the request and return the response future — sync, so
+        submission order is the caller's statement order."""
+        _maybe_inject_failure(method)
         seq = next(self._seq)
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[seq] = fut
         try:
-            await self._send([REQUEST, seq, method, kwargs])
+            self._send_nowait([REQUEST, seq, method, kwargs])
         except BaseException:
             self._pending.pop(seq, None)
             fut.cancel()
             raise
         return fut
 
+    async def call_start(self, method: str, **kwargs) -> asyncio.Future:
+        return self.call_start_nowait(method, kwargs)
+
+    def call_start_parts(self, method: str, kwargs,
+                         on_part: Callable) -> asyncio.Future:
+        """Batched request with streamed per-item responses: `on_part(idx,
+        ok, payload)` fires as each item completes on the peer; the
+        returned future resolves when the peer sends the final RESPONSE.
+        One frame out, per-item acks back — a worker death mid-batch
+        only loses the unacked items."""
+        seq = next(self._seq)
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[seq] = fut
+        self._part_handlers[seq] = on_part
+        try:
+            self._send_nowait([REQUEST, seq, method, kwargs])
+        except BaseException:
+            self._pending.pop(seq, None)
+            self._part_handlers.pop(seq, None)
+            fut.cancel()
+            raise
+        return fut
+
+    def send_partial(self, seq: int, idx: int, ok: bool, payload):
+        try:
+            self._send_nowait([PARTIAL, seq, idx, ok, payload])
+        except (ConnectionLost, ConnectionError):
+            pass
+
+    def send_final(self, seq: int, payload):
+        self._respond(seq, True, payload)
+
     async def notify(self, method: str, **kwargs):
-        await self._send([NOTIFY, 0, method, kwargs])
+        self._send_nowait([NOTIFY, 0, method, kwargs])
+        await self.maybe_drain()
 
     async def close(self):
         me = asyncio.current_task()
-        victims = [t for t in [self._task, *self._dispatch_tasks]
+        victims = [t for t in [self._task, self._drainer,
+                               *self._dispatch_tasks]
                    if t is not None and t is not me and not t.done()]
         for t in victims:
             t.cancel()
@@ -260,6 +408,9 @@ class Server:
         self.on_disconnect: Optional[Callable[[Connection], None]] = None
 
     async def _on_client(self, reader, writer):
+        sock = writer.get_extra_info("socket")
+        if sock is not None and sock.family != socket.AF_UNIX:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         conn = Connection(reader, writer, self.handlers,
                           name=f"{self.name}-peer").start()
         self.connections.add(conn)

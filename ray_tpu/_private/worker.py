@@ -25,7 +25,9 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import functools
 import hashlib
+import heapq
 import logging
 import os
 import threading
@@ -73,6 +75,17 @@ def _encode_arg(arg, ref_hook) -> list:
     s = serialization.serialize(arg, ref_hook=ref_hook)
     kind, pkl, bufs = s.to_wire()
     return ["v", kind, pkl, bufs]
+
+
+class _InlineBridgeError(BaseException):
+    """Raised when inline-executed task code calls a blocking sync API
+    (which bridges onto the event loop it is already running on).
+    BaseException so user-level `except Exception` can't swallow it and
+    complete the task with wrong results."""
+
+
+# execution-thread context: which method is running (bridge-use tracking)
+_exec_tls = threading.local()
 
 
 class PendingTask:
@@ -190,11 +203,48 @@ class CoreWorker:
         self._orig_visible: Dict[str, Optional[str]] = {}
         self._visible_dirty: set = set()
         self._cancelled_tasks: set = set()
+        self._exec_ema: Dict[str, float] = {}   # method -> avg duration
+        self._inline_ok = True    # off for max_concurrency>1 actors
+        self._inline_unsafe: set = set()   # methods seen using sync APIs
+        self._loop_thread_ident: Optional[int] = None
         self._shutdown = False
         # every fire-and-forget coroutine is tracked here so stop_async can
         # cancel-and-await it — shutdown must leave zero pending tasks
         # (the asyncio analogue of the reference's tsan-clean shutdown)
         self._bg: set = set()
+        # submissions from user threads coalesce here: N bursts become one
+        # loop wakeup instead of N call_soon_threadsafe socketpair writes
+        self._submit_buf: List[tuple] = []
+        self._submit_scheduled = False
+        self._submit_lock = threading.Lock()
+
+    def _enqueue_submit(self, fn, *args):
+        with self._submit_lock:
+            self._submit_buf.append((fn, args))
+            if self._submit_scheduled:
+                return
+            self._submit_scheduled = True
+        try:
+            self.loop.call_soon_threadsafe(self._drain_submits)
+        except BaseException:
+            # loop closing: reset so later submits fail loudly here
+            # instead of queueing behind a flag nobody will drain
+            with self._submit_lock:
+                self._submit_scheduled = False
+            raise
+
+    def _drain_submits(self):
+        while True:
+            with self._submit_lock:
+                buf, self._submit_buf = self._submit_buf, []
+                if not buf:
+                    self._submit_scheduled = False
+                    return
+            for fn, args in buf:
+                try:
+                    fn(*args)
+                except Exception:
+                    logger.exception("deferred submit failed")
 
     def _spawn(self, coro) -> "asyncio.Task":
         t = asyncio.ensure_future(coro)
@@ -206,6 +256,7 @@ class CoreWorker:
     async def start_async(self):
         handlers = {
             "push_task": self.h_push_task,
+            "push_tasks": self.h_push_tasks,
             "become_actor": self.h_become_actor,
             "wait_object": self.h_wait_object,
             "cancel_task": self.h_cancel_task,
@@ -217,6 +268,7 @@ class CoreWorker:
             "ping": lambda conn: "pong",
         }
         self.loop = asyncio.get_event_loop()
+        self._loop_thread_ident = threading.get_ident()
         self.server = rpc.Server(handlers, name=f"worker-{self.worker_id[:8]}")
         self.address = await self.server.listen_tcp("0.0.0.0", 0)
         self.gcs = await rpc.connect(self.gcs_address,
@@ -248,6 +300,7 @@ class CoreWorker:
         self._consumers = [self._spawn(self._exec_consumer())]
         self._lease_reaper = self._spawn(self._reap_leases())
         self._task_events: List[Dict] = []
+        self._task_events_dropped = 0
         self._event_flusher = self._spawn(self._flush_task_events())
         self._install_ref_hooks()
         self._subscribed_actor_channel = False
@@ -342,10 +395,16 @@ class CoreWorker:
     # ------------------------------------------------------------ task events
     def _record_task_event(self, task_id: bytes, state: str, **extra):
         """Buffered task state transitions, flushed to the GCS task-event
-        sink (reference: TaskEventBuffer,
+        sink. Bounded: under throughput bursts old events drop rather than
+        letting the buffer (and its per-flush msgpack cost) grow without
+        limit (reference: TaskEventBuffer max size + dropped counter,
         src/ray/core_worker/task_event_buffer.h:220)."""
-        self._task_events.append({"task_id": task_id.hex(), "state": state,
-                                  "ts": time.time(), **extra})
+        ev = self._task_events
+        if len(ev) >= 10000:
+            del ev[:5000]
+            self._task_events_dropped += 5000
+        ev.append({"task_id": task_id.hex(), "state": state,
+                   "ts": time.time(), **extra})
 
     async def _flush_task_events(self):
         while not self._shutdown:
@@ -370,6 +429,8 @@ class CoreWorker:
         async with self._gcs_reconnect_lock:
             if self.gcs is not None and not self.gcs.closed:
                 return   # a concurrent caller already reconnected
+            if self._shutdown:
+                raise rpc.ConnectionLost("worker is shutting down")
             logger.warning("GCS connection lost; reconnecting")
             self.gcs = await rpc.connect(self.gcs_address,
                                          handlers={"pubsub": self.h_pubsub},
@@ -920,13 +981,16 @@ class CoreWorker:
         spec, return_ids, arg_refs, refs = self._build_task_spec(
             func, args, kwargs, num_returns, name)
 
-        def _kickoff():
-            self._spawn(self._finish_task_submit(
-                func, spec, return_ids, arg_refs, resources, max_retries,
-                scheduling, runtime_env))
-
-        self.loop.call_soon_threadsafe(_kickoff)
+        self._enqueue_submit(
+            self._kickoff_task_submit, func, spec, return_ids, arg_refs,
+            resources, max_retries, scheduling, runtime_env)
         return refs
+
+    def _kickoff_task_submit(self, func, spec, return_ids, arg_refs,
+                             resources, max_retries, scheduling, runtime_env):
+        self._spawn(self._finish_task_submit(
+            func, spec, return_ids, arg_refs, resources, max_retries,
+            scheduling, runtime_env))
 
     async def submit_task_async(self, func, args, kwargs, num_returns=1,
                                 resources=None, max_retries=None,
@@ -1058,23 +1122,36 @@ class CoreWorker:
                     continue
                 lease_ok = True
                 while st["queue"] and lease_ok:
-                    pt = st["queue"].popleft()
+                    # batch into one frame ONLY when client-side
+                    # parallelism is exhausted (every dispatcher slot
+                    # busy): with slots free, queued tasks belong on
+                    # OTHER leases — possibly other nodes (spillback,
+                    # spread) — not serialized behind this one. Acks
+                    # stream back per-task either way
+                    batch = [st["queue"].popleft()]
+                    if st["dispatchers"] >= cfg.max_dispatchers_per_sig:
+                        while st["queue"] and \
+                                len(batch) < cfg.task_push_batch:
+                            batch.append(st["queue"].popleft())
                     st["busy"] += 1
                     # work remains behind us: make sure it isn't stuck
                     # waiting for this (possibly dependent) task
                     if st["queue"]:
                         self._maybe_spawn_dispatcher(sig, st)
                     try:
-                        lease_ok = await self._run_on_lease(pt, lease, st)
+                        lease_ok = await self._run_on_lease(batch, lease,
+                                                            st)
                     except Exception as e:
                         # unexpected failure must not strand the queue:
-                        # fail this task, drop the (suspect) lease, keep
+                        # fail these tasks, drop the (suspect) lease, keep
                         # draining with a fresh one
                         logger.exception("dispatcher error running %s",
-                                         pt.spec.get("name"))
-                        self._fail_task(pt, RuntimeError(
-                            f"dispatch failed: {e}"))
-                        self.pending_tasks.pop(pt.spec["task_id"], None)
+                                         batch[0].spec.get("name"))
+                        for pt in batch:
+                            self._fail_task(pt, RuntimeError(
+                                f"dispatch failed: {e}"))
+                            self.pending_tasks.pop(pt.spec["task_id"],
+                                                   None)
                         await self._drop_lease(lease, dead=True)
                         lease_ok = False
                     finally:
@@ -1096,38 +1173,71 @@ class CoreWorker:
             elif not st["queue"] and st["dispatchers"] == 0:
                 self._sig_queues.pop(sig, None)
 
-    async def _run_on_lease(self, pt: PendingTask, lease, st) -> bool:
-        """Run one task on a held lease. Returns False if the lease died
-        (caller must stop using it). The pending_tasks entry stays alive
-        only while the task can still run (requeued for retry)."""
-        task_id = pt.spec["task_id"]
-        if pt.cancelled:
-            self._fail_task(pt, TaskCancelledError(pt.spec["name"]))
-            self.pending_tasks.pop(task_id, None)
+    async def _run_on_lease(self, pts: List[PendingTask], lease, st) -> bool:
+        """Run a batch of tasks on a held lease (one frame, serial
+        execution on the worker). Returns False if the lease died (caller
+        must stop using it). Each pending_tasks entry stays alive only
+        while its task can still run (requeued for retry)."""
+        run = []
+        for pt in pts:
+            if pt.cancelled:
+                self._fail_task(pt, TaskCancelledError(pt.spec["name"]))
+                self.pending_tasks.pop(pt.spec["task_id"], None)
+            else:
+                run.append(pt)
+        if not run:
             return True
+
+        def on_part(idx, ok, payload):
+            pt = run[idx]
+            if pt.done:
+                return
+            if ok:
+                self._complete_task(pt, payload)
+            else:
+                self._fail_task(pt, RuntimeError(
+                    f"{payload[0]}: {payload[1]}"
+                    if isinstance(payload, list) else str(payload)))
+            self.pending_tasks.pop(pt.spec["task_id"], None)
+
         try:
-            if lease.resource_ids:
-                pt.spec["accelerator_ids"] = lease.resource_ids
-            pt.current_worker = lease.worker_address
+            for pt in run:
+                if lease.resource_ids:
+                    pt.spec["accelerator_ids"] = lease.resource_ids
+                pt.current_worker = lease.worker_address
             conn = await self.pool.get(lease.worker_address)
-            resp = await conn.call("push_task", spec=pt.spec)
+            if len(run) == 1:
+                resp = await conn.call("push_task", spec=run[0].spec)
+                self._complete_task(run[0], resp)
+                self.pending_tasks.pop(run[0].spec["task_id"], None)
+            else:
+                # one frame out; per-task acks stream back as PARTIALs
+                # (a fast task completes the moment IT finishes, and a
+                # worker death only retries unacked tasks)
+                await conn.call_start_parts(
+                    "push_tasks", {"specs": [p.spec for p in run]},
+                    on_part)
         except (rpc.ConnectionLost, ConnectionError, rpc.RpcError) as e:
             await self._drop_lease(lease, dead=True)
+            stragglers = [pt for pt in run if not pt.done]
             if isinstance(e, rpc.RpcError):
-                self._fail_task(pt, RuntimeError(f"push failed: {e}"))
-                self.pending_tasks.pop(task_id, None)
-            elif pt.retries_left > 0:
-                pt.retries_left -= 1
-                logger.warning("task %s worker died; retrying (%d left)",
-                               pt.spec["name"], pt.retries_left)
-                st["queue"].appendleft(pt)   # keep pending for retry
-            else:
-                self._fail_task(pt, WorkerCrashedError(
-                    f"worker died running {pt.spec['name']}"))
-                self.pending_tasks.pop(task_id, None)
+                for pt in stragglers:
+                    self._fail_task(pt, RuntimeError(f"push failed: {e}"))
+                    self.pending_tasks.pop(pt.spec["task_id"], None)
+                return False
+            retried = 0
+            for pt in reversed(stragglers):   # keep submission order
+                if pt.retries_left > 0:
+                    pt.retries_left -= 1
+                    st["queue"].appendleft(pt)   # keep pending for retry
+                    retried += 1
+                else:
+                    self._fail_task(pt, WorkerCrashedError(
+                        f"worker died running {pt.spec['name']}"))
+                    self.pending_tasks.pop(pt.spec["task_id"], None)
+            if retried:
+                logger.warning("worker died; retrying %d task(s)", retried)
             return False
-        self._complete_task(pt, resp)
-        self.pending_tasks.pop(task_id, None)
         return True
 
     def _complete_task(self, pt: PendingTask, resp: Dict):
@@ -1171,6 +1281,34 @@ class CoreWorker:
             if e is not None:
                 e["submitted"] = max(0, e.get("submitted", 0) - 1)
                 self._maybe_free(r.id)
+
+    async def broadcast_async(self, ref: ObjectRef, node_ids: List[str]):
+        """Owner-directed broadcast: fan a shm-resident object out to
+        `node_ids` through the node managers' binomial push tree (gang arg
+        feeding / weight distribution; reference has point-to-point
+        Push/Pull only, object_manager.h:117)."""
+        entry = self.owned.get(ref.id)
+        loc = entry.get("location") if entry is not None else None
+        if loc is None and self.store is not None \
+                and self.store.contains(ref.id):
+            loc = self.node_id
+        if loc is None:
+            raise ValueError(
+                "broadcast requires a sealed shm object (inline objects "
+                "travel with their task specs)")
+        targets = [n for n in node_ids if n != loc]
+        if not targets:
+            return
+        if loc == self.node_id:
+            await self.node_conn.call("broadcast_object", oid=ref.id,
+                                      targets=targets)
+        else:
+            view = await self.gcs_call_async("get_cluster_view")
+            holder = view.get(loc)
+            if holder is None:
+                raise RuntimeError(f"holder node {loc[:12]} unknown")
+            await self.pool.call(holder["address"], "broadcast_object",
+                                 oid=ref.id, targets=targets)
 
     async def cancel_task_async(self, ref: ObjectRef, force: bool = False):
         task_id = ids.task_id_of_object(ref.id)
@@ -1388,15 +1526,14 @@ class CoreWorker:
                                      args, kwargs, num_returns=1,
                                      max_task_retries=0) -> List[ObjectRef]:
         """Fire-and-forget actor submission from a user thread — no loop
-        round trip per call. Ordering: call_soon_threadsafe is FIFO and
+        round trip per call. Ordering: the submit buffer is FIFO and
         _finish_actor_submit enqueues synchronously, so calls from one
         thread start in submission order (the reference's
         SequentialActorSubmitQueue guarantee)."""
         spec, return_ids, arg_refs, refs = self._build_actor_task_spec(
             actor_id, method, args, kwargs, num_returns)
-        self.loop.call_soon_threadsafe(
-            self._finish_actor_submit, spec, return_ids, arg_refs,
-            max_task_retries)
+        self._enqueue_submit(self._finish_actor_submit, spec, return_ids,
+                             arg_refs, max_task_retries)
         return refs
 
     async def submit_actor_task_async(self, actor_id: str, method: str,
@@ -1445,7 +1582,6 @@ class CoreWorker:
         concurrently so calls pipeline. Retries of calls that died with a
         connection re-enter by sequence number ahead of later fresh
         submissions."""
-        import heapq
         while True:
             while not st.retry and not st.pending:
                 st.work.clear()
@@ -1469,7 +1605,6 @@ class CoreWorker:
                 address = st.address
                 try:
                     conn = await self.pool.get(address)
-                    fut = await conn.call_start("push_task", spec=pt.spec)
                 except (rpc.ConnectionLost, ConnectionError) as e:
                     if not self._note_actor_conn_loss(st, address):
                         continue
@@ -1480,9 +1615,73 @@ class CoreWorker:
                     self._fail_task(pt, ActorDiedError(
                         f"actor {actor_id[:12]} connection lost: {e}"))
                     break
-                self._spawn(
-                    self._finish_actor_task(pt, fut, actor_id, st, address))
+                if st.retry and st.retry[0][0] < pt.seq:
+                    # pool.get suspended (fresh connection): earlier
+                    # in-flight calls may have failed into the retry heap
+                    # meanwhile — they must go first
+                    heapq.heappush(st.retry, (pt.seq, pt))
+                    _, pt = heapq.heappop(st.retry)
+                    continue
+                # coalesce immediately-sendable successors into one frame
+                # (order preserved; only when no retry is waiting and the
+                # next calls' deps are already satisfied). Per-call acks
+                # stream back as PARTIALs, so batching never delays or
+                # coarsens completion
+                batch = [pt]
+                while (not st.retry and st.pending
+                       and len(batch) < cfg.actor_push_batch
+                       and self._deps_ready(st.pending[0])):
+                    batch.append(st.pending.popleft())
+                try:
+                    if len(batch) == 1:
+                        fut = conn.call_start_nowait("push_task",
+                                                     {"spec": pt.spec})
+                    else:
+                        fut = conn.call_start_parts(
+                            "push_tasks",
+                            {"specs": [p.spec for p in batch]},
+                            functools.partial(self._on_actor_part, batch))
+                except (rpc.ConnectionLost, ConnectionError) as e:
+                    if not self._note_actor_conn_loss(st, address):
+                        continue
+                    requeued = False
+                    for p in batch:
+                        if p.retries_left != 0:
+                            if p.retries_left > 0:
+                                p.retries_left -= 1
+                            heapq.heappush(st.retry, (p.seq, p))
+                            requeued = True
+                        else:
+                            self._fail_task(p, ActorDiedError(
+                                f"actor {actor_id[:12]} connection lost:"
+                                f" {e}"))
+                    if requeued:
+                        st.work.set()
+                        continue
+                    break
+                # completion rides the response future's callback — no
+                # task per in-flight call (reference pipelines the same
+                # way, actor_task_submitter.h:75)
+                fut.add_done_callback(
+                    functools.partial(self._on_actor_reply, batch,
+                                      actor_id, st, address))
+                try:
+                    await conn.maybe_drain()   # backpressure: slow peer
+                except (rpc.ConnectionLost, ConnectionError):
+                    pass   # the reply callback handles the failure
                 break
+
+    def _deps_ready(self, pt: "PendingTask") -> bool:
+        """True when every arg ref is locally known-complete (the batch
+        fast path; anything else goes through _resolve_dependencies)."""
+        for r in pt.arg_refs:
+            e = self.owned.get(r.id)
+            if e is not None:
+                if not e.get("complete"):
+                    return False
+            elif r.owner_address and r.owner_address != self.address:
+                return False
+        return True
 
     def _note_actor_conn_loss(self, st: ActorHandleState, address) -> bool:
         """Mark the actor's address suspect after a connection failure.
@@ -1494,30 +1693,60 @@ class CoreWorker:
         self._spawn(self._probe_actor(st.actor_id))
         return True
 
-    async def _finish_actor_task(self, pt: PendingTask, fut, actor_id: str,
-                                 st: ActorHandleState, address: str):
-        try:
-            resp = await fut
-        except (rpc.ConnectionLost, ConnectionError) as e:
+    def _on_actor_part(self, batch: List[PendingTask], idx: int, ok: bool,
+                       payload):
+        """Streamed per-call ack from a batched frame."""
+        pt = batch[idx]
+        if pt.done:
+            return
+        if ok:
+            self._complete_task(pt, payload)
+        else:
+            self._fail_task(pt, RuntimeError(
+                f"{payload[0]}: {payload[1]}" if isinstance(payload, list)
+                else str(payload)))
+
+    def _on_actor_reply(self, batch: List[PendingTask], actor_id: str,
+                        st: ActorHandleState, address: str, fut):
+        """Final-response callback for one frame (1..N coalesced calls):
+        completes (single-call frames) or fails/requeues stragglers whose
+        per-call ack never arrived."""
+        exc = (asyncio.CancelledError("connection closed")
+               if fut.cancelled() else fut.exception())
+        if exc is None:
+            if len(batch) == 1 and not batch[0].done:
+                self._complete_task(batch[0], fut.result())
+            return   # batched calls completed via their PARTIALs
+        pending = [pt for pt in batch if not pt.done]
+        if not pending:
+            return
+        if isinstance(exc, (rpc.ConnectionLost, ConnectionError,
+                            asyncio.CancelledError)):
             self._note_actor_conn_loss(st, address)
-            if pt.retries_left != 0:
-                if pt.retries_left > 0:
-                    pt.retries_left -= 1
-                # re-run after restart IN SUBMISSION ORDER: a dying
-                # connection fails a pipeline of in-flight calls in
-                # arbitrary completion order; the seq heap restores it
-                # and jumps ahead of later fresh submissions
-                import heapq
-                heapq.heappush(st.retry, (pt.seq, pt))
+            requeued = False
+            for pt in pending:
+                if pt.retries_left != 0:
+                    if pt.retries_left > 0:
+                        pt.retries_left -= 1
+                    # re-run after restart IN SUBMISSION ORDER: a dying
+                    # connection fails a pipeline of in-flight calls in
+                    # arbitrary completion order; the seq heap restores
+                    # it and jumps ahead of later fresh submissions.
+                    # Calls acked by a PARTIAL never re-run.
+                    heapq.heappush(st.retry, (pt.seq, pt))
+                    requeued = True
+                else:
+                    self._fail_task(pt, ActorDiedError(
+                        f"actor {actor_id[:12]} died mid-call: {exc}"))
+            if requeued:
                 st.work.set()
-                return
-            self._fail_task(pt, ActorDiedError(
-                f"actor {actor_id[:12]} died mid-call: {e}"))
             return
-        except rpc.RpcError as e:
-            self._fail_task(pt, RuntimeError(str(e)))
-            return
-        self._complete_task(pt, resp)
+        for pt in pending:
+            if isinstance(exc, rpc.RpcError):
+                self._fail_task(pt, RuntimeError(str(exc)))
+            else:
+                self._fail_task(pt, exc if isinstance(exc, Exception)
+                                else RuntimeError(repr(exc)))
 
     async def _probe_actor(self, actor_id: str):
         """Refresh actor state from GCS after a connection loss."""
@@ -1540,10 +1769,45 @@ class CoreWorker:
                             no_restart=no_restart)
 
     # --------------------------------------------------------- execution side
-    async def h_push_task(self, conn, spec: Dict):
+    def h_push_task(self, conn, spec: Dict):
+        # sync handler returning a Future: the rpc layer responds from the
+        # future's done-callback, so the hot execution path spawns no
+        # per-call dispatch task
         fut = self.loop.create_future()
-        await self._exec_queue.put((spec, fut))
-        return await fut
+        self._exec_queue.put_nowait((spec, fut))
+        return fut
+
+    def h_push_tasks(self, conn, seq, specs: List[Dict]):
+        """Batched push with STREAMED acks: one frame in, a PARTIAL out
+        per task as it completes (so a fast task's ack never waits for a
+        slow one sharing its frame, and a worker death mid-batch only
+        loses unacked tasks), then a final response."""
+        state = {"remaining": len(specs)}
+
+        def make_cb(idx):
+            def cb(fut):
+                if fut.cancelled():
+                    conn.send_partial(seq, idx, False,
+                                      ("CancelledError", "cancelled", ""))
+                else:
+                    exc = fut.exception()
+                    if exc is not None:
+                        conn.send_partial(
+                            seq, idx, False,
+                            (type(exc).__name__, str(exc), ""))
+                    else:
+                        conn.send_partial(seq, idx, True, fut.result())
+                state["remaining"] -= 1
+                if state["remaining"] == 0:
+                    conn.send_final(seq, len(specs))
+            return cb
+
+        for idx, spec in enumerate(specs):
+            fut = self.loop.create_future()
+            fut.add_done_callback(make_cb(idx))
+            self._exec_queue.put_nowait((spec, fut))
+
+    h_push_tasks.streaming = True
 
     def h_cancel_task(self, conn, task_id: bytes, force: bool = False):
         """Cancel a queued (not yet started) task on this worker
@@ -1800,13 +2064,47 @@ class CoreWorker:
                 asyncio.iscoroutinefunction(fn):
             value = await fn(*args, **kwargs)
         else:
+            key = spec.get("method") or spec.get("func_id")
+
             def _call():
                 token = self._apply_runtime_env(spec)
+                prev = getattr(_exec_tls, "method_key", None)
+                _exec_tls.method_key = key
                 try:
                     return fn(*args, **kwargs)
                 finally:
+                    _exec_tls.method_key = prev
                     self._restore_runtime_env(token)
-            value = await self.loop.run_in_executor(self.executor, _call)
+            # adaptive inline execution: methods with a sub-threshold
+            # running-average duration skip the thread-pool round trip
+            # (two loop wakeups + condvar, ~100us on a busy box). A method
+            # that turns slow migrates back to the pool on the next call.
+            # Inline code CANNOT use blocking sync APIs (they bridge onto
+            # this very loop), so a method OBSERVED using the bridge
+            # during its pool runs is marked inline-unsafe for good; the
+            # rare first-ever bridge call while inline fail-fasts into a
+            # clean task error (never a silent re-run — side effects must
+            # not double, reference retry semantics are opt-in)
+            ema = self._exec_ema.get(key)
+            t0 = time.perf_counter()
+            if (ema is not None and self._inline_ok
+                    and key not in self._inline_unsafe
+                    and ema < cfg.inline_exec_threshold_s):
+                try:
+                    value = _call()
+                except _InlineBridgeError:
+                    self._inline_unsafe.add(key)
+                    raise RuntimeError(
+                        f"{spec.get('name')}: blocking ray_tpu API call "
+                        "from inline execution; the method is now marked "
+                        "for thread-pool execution — retry the call")
+            else:
+                value = await self.loop.run_in_executor(self.executor,
+                                                        _call)
+            dt = time.perf_counter() - t0
+            if key is not None:
+                self._exec_ema[key] = dt if ema is None \
+                    else 0.8 * ema + 0.2 * dt
         self.current_task_name = None
         self.current_task_id = None
         nret = len(spec["return_ids"])
@@ -1891,6 +2189,7 @@ class CoreWorker:
         self.actor_spec = spec
         maxc = spec.get("max_concurrency", 1)
         if maxc > 1:
+            self._inline_ok = False    # parallel methods need real threads
             self.executor = concurrent.futures.ThreadPoolExecutor(
                 max_workers=maxc, thread_name_prefix="actor-exec")
             for _ in range(maxc - 1):
@@ -1949,10 +2248,12 @@ class CoreWorker:
         # (owns_loop=False) only our tracked tasks are ours to judge
         pool = asyncio.all_tasks() if private_loop else self._bg
         leaked = [t for t in pool if t is not me and not t.done()]
+        names = [f"{t.get_name()}:{getattr(t.get_coro(), '__qualname__', t.get_coro())}"
+                 for t in leaked]
         if leaked:
             logger.warning("shutdown leaked %d pending tasks: %s",
-                           len(leaked), [t.get_name() for t in leaked][:8])
-        return [t.get_name() for t in leaked]
+                           len(leaked), names[:8])
+        return names
 
 
 global_worker: Optional["Worker"] = None
@@ -1990,6 +2291,17 @@ class Worker:
         return w
 
     def _run(self, coro, timeout=None):
+        key = getattr(_exec_tls, "method_key", None)
+        if key is not None:
+            # task code used a blocking sync API on a pool thread: this
+            # method must never migrate to inline execution
+            self.core._inline_unsafe.add(key)
+        if threading.get_ident() == self.core._loop_thread_ident:
+            # inline-executed task code blocking on its own loop would
+            # deadlock; fail fast (converted to a task error by _execute)
+            coro.close()
+            raise _InlineBridgeError(
+                "blocking sync API called from inline task execution")
         return asyncio.run_coroutine_threadsafe(
             coro, self.core.loop).result(timeout)
 
@@ -2025,6 +2337,9 @@ class Worker:
 
     def kill_actor(self, actor_id, no_restart=True):
         return self._run(self.core.kill_actor_async(actor_id, no_restart))
+
+    def broadcast(self, ref, node_ids):
+        return self._run(self.core.broadcast_async(ref, node_ids))
 
     def cancel(self, ref, force=False):
         return self._run(self.core.cancel_task_async(ref, force))

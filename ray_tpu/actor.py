@@ -90,10 +90,19 @@ class ActorHandle:
                 # cloudpickle of a task argument) — so a blocking bridge
                 # here deadlocks the loop on itself
                 w = ray_tpu._get_worker()
+                if w.core._shutdown:
+                    # too late to reach the GCS; finish_job reaps the
+                    # job's actors server-side (spawning here would leak
+                    # a task through the drained shutdown)
+                    return
                 import asyncio
-                asyncio.run_coroutine_threadsafe(
-                    w.core.kill_actor_async(self._actor_id, no_restart=True),
-                    w.core.loop)
+
+                def _kick():
+                    if not w.core._shutdown:
+                        w.core._spawn(w.core.kill_actor_async(
+                            self._actor_id, no_restart=True))
+
+                w.core.loop.call_soon_threadsafe(_kick)
         except Exception:
             pass
 

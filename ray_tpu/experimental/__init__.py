@@ -1,3 +1,14 @@
 from ray_tpu.experimental.channel import Channel, ReaderView
 
-__all__ = ["Channel", "ReaderView"]
+
+def broadcast_object(ref, node_ids):
+    """Push `ref`'s object to every node in `node_ids` through the
+    binomial broadcast tree (owner-directed; see
+    node_manager.h_broadcast_object)."""
+    import ray_tpu._private.worker as _w
+    if _w.global_worker is None:
+        raise RuntimeError("ray_tpu.init() first")
+    return _w.global_worker.broadcast(ref, node_ids)
+
+
+__all__ = ["Channel", "ReaderView", "broadcast_object"]

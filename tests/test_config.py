@@ -17,7 +17,7 @@ from ray_tpu._private.config import Config, cfg, flags
 def test_defaults_and_registry():
     assert cfg.lease_idle_timeout_s == 1.0
     assert cfg.task_max_retries == 3
-    assert cfg.transfer_chunk_bytes == 64 * 1024 * 1024
+    assert cfg.transfer_chunk_bytes == 8 * 1024 * 1024
     assert len(flags()) >= 20
     with pytest.raises(AttributeError):
         cfg.no_such_flag

@@ -274,3 +274,27 @@ def test_actor_restart_preserves_call_order(ray_start):
     assert log, "kill landed after all appends; nothing exercised"
     assert log == list(range(log[0], 40)), log
     assert log[0] > 0, "kill landed before any append completed"
+
+
+def test_fast_method_using_sync_api_never_double_executes(ray_start):
+    """A quick actor method that calls a blocking sync API (ray_tpu.get)
+    must stay on the thread pool (inline execution would deadlock or
+    double-run side effects): the bridge marks it inline-unsafe during
+    its first pool runs and every call executes exactly once."""
+    @ray_tpu.remote(num_cpus=0.1)
+    class G:
+        def __init__(self):
+            self.count = 0
+
+        def bump_and_get(self, refs):
+            # nested refs stay unresolved (top-level args resolve to
+            # values), so the method itself must call the blocking get
+            self.count += 1
+            return self.count, ray_tpu.get(refs[0])
+
+    g = G.remote()
+    ref = ray_tpu.put(7)
+    outs = ray_tpu.get([g.bump_and_get.remote([ref]) for _ in range(30)],
+                       timeout=60)
+    assert [c for c, _ in outs] == list(range(1, 31))
+    assert all(v == 7 for _, v in outs)
