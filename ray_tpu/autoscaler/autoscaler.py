@@ -32,10 +32,12 @@ class AutoscalerConfig:
 
 class Autoscaler:
     def __init__(self, config: AutoscalerConfig, provider,
-                 protected_node_ids: Optional[List[str]] = None):
+                 protected_node_ids: Optional[List[str]] = None,
+                 nodes_fn=None):
         self.config = config
         self.provider = provider
         self.protected = set(protected_node_ids or [])
+        self._nodes_fn = nodes_fn    # None -> the driver's node table
         self._launched: Dict[str, str] = {}   # node_id -> node_type
         # launched but not yet registered in the node table; counted as
         # capacity during binpacking so a slow-booting node (minutes for a
@@ -44,6 +46,8 @@ class Autoscaler:
         self._idle_since: Dict[str, float] = {}
 
     def _cluster_nodes(self) -> List[Dict]:
+        if self._nodes_fn is not None:
+            return self._nodes_fn()
         import ray_tpu
         return ray_tpu.nodes()
 
@@ -157,8 +161,19 @@ class Autoscaler:
                                      provider_id)
                     continue
                 self._launched.pop(provider_id, None)
+                # drop idle state for EVERY member of a terminated slice,
+                # not just the triggering host (stale entries would
+                # otherwise accumulate for the life of the reconciler)
+                if provider_id != nid:
+                    for m in slice_of.get(provider_id, []):
+                        self._idle_since.pop(m["node_id"], None)
                 self._idle_since.pop(nid, None)
                 actions["terminated"].append(provider_id)
+        # prune idle entries for nodes no longer alive (dead or terminated
+        # out-of-band): _idle_since must not grow without bound
+        alive_ids = {n["node_id"] for n in alive}
+        for nid in [k for k in self._idle_since if k not in alive_ids]:
+            self._idle_since.pop(nid, None)
         return actions
 
     def run(self, stop_event=None):

@@ -1,0 +1,207 @@
+"""Cluster launcher: bring a cluster up/down from a YAML spec
+(reference: `ray up` — python/ray/autoscaler/_private/commands.py with
+schema ray-schema.json; the v2 instance-manager reconciler supplies the
+runtime scaling here via autoscaler.Autoscaler).
+
+Schema (YAML):
+
+    cluster_name: my-cluster
+    provider:
+      type: fake | gcp_tpu
+      # gcp_tpu only:
+      project: my-project
+      zone: us-central2-b
+    head:
+      num_cpus: 4
+      resources: {}           # extra custom resources
+    available_node_types:
+      cpu_worker:
+        resources: {CPU: 4}
+        min_workers: 0
+        max_workers: 10
+      v5e_16:
+        resources: {TPU: 4}
+        tpu_accelerator_type: v5litepod-16   # slice type (gcp_tpu)
+        min_workers: 0
+        max_workers: 4
+    idle_timeout_s: 60
+
+`up()` starts the head in-process, pre-launches every type's min_workers
+through the provider, and runs the demand-driven reconciler on a
+background thread. `down()` terminates provider nodes and the head.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.autoscaler.autoscaler import (Autoscaler, AutoscalerConfig,
+                                           NodeTypeConfig)
+from ray_tpu.autoscaler.node_provider import (FakeMultiNodeProvider,
+                                              GcpTpuNodeProvider)
+
+logger = logging.getLogger(__name__)
+
+STATE_FILE = "/tmp/raytpu/cluster_launcher.json"
+
+
+def load_config(path: str) -> Dict[str, Any]:
+    import yaml
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    if not isinstance(cfg, dict):
+        raise ValueError(f"{path}: expected a mapping at top level")
+    cfg.setdefault("cluster_name", "ray-tpu")
+    cfg.setdefault("head", {})
+    cfg.setdefault("available_node_types", {})
+    prov = cfg.get("provider") or {}
+    if prov.get("type") not in ("fake", "gcp_tpu"):
+        raise ValueError("provider.type must be 'fake' or 'gcp_tpu'")
+    for name, nt in cfg["available_node_types"].items():
+        if "resources" not in nt:
+            raise ValueError(f"node type {name!r} needs `resources`")
+        nt.setdefault("min_workers", 0)
+        nt.setdefault("max_workers", 10)
+        nt.setdefault("labels", {})
+    return cfg
+
+
+def _make_provider(cfg: Dict, gcs_address: str):
+    prov = cfg["provider"]
+    if prov["type"] == "fake":
+        return FakeMultiNodeProvider(gcs_address,
+                                     session_name=cfg["cluster_name"])
+    kw = {}
+    types = cfg["available_node_types"]
+    slice_types = [nt.get("tpu_accelerator_type")
+                   for nt in types.values() if nt.get("tpu_accelerator_type")]
+    if slice_types:
+        kw["accelerator_type"] = slice_types[0]
+    if prov.get("runtime_version"):
+        kw["runtime_version"] = prov["runtime_version"]
+    return GcpTpuNodeProvider(project=prov["project"], zone=prov["zone"],
+                              cluster_address=gcs_address, **kw)
+
+
+class ClusterHandle:
+    """A launched cluster: head node + provider + reconciler thread."""
+
+    def __init__(self, config: Dict, head, provider,
+                 autoscaler: Optional[Autoscaler], stop: threading.Event,
+                 thread: Optional[threading.Thread]):
+        self.config = config
+        self.head = head
+        self.provider = provider
+        self.autoscaler = autoscaler
+        self._stop = stop
+        self._thread = thread
+
+    @property
+    def gcs_address(self) -> str:
+        return self.head.gcs_address
+
+    def down(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        for pid in list(self.provider.non_terminated_nodes()):
+            try:
+                self.provider.terminate_node(pid)
+            except Exception:
+                logger.exception("terminate %s failed", pid)
+        self.head.kill()
+        try:
+            os.unlink(STATE_FILE)
+        except OSError:
+            pass
+
+
+def up(config_path: str, start_autoscaler: bool = True) -> ClusterHandle:
+    """Bring the cluster up: head + min_workers + reconciler."""
+    from ray_tpu._private import node as node_mod
+
+    cfg = load_config(config_path)
+    head_cfg = cfg["head"]
+    head = node_mod.start_head(
+        num_cpus=head_cfg.get("num_cpus", 1),
+        resources=dict(head_cfg.get("resources") or {}))
+    provider = _make_provider(cfg, head.gcs_address)
+    for name, nt in cfg["available_node_types"].items():
+        for _ in range(int(nt["min_workers"])):
+            provider.create_node(name, dict(nt["resources"]),
+                                 dict(nt["labels"]))
+
+    stop = threading.Event()
+    thread = None
+    asc = None
+    if start_autoscaler:
+        def nodes_fn(addr=head.gcs_address):
+            # standalone GCS query: the launcher process need not be a
+            # ray_tpu driver
+            import asyncio
+
+            from ray_tpu._private import rpc
+
+            async def go():
+                conn = await rpc.connect(addr, name="launcher", retries=3)
+                try:
+                    return await conn.call("get_all_nodes")
+                finally:
+                    await conn.close()
+            return asyncio.run(go())
+
+        asc = Autoscaler(
+            AutoscalerConfig(
+                node_types={
+                    name: NodeTypeConfig(resources=dict(nt["resources"]),
+                                         max_workers=int(nt["max_workers"]),
+                                         labels=dict(nt["labels"]))
+                    for name, nt in cfg["available_node_types"].items()},
+                idle_timeout_s=float(cfg.get("idle_timeout_s", 60.0))),
+            provider, protected_node_ids=[head.node_id],
+            nodes_fn=nodes_fn)
+        thread = threading.Thread(target=asc.run, args=(stop,),
+                                  name="cluster-autoscaler", daemon=True)
+        thread.start()
+
+    os.makedirs(os.path.dirname(STATE_FILE), exist_ok=True)
+    with open(STATE_FILE, "w") as f:
+        json.dump({"cluster_name": cfg["cluster_name"],
+                   "gcs_address": head.gcs_address,
+                   "provider": cfg["provider"],
+                   "config_path": os.path.abspath(config_path),
+                   "started_at": time.time()}, f)
+    logger.info("cluster %s up: GCS %s, %d node type(s)",
+                cfg["cluster_name"], head.gcs_address,
+                len(cfg["available_node_types"]))
+    return ClusterHandle(cfg, head, provider, asc, stop, thread)
+
+
+def down_from_state() -> bool:
+    """`ray_tpu down` from a different process than `up`: terminate cloud
+    nodes via a re-instantiated provider, then sweep local processes."""
+    try:
+        with open(STATE_FILE) as f:
+            st = json.load(f)
+    except OSError:
+        return False
+    prov = st.get("provider") or {}
+    if prov.get("type") == "gcp_tpu":
+        try:
+            p = GcpTpuNodeProvider(project=prov["project"],
+                                   zone=prov["zone"],
+                                   cluster_address=st["gcs_address"])
+            for pid in p.non_terminated_nodes():
+                p.terminate_node(pid)
+        except Exception:
+            logger.exception("cloud teardown failed; nodes may remain")
+    try:
+        os.unlink(STATE_FILE)
+    except OSError:
+        pass
+    return True

@@ -93,6 +93,16 @@ class _SplitCoordinator:
         while target > 0 and sum(max(target - h, 0)
                                  for h in self._rows_handed) > pool_rows:
             target -= 1
+        if target < max(self._rows_handed):
+            # a consumer was already handed more rows than the pool can
+            # match for its peers: exact equality is unreachable. Raising
+            # here turns a would-be collective deadlock in lockstep SPMD
+            # consumers into a loud error (_can_hand prevents this; guard
+            # stays in case of a logic hole)
+            raise RuntimeError(
+                "streaming_split(equal=True): delivered row counts "
+                f"diverged beyond repair (handed={self._rows_handed}, "
+                f"undelivered pool={pool_rows} rows)")
 
         cursor = iter(pool)
         current = None          # (ref, meta, offset)
@@ -127,6 +137,18 @@ class _SplitCoordinator:
             self._queues[i] = kept
 
     # -------------------------------------------------------------- api
+    def _can_hand(self, idx: int) -> bool:
+        """Equal mode invariant: after handing the head bundle to idx, the
+        undelivered pool must still cover every peer's deficit to the new
+        max — bounding run-ahead by ROWS (a fixed bundle-depth reserve
+        lets uneven block sizes silently break exact equality)."""
+        rows = self._queues[idx][0][1].num_rows
+        pool = sum(b[1].num_rows for q in self._queues for b in q) - rows
+        handed = list(self._rows_handed)
+        handed[idx] += rows
+        hmax = max(handed)
+        return sum(hmax - h for h in handed) <= pool
+
     def next(self, idx: int):
         """Next (block_ref, metadata) for consumer idx; (_WAIT,) when the
         stream is backpressured by a lagging peer; None at end."""
@@ -135,7 +157,7 @@ class _SplitCoordinator:
             if self._equal and not self._done:
                 # keep one bundle in reserve until the stream ends so the
                 # tail can be sliced to equality
-                if len(q) >= 2:
+                if len(q) >= 2 and self._can_hand(idx):
                     return self._hand(idx)
             elif q:
                 return self._hand(idx)

@@ -1,0 +1,246 @@
+"""Multi-agent RL: env API, env runner with policy mapping, and a
+multi-policy PPO driver (reference: rllib/env/multi_agent_env.py,
+rllib/env/multi_agent_env_runner.py, multi-module RLModule spec in
+rllib/core/rl_module/ — policies train independently or shared via the
+policy_mapping_fn, each on its own JaxLearner).
+
+Env contract (reference MultiAgentEnv):
+    reset(seed) -> (obs: {agent_id: ob}, info)
+    step(actions: {agent_id: act}) ->
+        (obs, rewards, terminateds, truncateds, info)   # all keyed dicts;
+        terminateds/truncateds carry "__all__" for episode end
+    agents -> list of agent ids; observation/action spaces per agent via
+    observation_space(agent), action_space(agent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class MultiAgentEnv:
+    """Base class; subclass and implement reset/step/spaces."""
+
+    agents: List[str] = []
+
+    def reset(self, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, actions: Dict[str, Any]):
+        raise NotImplementedError
+
+    def observation_space(self, agent_id: str):
+        raise NotImplementedError
+
+    def action_space(self, agent_id: str):
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class MultiAgentConfig:
+    env_maker: Callable[[], MultiAgentEnv] = None
+    # agent_id -> policy_id; shared policies = many agents -> one id
+    policy_mapping_fn: Callable[[str], str] = lambda aid: aid
+    num_env_runners: int = 2
+    rollout_fragment_length: int = 64
+    num_epochs: int = 4
+    minibatch_size: int = 128
+    lr: float = 3e-4
+    gamma: float = 0.99
+    lambda_: float = 0.95
+    clip_param: float = 0.2
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    grad_clip: float = 0.5
+    hidden_sizes: tuple = (64, 64)
+    seed: int = 0
+
+
+class MultiAgentEnvRunner:
+    """Steps one multi-agent env; groups per-agent trajectories by policy
+    and computes GAE per agent stream (the role the reference's
+    connector pipelines + MultiAgentEpisode play)."""
+
+    def __init__(self, cfg: Dict, runner_index: int = 0):
+        import jax
+
+        from ray_tpu.rl.rl_module import DiscreteRLModule
+        self.cfg = cfg
+        self.env = cfg["env_maker"]()
+        self.mapping = cfg["policy_mapping_fn"]
+        self.policies: Dict[str, DiscreteRLModule] = {}
+        for aid in self.env.agents:
+            pid = self.mapping(aid)
+            if pid not in self.policies:
+                obs_dim = int(np.prod(
+                    self.env.observation_space(aid).shape))
+                act_dim = self.env.action_space(aid).n
+                self.policies[pid] = DiscreteRLModule(
+                    obs_dim, act_dim, cfg.get("hidden_sizes", (64, 64)),
+                    seed=cfg.get("seed", 0))
+        self.rng = jax.random.PRNGKey(
+            cfg.get("seed", 0) + runner_index * 1000)
+        self.obs, _ = self.env.reset(seed=cfg.get("seed", 0) + runner_index)
+        self.gamma = cfg["gamma"]
+        self.lam = cfg["lambda_"]
+        self._episode_return = 0.0
+        self._episode_returns: List[float] = []
+
+    def policy_ids(self) -> List[str]:
+        return sorted(self.policies)
+
+    def set_weights(self, weights: Dict[str, Any]):
+        for pid, w in weights.items():
+            self.policies[pid].set_weights(w)
+        return True
+
+    def sample(self, num_steps: Optional[int] = None) -> Dict[str, Dict]:
+        """Run `num_steps` env steps; returns {policy_id: flat batch with
+        obs/actions/logp/advantages/value_targets}."""
+        import jax
+        T = num_steps or self.cfg["rollout_fragment_length"]
+        # per-agent trajectory buffers
+        traj: Dict[str, Dict[str, list]] = {
+            aid: {"obs": [], "act": [], "logp": [], "rew": [], "val": [],
+                  "done": []}
+            for aid in self.env.agents}
+        for _ in range(T):
+            actions = {}
+            for aid, ob in self.obs.items():
+                pol = self.policies[self.mapping(aid)]
+                self.rng, key = jax.random.split(self.rng)
+                a, logp, v = pol.sample_actions(
+                    pol.params, np.asarray(ob, np.float32)[None], key)
+                actions[aid] = int(a[0])
+                t = traj[aid]
+                t["obs"].append(np.asarray(ob, np.float32))
+                t["act"].append(int(a[0]))
+                t["logp"].append(float(logp[0]))
+                t["val"].append(float(v[0]))
+            obs, rews, terms, truncs, _ = self.env.step(actions)
+            done = bool(terms.get("__all__")) or bool(truncs.get("__all__"))
+            for aid in actions:
+                traj[aid]["rew"].append(float(rews.get(aid, 0.0)))
+                traj[aid]["done"].append(1.0 if done else 0.0)
+            self._episode_return += sum(rews.values())
+            if done:
+                self._episode_returns.append(self._episode_return)
+                self._episode_return = 0.0
+                obs, _ = self.env.reset()
+            self.obs = obs
+
+        out: Dict[str, Dict[str, list]] = {}
+        for aid, t in traj.items():
+            pid = self.mapping(aid)
+            pol = self.policies[pid]
+            # bootstrap with the value of the agent's current obs unless
+            # the stream ended with a terminal
+            if t["done"] and t["done"][-1] > 0:
+                last_val = 0.0
+            else:
+                ob = np.asarray(self.obs[aid], np.float32)[None]
+                _, v = pol.forward(pol.params, ob)
+                last_val = float(np.asarray(v)[0])
+            n = len(t["obs"])
+            adv = np.zeros(n, np.float32)
+            lastgaelam = 0.0
+            for i in reversed(range(n)):
+                nonterminal = 1.0 - t["done"][i]
+                next_value = t["val"][i + 1] if i + 1 < n else last_val
+                delta = t["rew"][i] + self.gamma * next_value * nonterminal \
+                    - t["val"][i]
+                lastgaelam = delta + self.gamma * self.lam * nonterminal \
+                    * lastgaelam
+                adv[i] = lastgaelam
+            targets = adv + np.asarray(t["val"], np.float32)
+            dst = out.setdefault(pid, {"obs": [], "actions": [], "logp": [],
+                                       "advantages": [],
+                                       "value_targets": []})
+            dst["obs"].extend(t["obs"])
+            dst["actions"].extend(t["act"])
+            dst["logp"].extend(t["logp"])
+            dst["advantages"].extend(adv.tolist())
+            dst["value_targets"].extend(targets.tolist())
+        return {pid: {"obs": np.asarray(b["obs"], np.float32),
+                      "actions": np.asarray(b["actions"], np.int64),
+                      "logp": np.asarray(b["logp"], np.float32),
+                      "advantages": np.asarray(b["advantages"], np.float32),
+                      "value_targets": np.asarray(b["value_targets"],
+                                                  np.float32)}
+                for pid, b in out.items()}
+
+    def get_metrics(self) -> Dict:
+        out = {"episode_return_mean":
+               float(np.mean(self._episode_returns[-20:]))
+               if self._episode_returns else None,
+               "episodes": len(self._episode_returns)}
+        return out
+
+
+class MultiAgentPPO:
+    """PPO over a policy map: each policy updates on the experience of the
+    agents mapped to it (reference: multi-agent training_step in
+    algorithm.py + LearnerGroup with a module per policy)."""
+
+    def __init__(self, config: MultiAgentConfig):
+        import ray_tpu
+        from ray_tpu.rl.learner import JaxLearner
+
+        self.config = config
+        cfg_dict = dataclasses.asdict(config)
+        cfg_dict["env_maker"] = config.env_maker
+        cfg_dict["policy_mapping_fn"] = config.policy_mapping_fn
+        runner_cls = ray_tpu.remote(num_cpus=0.25)(MultiAgentEnvRunner)
+        self.env_runners = [runner_cls.remote(cfg_dict, i)
+                            for i in range(config.num_env_runners)]
+        # learners are built from the env's spaces, one per policy
+        probe = config.env_maker()
+        self.learners: Dict[str, JaxLearner] = {}
+        for aid in probe.agents:
+            pid = config.policy_mapping_fn(aid)
+            if pid not in self.learners:
+                obs_dim = int(np.prod(probe.observation_space(aid).shape))
+                act_dim = probe.action_space(aid).n
+                self.learners[pid] = JaxLearner(cfg_dict, obs_dim, act_dim)
+        self.iteration = 0
+        self._sync_weights()
+
+    def _sync_weights(self):
+        import ray_tpu
+        weights = {pid: ln.get_weights()
+                   for pid, ln in self.learners.items()}
+        ref = ray_tpu.put(weights)
+        ray_tpu.get([r.set_weights.remote(ref) for r in self.env_runners])
+
+    def training_step(self) -> Dict:
+        import ray_tpu
+        batches = ray_tpu.get([r.sample.remote()
+                               for r in self.env_runners])
+        merged: Dict[str, Dict[str, np.ndarray]] = {}
+        for b in batches:
+            for pid, pb in b.items():
+                dst = merged.setdefault(pid, {})
+                for k, v in pb.items():
+                    dst.setdefault(k, []).append(v)
+        stats = {}
+        for pid, pb in merged.items():
+            batch = {k: np.concatenate(v) for k, v in pb.items()}
+            stats[pid] = self.learners[pid].update_from_batch(batch)
+        self._sync_weights()
+        self.iteration += 1
+        return stats
+
+    def train(self) -> Dict:
+        import ray_tpu
+        stats = self.training_step()
+        metrics = ray_tpu.get([r.get_metrics.remote()
+                               for r in self.env_runners])
+        returns = [m["episode_return_mean"] for m in metrics
+                   if m["episode_return_mean"] is not None]
+        return {"iteration": self.iteration,
+                "episode_return_mean":
+                    float(np.mean(returns)) if returns else None,
+                "learners": stats}

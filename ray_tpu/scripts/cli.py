@@ -88,7 +88,45 @@ def cmd_status(args):
     import ray_tpu
     from ray_tpu.util import state
     ray_tpu.init(address=_load_address(args))
-    print(json.dumps(state.cluster_summary(), indent=2, default=str))
+    summary = state.cluster_summary()
+    # autoscaler view: aggregate queued lease demand per resource shape
+    # (reference: `ray status` resource demand section)
+    demand = {}
+    for n in ray_tpu.nodes():
+        for d in n.get("pending_demand") or []:
+            key = json.dumps(d, sort_keys=True)
+            demand[key] = demand.get(key, 0) + 1
+    summary["pending_demand"] = [
+        {"shape": json.loads(k), "count": v} for k, v in demand.items()]
+    print(json.dumps(summary, indent=2, default=str))
+
+
+def cmd_up(args):
+    from ray_tpu.autoscaler import launcher
+    handle = launcher.up(args.config)
+    print(f"cluster {handle.config['cluster_name']} up; "
+          f"GCS at {handle.gcs_address}")
+    print(f"connect with: ray_tpu.init(address={handle.gcs_address!r})")
+    if args.block:
+        import signal
+        stop = False
+
+        def _sig(*_):
+            nonlocal stop
+            stop = True
+        signal.signal(signal.SIGINT, _sig)
+        signal.signal(signal.SIGTERM, _sig)
+        while not stop:
+            time.sleep(1)
+        handle.down()
+        print("cluster down")
+
+
+def cmd_down(args):
+    from ray_tpu.autoscaler import launcher
+    if launcher.down_from_state():
+        print("cloud nodes terminated")
+    cmd_stop(args)
 
 
 def cmd_list(args):
@@ -161,6 +199,15 @@ def main(argv=None):
 
     pstop = sub.add_parser("stop")
     pstop.set_defaults(fn=cmd_stop)
+
+    pu = sub.add_parser("up", help="bring up a cluster from a YAML spec")
+    pu.add_argument("config")
+    pu.add_argument("--block", action="store_true",
+                    help="stay attached; ctrl-c tears the cluster down")
+    pu.set_defaults(fn=cmd_up)
+
+    pd = sub.add_parser("down", help="tear down the launched cluster")
+    pd.set_defaults(fn=cmd_down)
 
     pst = sub.add_parser("status")
     pst.add_argument("--address", default=None)

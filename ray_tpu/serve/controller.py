@@ -11,6 +11,7 @@ loop must stay free for RPC)."""
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 import time
@@ -19,13 +20,50 @@ from typing import Any, Dict, List, Optional
 logger = logging.getLogger(__name__)
 
 
+def autoscale_decision(auto: Dict, hist, total_load: float, target: int,
+                       now: float, up_since: Dict, down_since: Dict,
+                       key) -> int:
+    """Pure autoscaling step (reference: serve/autoscaling_policy.py).
+    Appends the sample to `hist`, windows it to look_back_period_s, and
+    returns the new target: the desired count (ceil(window-avg load /
+    target_ongoing_requests), clamped) applied only once the up/down
+    condition has held for its delay. `up_since`/`down_since` carry the
+    condition-start timestamps between calls."""
+    import math
+    hist.append((now, total_load))
+    look = auto.get("look_back_period_s", 10.0)
+    while hist and hist[0][0] < now - look:
+        hist.popleft()
+    avg_total = sum(v for _, v in hist) / len(hist)
+    desired = math.ceil(avg_total / max(auto["target_ongoing_requests"],
+                                        1e-9))
+    desired = max(auto["min_replicas"], min(auto["max_replicas"], desired))
+    if desired > target:
+        down_since.pop(key, None)
+        t0 = up_since.setdefault(key, now)
+        if now - t0 >= auto.get("upscale_delay_s", 0.0):
+            up_since.pop(key, None)
+            return desired
+    elif desired < target:
+        up_since.pop(key, None)
+        t0 = down_since.setdefault(key, now)
+        if now - t0 >= auto.get("downscale_delay_s", 0.0):
+            down_since.pop(key, None)
+            return desired
+    else:
+        up_since.pop(key, None)
+        down_since.pop(key, None)
+    return target
+
+
 class ServeController:
     def __init__(self):
         # apps[app][dep] = {spec, replicas: [handle], version, target}
         self.apps: Dict[str, Dict[str, Dict]] = {}
         self._lock = threading.RLock()
-        self._load_ema: Dict[tuple, float] = {}
-        self._scale_marks: Dict[tuple, float] = {}
+        self._load_hist: Dict[tuple, "collections.deque"] = {}
+        self._up_since: Dict[tuple, float] = {}
+        self._down_since: Dict[tuple, float] = {}
         self._stop = False
         # routing state is controller-owned so every proxy on every node
         # serves one authoritative table (reference: EndpointState +
@@ -304,6 +342,11 @@ class ServeController:
                 logger.exception("reconcile loop iteration failed")
 
     def _autoscale(self, app_name, name, dep):
+        """Reference-shaped policy (serve/autoscaling_policy.py): average
+        total queue depth over a look-back window, derive the DESIRED
+        replica count from target_ongoing_requests, and apply it only
+        after the condition has held for the up/downscale delay — bursts
+        neither flap replicas up nor drain them mid-dip."""
         import ray_tpu
         auto = dep["spec"]["config"].get("autoscaling_config")
         if not auto or not dep["replicas"]:
@@ -314,22 +357,11 @@ class ServeController:
         except Exception:
             return
         key = (app_name, name)
-        load = sum(lens) / max(1, len(dep["replicas"]))
-        ema = 0.6 * self._load_ema.get(key, load) + 0.4 * load
-        self._load_ema[key] = ema
-        target = dep["target"]
         now = time.monotonic()
-        mark = self._scale_marks.get(key, 0)
-        if ema > auto["target_ongoing_requests"] and \
-                target < auto["max_replicas"] and \
-                now - mark > auto["upscale_delay_s"]:
-            dep["target"] = target + 1
-            self._scale_marks[key] = now
-        elif ema < auto["target_ongoing_requests"] * 0.3 and \
-                target > auto["min_replicas"] and \
-                now - mark > auto["downscale_delay_s"]:
-            dep["target"] = target - 1
-            self._scale_marks[key] = now
+        hist = self._load_hist.setdefault(key, collections.deque())
+        dep["target"] = autoscale_decision(
+            auto, hist, float(sum(lens)), dep["target"], now,
+            self._up_since, self._down_since, key)
 
     def get_deployment_info(self, app_name: str, name: str) -> Dict:
         with self._lock:

@@ -17,6 +17,10 @@ class AutoscalingConfig:
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 2.0
     downscale_delay_s: float = 10.0
+    # queue-depth samples are averaged over this window before any
+    # decision — bursty load doesn't flap replicas (reference:
+    # serve/autoscaling_policy.py look_back_period_s)
+    look_back_period_s: float = 10.0
 
 
 @dataclasses.dataclass

@@ -146,3 +146,45 @@ def test_declarative_config_deploy(two_node_cluster):
         time.sleep(0.5)
     addr = next(iter(proxies.values()))["http"]
     assert _http_get(addr, "/yam", payload="q") == "from-yaml"
+
+
+def test_autoscale_windowed_no_flapping():
+    """Bursty load through the windowed policy: upscale happens after the
+    sustained delay, momentary dips never drop replicas, and a sustained
+    quiet period scales down once (reference: serve/autoscaling_policy.py
+    look-back + delay semantics)."""
+    import collections
+
+    from ray_tpu.serve.controller import autoscale_decision
+
+    auto = {"min_replicas": 1, "max_replicas": 8,
+            "target_ongoing_requests": 2.0, "upscale_delay_s": 2.0,
+            "downscale_delay_s": 10.0, "look_back_period_s": 4.0}
+    hist = collections.deque()
+    up, down, key = {}, {}, "d"
+    target = 1
+    targets = []
+    # quiet warm-up fills the window, then load alternates 12 <-> 0 every
+    # tick (1s): window-avg ~6 -> desired 3
+    for t in range(4):
+        target = autoscale_decision(auto, hist, 0.0, target, float(t),
+                                    up, down, key)
+        assert target == 1
+    for t in range(4, 40):
+        load = 12.0 if t % 2 == 0 else 0.0
+        target = autoscale_decision(auto, hist, load, target, float(t),
+                                    up, down, key)
+        targets.append(target)
+    # scaled up exactly once past the delay, then stayed put: no flapping
+    assert target == 3, targets
+    changes = sum(1 for a, b in zip(targets, targets[1:]) if a != b)
+    assert changes == 1, targets
+    # sustained quiet: no immediate drop (downscale delay), then one drop
+    for t in range(40, 49):
+        target = autoscale_decision(auto, hist, 0.0, target, float(t),
+                                    up, down, key)
+        assert target == 3   # inside downscale_delay_s
+    for t in range(49, 60):
+        target = autoscale_decision(auto, hist, 0.0, target, float(t),
+                                    up, down, key)
+    assert target == 1
