@@ -144,6 +144,25 @@ def bench_put_bandwidth(ray_tpu, duration=3.0):
     return _rate(n, t0) * len(blob) / 1e9
 
 
+def bench_memcpy_ceiling(duration=2.0):
+    """This box's raw warm memcpy bandwidth — the physical ceiling for
+    put (one copy into the shm arena is irreducible). The reference's
+    17.8 GB/s row was measured on a much wider-memory node; put
+    efficiency (put_gb / this) is the honest figure of merit."""
+    import mmap
+
+    import numpy as np
+    src = np.ones(64 * 1024 * 1024, dtype=np.uint8)
+    m = mmap.mmap(-1, len(src))
+    dst = np.frombuffer(m, dtype=np.uint8)
+    dst[:] = src
+    n, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < duration:
+        dst[:] = src
+        n += 1
+    return _rate(n, t0) * len(src) / 1e9
+
+
 def bench_tasks_sync(ray_tpu, duration=5.0):
     @ray_tpu.remote
     def nop():
@@ -345,6 +364,17 @@ def main():
                                 "error": str(e)[:200]}
     finally:
         ray_tpu.shutdown()
+
+    try:
+        ceiling = bench_memcpy_ceiling()
+        put = results.get("single_client_put_gb_per_s", {}).get("value")
+        results["memcpy_ceiling_gb_per_s"] = {
+            "value": round(ceiling, 2),
+            "put_efficiency": round(put / ceiling, 3) if put else None}
+        log(f"memcpy ceiling {ceiling:.2f} GB/s; put efficiency "
+            f"{results['memcpy_ceiling_gb_per_s']['put_efficiency']}")
+    except Exception as e:
+        log(f"memcpy ceiling probe failed: {e}")
 
     try:
         mfu_res = bench_train_step_mfu()

@@ -52,6 +52,7 @@ class GcsServer:
         self.port = port
         self.session_name = session_name
         self.persist_path = persist_path
+        self._wal = None
         self.address: Optional[str] = None
 
         self.kv: Dict[str, Dict[bytes, bytes]] = {}          # namespace -> {k: v}
@@ -110,6 +111,7 @@ class GcsServer:
         self.server = rpc.Server(handlers, name="gcs")
         self.server.on_disconnect = self._on_disconnect
         self._load_snapshot()
+        self._replay_wal()
         self.address = await self.server.listen_tcp("0.0.0.0", self.port)
         # restart path: snapshot-restored actors that never reached ALIVE
         # must be (re)scheduled — the client's retried create_actor hits
@@ -155,6 +157,79 @@ class GcsServer:
         with open(tmp, "wb") as f:
             f.write(msgpack.packb(self._snapshot_state(), use_bin_type=True))
         os.replace(tmp, self.persist_path)
+        # the snapshot covers everything the WAL recorded: start it fresh
+        if self._wal is not None:
+            try:
+                self._wal.close()
+            except Exception:
+                pass
+            self._wal = None
+        try:
+            os.unlink(self.persist_path + ".wal")
+        except OSError:
+            pass
+
+    def _log_op(self, op: str, data: Dict):
+        """Append one mutation to the write-ahead log. Closes the
+        durability window between periodic snapshots: a GCS that dies
+        right after registering an actor/PG/KV entry replays it on
+        restart (reference: every mutation goes through the Redis store
+        client synchronously, redis_store_client.h:106)."""
+        if not self.persist_path:
+            return
+        import msgpack
+        try:
+            if self._wal is None:
+                import os
+                os.makedirs(os.path.dirname(self.persist_path) or ".",
+                            exist_ok=True)
+                self._wal = open(self.persist_path + ".wal", "ab")
+            rec = msgpack.packb([op, data], use_bin_type=True)
+            self._wal.write(len(rec).to_bytes(4, "little") + rec)
+            self._wal.flush()
+        except Exception:
+            logger.exception("WAL append failed")
+
+    def _replay_wal(self):
+        import os
+
+        import msgpack
+        path = (self.persist_path or "") + ".wal"
+        if not self.persist_path or not os.path.exists(path):
+            return
+        n = 0
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+            off = 0
+            while off + 4 <= len(raw):
+                ln = int.from_bytes(raw[off:off + 4], "little")
+                if off + 4 + ln > len(raw):
+                    break      # torn tail write: ignore
+                op, data = msgpack.unpackb(raw[off + 4:off + 4 + ln],
+                                           raw=False, strict_map_key=False)
+                self._apply_op(op, data)
+                off += 4 + ln
+                n += 1
+        except Exception:
+            logger.exception("WAL replay failed at record %d", n)
+        if n:
+            logger.info("replayed %d WAL records", n)
+
+    def _apply_op(self, op: str, d: Dict):
+        if op == "kv_put":
+            self.kv.setdefault(d["ns"], {})[d["key"]] = d["value"]
+        elif op == "kv_del":
+            self.kv.get(d["ns"], {}).pop(d["key"], None)
+        elif op == "actor":
+            self.actors[d["aid"]] = d["row"]
+        elif op == "named_actor":
+            self.named_actors[(d["ns"], d["name"])] = d["aid"]
+        elif op == "job":
+            self.jobs[int(d["job_id"])] = d["row"]
+            self._next_job_id = max(self._next_job_id, int(d["job_id"]) + 1)
+        elif op == "pg":
+            self.placement_groups[d["pg_id"]] = d["row"]
 
     def _load_snapshot(self):
         if not self.persist_path:
@@ -222,13 +297,17 @@ class GcsServer:
         if not overwrite and key in table:
             return False
         table[key] = value
+        self._log_op("kv_put", {"ns": ns, "key": key, "value": value})
         return True
 
     def h_kv_get(self, conn, ns: str, key: bytes):
         return self.kv.get(ns, {}).get(key)
 
     def h_kv_del(self, conn, ns: str, key: bytes):
-        return self.kv.get(ns, {}).pop(key, None) is not None
+        existed = self.kv.get(ns, {}).pop(key, None) is not None
+        if existed:
+            self._log_op("kv_del", {"ns": ns, "key": key})
+        return existed
 
     def h_kv_exists(self, conn, ns: str, key: bytes):
         return key in self.kv.get(ns, {})
@@ -354,6 +433,7 @@ class GcsServer:
         self.jobs[job_id] = {"job_id": job_id, "driver_address": driver_address,
                              "metadata": metadata, "start_time": time.time(),
                              "finished": False}
+        self._log_op("job", {"job_id": job_id, "row": self.jobs[job_id]})
         return job_id
 
     def h_finish_job(self, conn, job_id: int):
@@ -361,6 +441,7 @@ class GcsServer:
         if job:
             job["finished"] = True
             job["end_time"] = time.time()
+            self._log_op("job", {"job_id": job_id, "row": job})
         self._publish("JOB", str(job_id), {"state": "FINISHED"})
         return True
 
@@ -389,6 +470,8 @@ class GcsServer:
                     and self.actors[existing]["state"] != DEAD):
                 raise ValueError(f"actor name {name!r} already taken in namespace {ns!r}")
             self.named_actors[(ns, name)] = actor_id
+            self._log_op("named_actor", {"ns": ns, "name": name,
+                                         "aid": actor_id})
         row = {
             "actor_id": actor_id, "spec": spec, "state": PENDING_CREATION,
             "name": name, "namespace": ns, "node_id": None, "address": None,
@@ -415,6 +498,7 @@ class GcsServer:
             if pg is None or pg["state"] != "CREATED":
                 row["state"] = DEAD
                 row["death_cause"] = f"placement group {pg_id} not ready"
+                self._persist_actor(actor_id)
                 self._publish("ACTOR", actor_id, _actor_public(row))
                 return
             idx = sched.get("placement_group_bundle_index", 0)
@@ -454,6 +538,7 @@ class GcsServer:
         row["node_id"] = target
         row["address"] = result["worker_address"]
         row["worker_id"] = result["worker_id"]
+        self._persist_actor(actor_id)
         self._publish("ACTOR", actor_id, _actor_public(row))
 
     async def _handle_actor_failure(self, actor_id: str, reason: str,
@@ -473,11 +558,13 @@ class GcsServer:
             row["state"] = RESTARTING
             row["address"] = None
             row["node_id"] = None
+            self._persist_actor(actor_id)
             self._publish("ACTOR", actor_id, _actor_public(row))
             asyncio.ensure_future(self._schedule_actor(actor_id))
         else:
             row["state"] = DEAD
             row["death_cause"] = reason
+            self._persist_actor(actor_id)
             self._publish("ACTOR", actor_id, _actor_public(row))
 
     def h_get_actor_info(self, conn, actor_id: str):
@@ -532,6 +619,7 @@ class GcsServer:
             row["death_cause"] = "ray_tpu.kill"
             if row.get("name"):
                 self.named_actors.pop((row["namespace"], row["name"]), None)
+            self._persist_actor(actor_id)
             self._publish("ACTOR", actor_id, _actor_public(row))
         if node_conn is not None and not node_conn.closed:
             try:
@@ -598,6 +686,16 @@ class GcsServer:
         self._publish(channel, key, payload)
         return True
 
+    def _persist_actor(self, actor_id: str):
+        row = self.actors.get(actor_id)
+        if row is not None:
+            self._log_op("actor", {"aid": actor_id, "row": row})
+
+    def _persist_pg(self, pg_id: str):
+        row = self.placement_groups.get(pg_id)
+        if row is not None:
+            self._log_op("pg", {"pg_id": pg_id, "row": row})
+
     def _publish(self, channel: str, key: str, payload: Any):
         for sub in list(self.subscribers.get(channel, ())):
             if sub.closed:
@@ -624,6 +722,7 @@ class GcsServer:
         row = {"pg_id": pg_id, "bundles": bundles, "strategy": strategy,
                "name": name, "state": "PENDING", "node_ids": None}
         self.placement_groups[pg_id] = row
+        self._persist_pg(pg_id)
         if placement is None:
             row["state"] = "PENDING"   # infeasible now; retried by caller wait
             return {"state": "PENDING"}
@@ -663,6 +762,7 @@ class GcsServer:
                 pass
         row["state"] = "CREATED"
         row["node_ids"] = placement
+        self._persist_pg(pg_id)
         self._publish("PG", pg_id, {"state": "CREATED", "node_ids": placement})
         return {"state": "CREATED", "node_ids": placement}
 

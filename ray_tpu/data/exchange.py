@@ -61,8 +61,36 @@ def reduce_concat(seed, *parts):
 
 def reduce_sorted(key, descending, *parts):
     merged = block_lib.concat_blocks(list(parts))
+    if merged.num_rows == 0:
+        return merged    # all-empty concat loses the schema; don't sort
     order = "descending" if descending else "ascending"
     return merged.sort_by([(key, order)])
+
+
+def reduce_agg(key, aggs, *parts):
+    """Per-partition arrow group-by aggregate (keys are hash-disjoint
+    across partitions, so no cross-partition combine is needed)."""
+    merged = block_lib.concat_blocks(list(parts))
+    if merged.num_rows == 0:
+        return merged
+    spec = [(c, f) for c, f, _ in aggs]
+    out = merged.group_by(key).aggregate(spec)
+    rename = {f"{c}_{f}": name for c, f, name in aggs}
+    return out.rename_columns(
+        [rename.get(c, c) for c in out.column_names])
+
+
+def reduce_map_groups(key, fn, *parts):
+    import pandas as pd
+    merged = block_lib.concat_blocks(list(parts))
+    if merged.num_rows == 0:
+        return merged
+    df = merged.to_pandas()
+    outs = [fn(g) for _, g in df.groupby(key, sort=False)]
+    outs = [o if isinstance(o, pd.DataFrame) else pd.DataFrame(o)
+            for o in outs]
+    return block_lib.block_from_batch(pd.concat(outs)) if outs \
+        else merged.slice(0, 0)
 
 
 # ------------------------------------------------------------------- driver

@@ -33,12 +33,37 @@ class ExecutionBudget:
     head first (pull-based stages always keep making progress, so this
     throttles without deadlock)."""
 
-    def __init__(self, max_tasks: int = 32,
-                 max_bytes: int = 256 * 1024 * 1024):
+    def __init__(self, max_tasks: Optional[int] = None,
+                 max_bytes: Optional[int] = None):
+        if max_tasks is None or max_bytes is None:
+            d_tasks, d_bytes = self._cluster_defaults()
+            max_tasks = max_tasks if max_tasks is not None else d_tasks
+            max_bytes = max_bytes if max_bytes is not None else d_bytes
         self.max_tasks = max_tasks
         self.max_bytes = max_bytes
         self.tasks = 0
         self.bytes = 0
+
+    @staticmethod
+    def _cluster_defaults():
+        """Scale the budget to the CLUSTER, not a constant: in-flight
+        tasks track total CPUs (x2 for pipelining) and in-flight bytes
+        track a quarter of aggregate object-store capacity (reference:
+        execution/resource_manager.py derives caps from cluster resources
+        the same way). Falls back to single-node-ish constants when no
+        cluster is attached."""
+        try:
+            import ray_tpu
+            if ray_tpu.is_initialized():
+                total = ray_tpu.cluster_resources()
+                cpus = int(total.get("CPU", 8))
+                store = float(total.get("object_store_memory",
+                                        1024 * 1024 * 1024))
+                return (max(8, 2 * cpus),
+                        max(64 * 1024 * 1024, int(store // 4)))
+        except Exception:
+            pass
+        return 32, 256 * 1024 * 1024
 
     def try_acquire(self, est_bytes: int, force: bool = False) -> bool:
         """force=True always succeeds (still counted): a stage with an
@@ -324,83 +349,63 @@ class AllToAllStage(Stage):
             yield (ray_tpu.put(part), block_lib.block_metadata(part))
 
     def _random_shuffle(self, refs, seed):
+        """Distributed map/reduce shuffle: blocks never materialize in the
+        driver (reference: _internal/planner/exchange ShuffleTaskSpec);
+        single-block datasets take the local path."""
         import numpy as np
-        blocks = ray_tpu.get(list(refs))
-        merged = block_lib.concat_blocks(blocks)
-        rng = np.random.default_rng(seed)
-        idx = rng.permutation(merged.num_rows)
-        shuffled = merged.take(idx)
-        n = max(1, len(refs))
-        per = (shuffled.num_rows + n - 1) // n if shuffled.num_rows else 1
-        for i in range(n):
-            part = block_lib.slice_block(
-                shuffled, min(i * per, shuffled.num_rows),
-                min((i + 1) * per, shuffled.num_rows))
-            yield (ray_tpu.put(part), block_lib.block_metadata(part))
+        if len(refs) <= 1:
+            blocks = ray_tpu.get(list(refs))
+            merged = block_lib.concat_blocks(blocks)
+            rng = np.random.default_rng(seed)
+            shuffled = merged.take(rng.permutation(merged.num_rows))
+            yield (ray_tpu.put(shuffled),
+                   block_lib.block_metadata(shuffled))
+            return
+        from ray_tpu.data import exchange
+        n = len(refs)
+        seeds = np.random.default_rng(seed).integers(0, 2**31, size=n + 1)
+        yield from exchange.exchange(
+            list(refs), n, exchange.partition_random, (n, int(seeds[0])),
+            exchange.reduce_concat, (int(seeds[1]),))
 
     def _sort(self, refs, key, descending):
-        blocks = ray_tpu.get(list(refs))
-        merged = block_lib.concat_blocks(blocks)
-        order = "descending" if descending else "ascending"
-        out = merged.sort_by([(key, order)])
-        yield (ray_tpu.put(out), block_lib.block_metadata(out))
-
-    def _hash_partitions(self, refs, key, n):
-        """Disjoint key-hash partitions across blocks (the shuffle step of
-        a distributed group-by; reference: ray.data shuffle ops)."""
-        import numpy as np
-        blocks = ray_tpu.get(list(refs))
-        merged = block_lib.concat_blocks(blocks)
-        if merged.num_rows == 0:
-            return [merged]
-        col = merged.column(key).to_pandas()
-        part = np.asarray(col.map(lambda v: hash(v) % n), np.int64)
-        return [merged.take(np.nonzero(part == i)[0]) for i in range(n)]
+        """Distributed range-partitioned sort (reference: SortTaskSpec —
+        sample boundaries, partition by range, merge-sort per partition);
+        output partitions are globally ordered."""
+        if len(refs) <= 1:
+            blocks = ray_tpu.get(list(refs))
+            merged = block_lib.concat_blocks(blocks)
+            order = "descending" if descending else "ascending"
+            out = merged.sort_by([(key, order)])
+            yield (ray_tpu.put(out), block_lib.block_metadata(out))
+            return
+        from ray_tpu.data import exchange
+        n = len(refs)
+        bounds = exchange.sample_sort_bounds(list(refs), key, n)
+        yield from exchange.exchange(
+            list(refs), len(bounds) + 1, exchange.partition_range,
+            (key, bounds, descending), exchange.reduce_sorted,
+            (key, descending))
 
     def _groupby_agg(self, refs, key, aggs):
-        """aggs: list of (column, arrow_agg_fn, out_name); key-disjoint
-        partitions aggregate in parallel remote tasks."""
+        """aggs: list of (column, arrow_agg_fn, out_name); hash-exchange
+        to key-disjoint partitions, each aggregated in its reduce task
+        (reference: hash-shuffle groupby under
+        _internal/planner/exchange)."""
+        from ray_tpu.data import exchange
         n = max(1, min(len(refs), 8))
-        parts = self._hash_partitions(refs, key, n)
-
-        def agg_part(table):
-            import pyarrow as pa
-            if table.num_rows == 0:
-                return table
-            spec = [(c, f) for c, f, _ in aggs]
-            out = table.group_by(key).aggregate(spec)
-            rename = {f"{c}_{f}": name for c, f, name in aggs}
-            return out.rename_columns(
-                [rename.get(c, c) for c in out.column_names])
-
-        agg_remote = ray_tpu.remote(agg_part)
-        out_refs = [agg_remote.remote(p) for p in parts if p.num_rows]
-        for ref in out_refs:
-            block = ray_tpu.get(ref)
-            yield (ray_tpu.put(block), block_lib.block_metadata(block))
+        yield from exchange.exchange(
+            list(refs), n, exchange.partition_hash, (key, n),
+            exchange.reduce_agg, (key, list(aggs)))
 
     def _map_groups(self, refs, key, fn):
         """Run fn(pandas.DataFrame) per key group (reference:
-        GroupedData.map_groups)."""
+        GroupedData.map_groups) via the hash exchange."""
+        from ray_tpu.data import exchange
         n = max(1, min(len(refs), 8))
-        parts = self._hash_partitions(refs, key, n)
-
-        def groups_part(table):
-            import pandas as pd
-            if table.num_rows == 0:
-                return table
-            df = table.to_pandas()
-            outs = [fn(g) for _, g in df.groupby(key, sort=False)]
-            outs = [o if isinstance(o, pd.DataFrame) else pd.DataFrame(o)
-                    for o in outs]
-            return block_lib.block_from_batch(pd.concat(outs)) if outs \
-                else table.slice(0, 0)
-
-        groups_remote = ray_tpu.remote(groups_part)
-        out_refs = [groups_remote.remote(p) for p in parts if p.num_rows]
-        for ref in out_refs:
-            block = ray_tpu.get(ref)
-            yield (ray_tpu.put(block), block_lib.block_metadata(block))
+        yield from exchange.exchange(
+            list(refs), n, exchange.partition_hash, (key, n),
+            exchange.reduce_map_groups, (key, fn))
 
 
 class LimitStage(Stage):

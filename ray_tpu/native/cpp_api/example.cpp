@@ -49,6 +49,34 @@ int main(int argc, char** argv) {
   if (!actor_err) return 1;
   client.KillActor(acc);
 
+  // death path: a killed actor's connection drops — the next call must
+  // surface an error, never hang (reference: actor death propagation to
+  // xlang callers)
+  bool dead_err = false;
+  for (int attempt = 0; attempt < 50 && !dead_err; attempt++) {
+    try {
+      acc.conn.reset();   // force a reconnect to the (dead) address
+      client.CallActor(acc, "get", {});
+      // worker may not have exited yet; retry until the kill lands
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    } catch (const std::exception& e) {
+      dead_err = true;
+      std::printf("dead actor error: %s\n", e.what());
+    }
+  }
+  if (!dead_err) return 1;
+
+  // creation failure path: a bogus class ref fails loudly within the
+  // timeout instead of hanging
+  bool create_err = false;
+  try {
+    client.CreateActor("nosuch.module:Nope", {}, 1.0, 15.0);
+  } catch (const std::exception& e) {
+    create_err = true;
+    std::printf("create error propagated: %s\n", e.what());
+  }
+  if (!create_err) return 1;
+
   std::printf("CPP_API_OK\n");
   return 0;
 }

@@ -48,6 +48,8 @@ def test_cpp_client_cross_language(tmp_path):
         assert "pow=1024" in out.stdout
         assert "error propagated" in out.stdout
         assert "actor_total=112" in out.stdout
+        assert "dead actor error" in out.stdout
+        assert "create error propagated" in out.stdout
     finally:
         host.terminate()
         host.wait(timeout=10)

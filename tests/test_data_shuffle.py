@@ -1,0 +1,73 @@
+"""Distributed shuffle/sort/groupby across a 3-node cluster: 1M rows move
+through map/reduce exchange tasks — block bytes never materialize in the
+driver (reference: _internal/planner/exchange/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 2,
+                                "object_store_memory": 128 * 1024 * 1024})
+    for _ in range(2):
+        c.add_node(num_cpus=2, object_store_memory=128 * 1024 * 1024)
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_shuffle_1m_rows_multi_node(cluster):
+    n = 1_000_000
+    ds = rd.range(n, parallelism=8).random_shuffle(seed=7)
+    # exact permutation: all rows survive, order differs from identity
+    total = 0
+    prefix = []
+    for batch in ds.iter_batches(batch_size=100_000, batch_format="numpy"):
+        ids = batch["id"]
+        total += len(ids)
+        if len(prefix) < 3:
+            prefix.append(int(ids[0]))
+    assert total == n
+    assert prefix != sorted(prefix) or prefix[0] != 0
+    # spot-check global content equality via a checksum
+    s = 0
+    for batch in ds.iter_batches(batch_size=200_000, batch_format="numpy"):
+        s += int(batch["id"].sum())
+    assert s == n * (n - 1) // 2
+
+
+def test_distributed_sort_globally_ordered(cluster):
+    n = 200_000
+    rng = np.random.default_rng(3)
+    vals = rng.permutation(n)
+    ds = rd.from_items([{"v": int(v)} for v in vals]) \
+        .sort("v")
+    last = -1
+    total = 0
+    for batch in ds.iter_batches(batch_size=50_000, batch_format="numpy"):
+        v = batch["v"]
+        assert (np.diff(v) >= 0).all()
+        assert int(v[0]) > last or total == 0
+        assert int(v[0]) >= last
+        last = int(v[-1])
+        total += len(v)
+    assert total == n and last == n - 1
+
+
+def test_distributed_groupby_agg(cluster):
+    ds = rd.from_items([{"k": i % 10, "x": float(i)}
+                        for i in range(100_000)])
+    out = ds.groupby("k").sum("x").take_all()
+    assert len(out) == 10
+    got = {int(r["k"]): r["sum(x)"] if "sum(x)" in r else r.get("x_sum",
+           list(r.values())[1]) for r in out}
+    for k in range(10):
+        expect = sum(float(i) for i in range(k, 100_000, 10))
+        assert abs(got[k] - expect) < 1e-6

@@ -117,3 +117,40 @@ def test_gcs_restart_while_tasks_inflight(gcs_restart_cluster):
     # in-flight work (already-pushed tasks) completes: the data plane is
     # worker<->worker and never touches the GCS
     assert ray_tpu.get(refs, timeout=90) == [0, 10, 20, 30]
+
+
+def test_wal_closes_snapshot_window(gcs_restart_cluster):
+    """A mutation made moments before a GCS kill -9 (inside the periodic
+    snapshot interval) survives restart via the write-ahead log
+    (reference: synchronous Redis store writes, redis_store_client.h:106)."""
+    ctx = gcs_restart_cluster
+    import ray_tpu._private.worker as wm
+    w = wm.global_worker
+    # register state and kill IMMEDIATELY — no snapshot tick can run
+    w.gcs_call("kv_put", ns="walns", key=b"k1", value=b"v1")
+
+    @ray_tpu.remote(name="wal_actor", lifetime="detached")
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    ctx["gcs_proc"].kill()
+    ctx["gcs_proc"].wait()
+
+    proc2, addr2 = _spawn_gcs(ctx["port"], ctx["persist"], ctx["session"])
+    ctx["gcs_proc"] = proc2
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            assert w.gcs_call("kv_get", ns="walns", key=b"k1") == b"v1"
+            break
+        except Exception:
+            time.sleep(0.5)
+    else:
+        raise AssertionError("kv entry lost across restart")
+    # the actor's registration survived the restart too
+    info = w.gcs_call("get_actor_info",
+                      actor_id=a._actor_id)
+    assert info is not None and info.get("state") is not None
