@@ -87,6 +87,11 @@ _flag("actor_push_batch", int, 32,
 _flag("task_push_batch", int, 8,
       "Max queued same-signature tasks pushed to a leased worker in one "
       "frame.")
+_flag("gcs_wal_fsync", bool, False,
+      "fsync the GCS write-ahead log after every append. Off by default: "
+      "the WAL then survives a process kill but not a host crash (the "
+      "snapshot still bounds loss to the snapshot interval). Turn on for "
+      "single-head clusters whose state must survive power loss.")
 _flag("inline_exec_threshold_s", float, 0.002,
       "Actor/task methods whose running-average duration is below this "
       "execute inline on the event loop instead of a thread-pool hop "

@@ -53,6 +53,7 @@ class GcsServer:
         self.session_name = session_name
         self.persist_path = persist_path
         self._wal = None
+        self._wal_actors: set = set()   # actors whose full row is in WAL
         self.address: Optional[str] = None
 
         self.kv: Dict[str, Dict[bytes, bytes]] = {}          # namespace -> {k: v}
@@ -164,6 +165,7 @@ class GcsServer:
             except Exception:
                 pass
             self._wal = None
+        self._wal_actors.clear()
         try:
             os.unlink(self.persist_path + ".wal")
         except OSError:
@@ -174,7 +176,11 @@ class GcsServer:
         durability window between periodic snapshots: a GCS that dies
         right after registering an actor/PG/KV entry replays it on
         restart (reference: every mutation goes through the Redis store
-        client synchronously, redis_store_client.h:106)."""
+        client synchronously, redis_store_client.h:106).
+
+        Durability grade: flush() only by default — survives a process
+        kill, NOT a host crash (set cfg.gcs_wal_fsync for fsync-per-append
+        at a large latency cost)."""
         if not self.persist_path:
             return
         import msgpack
@@ -187,6 +193,9 @@ class GcsServer:
             rec = msgpack.packb([op, data], use_bin_type=True)
             self._wal.write(len(rec).to_bytes(4, "little") + rec)
             self._wal.flush()
+            if cfg.gcs_wal_fsync:
+                import os
+                os.fsync(self._wal.fileno())
         except Exception:
             logger.exception("WAL append failed")
 
@@ -223,6 +232,11 @@ class GcsServer:
             self.kv.get(d["ns"], {}).pop(d["key"], None)
         elif op == "actor":
             self.actors[d["aid"]] = d["row"]
+        elif op == "actor_delta":
+            # spec-less transition record; ignore if the full row never
+            # made it (snapshot already covers it then)
+            if d["aid"] in self.actors:
+                self.actors[d["aid"]].update(d["delta"])
         elif op == "named_actor":
             self.named_actors[(d["ns"], d["name"])] = d["aid"]
         elif op == "job":
@@ -687,9 +701,19 @@ class GcsServer:
         return True
 
     def _persist_actor(self, actor_id: str):
+        """Full row (incl. pickled spec) only on the first WAL record per
+        actor per WAL generation; state transitions afterwards log a
+        spec-less delta so churny actors can't balloon the WAL between
+        snapshots."""
         row = self.actors.get(actor_id)
-        if row is not None:
+        if row is None:
+            return
+        if actor_id not in self._wal_actors:
+            self._wal_actors.add(actor_id)
             self._log_op("actor", {"aid": actor_id, "row": row})
+        else:
+            delta = {k: v for k, v in row.items() if k != "spec"}
+            self._log_op("actor_delta", {"aid": actor_id, "delta": delta})
 
     def _persist_pg(self, pg_id: str):
         row = self.placement_groups.get(pg_id)

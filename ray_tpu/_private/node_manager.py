@@ -120,6 +120,7 @@ class NodeManager:
         self.bundles: Dict[tuple, Dict] = {}   # (pg_id, idx) -> {resources, available, committed}
         self.cluster_view: Dict[str, Dict] = {}
         self._view_version: Optional[int] = None
+        self._view_debits: Dict[str, List] = {}   # unconfirmed spill debits
         self._tasks: List[asyncio.Task] = []
         self._draining = False
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
@@ -285,8 +286,11 @@ class NodeManager:
                 self._view_version = resp["version"]
                 if "full" in resp:
                     self.cluster_view = resp["full"]
+                    self._view_debits.clear()
                 elif resp["delta"]:
                     self.cluster_view.update(resp["delta"])
+                    for nid in resp["delta"]:
+                        self._view_debits.pop(nid, None)
                 n += 1
             except rpc.ConnectionLost:
                 self._view_version = None     # resync after reconnect
@@ -297,6 +301,7 @@ class NodeManager:
                         "get_cluster_view")
                 except Exception:
                     pass
+            self._expire_view_debits()
             # reap half-received transfers whose pusher died mid-stream
             # (their unsealed buffers would otherwise pin arena space)
             now = time.monotonic()
@@ -307,6 +312,13 @@ class NodeManager:
                         self.store.abort(oid)
                     except Exception:
                         pass
+                    # fail pulls parked on this receive so they retry
+                    # immediately instead of waiting out their 300s cap
+                    done = self._recv_done.get(oid)
+                    if done is not None and not done.done():
+                        done.set_exception(RuntimeError(
+                            f"push of {oid.hex()[:16]} stalled >60s "
+                            "(pusher died?); receive aborted"))
 
     async def _reap_children_loop(self):
         while True:
@@ -616,8 +628,28 @@ class NodeManager:
         w = self.workers.get(wid)
         if w is not None and w.state not in ("dead", "driver"):
             asyncio.ensure_future(self._on_worker_death(w, "connection lost"))
-        elif w is not None and w.state == "driver":
-            self.workers.pop(wid, None)
+        elif w is None or w.state == "driver":
+            if w is not None:
+                self.workers.pop(wid, None)
+            # a submitter (driver, or a remote worker that leased here via
+            # spillback) vanished: release every lease it owned, or its
+            # workers stay "leased" forever and the node's resources leak
+            # (reference: raylet treats client-socket disconnect as death
+            # and cleans up its leases, node_manager.cc DisconnectClient)
+            self._release_owned_leases(wid)
+
+    def _release_owned_leases(self, wid: str):
+        """Reclaim leases whose submitter `wid` is gone. The leased worker
+        may still be EXECUTING the dead submitter's task — re-idling it
+        would double-assign the process (and its chips) while the orphan
+        task runs, so kill it and let the pool respawn fresh (reference:
+        raylet destroys workers of a disconnected owner,
+        node_manager.cc DisconnectClient). Clean shutdowns return leases
+        before disconnecting, so this only costs a respawn on crashes."""
+        for lid, info in list(self._leases.items()):
+            if info.get("owner") == wid:
+                asyncio.ensure_future(self._on_worker_death(
+                    info["worker"], f"lease owner {wid[:8]} disconnected"))
 
     async def _on_worker_death(self, w: WorkerProc, reason: str):
         prev_state = w.state
@@ -628,6 +660,9 @@ class NodeManager:
         self._kill_proc(w)
         if w.lease_id is not None:
             self._release_lease(w.lease_id, worker_dead=True)
+        if w.worker_id:
+            # leases this worker OWNED as a nested-task submitter
+            self._release_owned_leases(w.worker_id)
         if prev_state == "actor" and w.actor_id is not None:
             try:
                 await self.gcs.call("report_actor_failure", actor_id=w.actor_id,
@@ -698,6 +733,12 @@ class NodeManager:
         strategy = scheduling.get("strategy", "DEFAULT")
         infeasible_since = None
         while True:
+            # Zombie guard: the submitter may be long gone while this
+            # handler sits in the wait loop (its RPC was abandoned at
+            # disconnect). Granting to a dead conn leaks the lease
+            # forever — the owner-reclaim at disconnect already ran.
+            if conn.closed:
+                return {"status": "error", "reason": "requester gone"}
             bundle = self._bundle_pool(scheduling)
             pool_avail = bundle["available"] if bundle else self.available
             if scheduling.get("placement_group_id") and bundle is None:
@@ -740,12 +781,31 @@ class NodeManager:
                     self._free_chips.extend(chips)
                     scheduling_addback(pool_avail, resources)
                     raise
+                if conn.closed:
+                    # requester died while we were obtaining the worker:
+                    # the grant reply is undeliverable — roll back
+                    self._free_chips.extend(chips)
+                    scheduling_addback(pool_avail, resources)
+                    w.state = "idle"
+                    w.idle_since = time.monotonic()
+                    self._idle.append(w)
+                    self._wake_lease_waiters()
+                    return {"status": "error", "reason": "requester gone"}
                 self._lease_seq += 1
                 lease_id = f"{self.node_id[:8]}-{self._lease_seq}"
                 w.state = "leased"
                 w.lease_id = lease_id
+                # "owner" = the submitter that requested this lease — a
+                # driver or a worker running nested tasks. A submitter
+                # that dies (or disconnects without returning its idle
+                # leases) must not leak the resources forever.
                 self._leases[lease_id] = {"worker": w, "resources": resources,
-                                          "bundle": bundle, "chips": chips}
+                                          "bundle": bundle, "chips": chips,
+                                          "owner": worker_id}
+                # spilled requests arrive over an anonymous pool conn;
+                # stamping the submitter id here lets _on_disconnect
+                # reclaim its leases when that conn drops
+                conn.peer_info.setdefault("worker_id", worker_id)
                 return {"status": "ok", "lease_id": lease_id,
                         "worker_address": w.address,
                         "node_address": self.address,
@@ -795,7 +855,10 @@ class NodeManager:
         view after deciding to spill there: a burst of lease requests
         must not all pick the same (stale-view) target before the next
         sync corrects it (reference: ClusterResourceScheduler's local
-        resource-view adjustment on spillback decisions)."""
+        resource-view adjustment on spillback decisions). Debits expire:
+        if the spilled lease fails the GCS entry never changes, so under
+        delta sync the understated availability would persist until the
+        next full resync — a TTL sweep restores unconfirmed debits."""
         v = self.cluster_view.get(target)
         if v is None:
             return
@@ -804,6 +867,30 @@ class NodeManager:
             if k in avail:
                 avail[k] = avail[k] - amt
         self.cluster_view[target] = {**v, "available": avail}
+        self._view_debits.setdefault(target, []).append(
+            (time.monotonic(), dict(resources or {})))
+
+    def _expire_view_debits(self, ttl: float = 10.0):
+        """Credit back optimistic debits never confirmed by a view update
+        (confirmed ones are dropped when their node appears in a delta)."""
+        now = time.monotonic()
+        for target, recs in list(self._view_debits.items()):
+            keep = []
+            for t, res in recs:
+                if now - t < ttl:
+                    keep.append((t, res))
+                    continue
+                v = self.cluster_view.get(target)
+                if v is not None:
+                    avail = dict(v.get("available") or {})
+                    for k, amt in res.items():
+                        if k in avail:
+                            avail[k] = avail[k] + amt
+                    self.cluster_view[target] = {**v, "available": avail}
+            if keep:
+                self._view_debits[target] = keep
+            else:
+                self._view_debits.pop(target, None)
 
     def _live_view(self) -> Dict[str, Dict]:
         # draining nodes take no NEW work (reference: node draining in
@@ -878,6 +965,8 @@ class NodeManager:
         deadline = time.monotonic() + cfg.actor_resource_wait_s
         while not (scheduling_fits(pool_avail, resources)
                    and self._chips_fit(resources)):
+            if conn.closed:
+                raise RuntimeError("actor requester gone")
             if time.monotonic() > deadline:
                 raise RuntimeError(
                     f"insufficient resources for actor: {resources}")
@@ -1019,7 +1108,9 @@ class NodeManager:
             size = meta["data_size"]
             await self._pull_admit(size)
             admitted = size
-            if not self.store.contains(oid):    # re-check post-admission
+            for attempt in (0, 1):    # one retry after a reaped receive
+                if self.store.contains(oid):    # re-check post-admission
+                    break
                 done = asyncio.get_event_loop().create_future()
                 self._recv_done[oid] = done
                 try:
@@ -1027,6 +1118,10 @@ class NodeManager:
                                          to_node=self.node_id)
                     if not self.store.contains(oid):
                         await asyncio.wait_for(done, timeout=300)
+                    break
+                except Exception:
+                    if attempt:
+                        raise
                 finally:
                     self._recv_done.pop(oid, None)
             fut.set_result(True)

@@ -204,6 +204,7 @@ class CoreWorker:
         self._visible_dirty: set = set()
         self._cancelled_tasks: set = set()
         self._exec_ema: Dict[str, float] = {}   # method -> avg duration
+        self._exec_streak: Dict[str, int] = {}  # consecutive fast runs
         self._inline_ok = True    # off for max_concurrency>1 actors
         self._inline_unsafe: set = set()   # methods seen using sync APIs
         self._loop_thread_ident: Optional[int] = None
@@ -2085,9 +2086,15 @@ class CoreWorker:
             # rare first-ever bridge call while inline fail-fasts into a
             # clean task error (never a silent re-run — side effects must
             # not double, reference retry semantics are opt-in)
+            # Inlining requires EVIDENCE, not one lucky sample: the EMA
+            # is an average (a data-dependent slow call would block the
+            # whole loop), so demand >=3 consecutive sub-threshold runs
+            # before inlining, and a single run over threshold demotes
+            # the method back to the pool until it re-earns the streak.
             ema = self._exec_ema.get(key)
+            streak = self._exec_streak.get(key, 0)
             t0 = time.perf_counter()
-            if (ema is not None and self._inline_ok
+            if (ema is not None and streak >= 3 and self._inline_ok
                     and key not in self._inline_unsafe
                     and ema < cfg.inline_exec_threshold_s):
                 try:
@@ -2105,6 +2112,8 @@ class CoreWorker:
             if key is not None:
                 self._exec_ema[key] = dt if ema is None \
                     else 0.8 * ema + 0.2 * dt
+                self._exec_streak[key] = streak + 1 \
+                    if dt < cfg.inline_exec_threshold_s else 0
         self.current_task_name = None
         self.current_task_id = None
         nret = len(spec["return_ids"])
@@ -2212,6 +2221,20 @@ class CoreWorker:
 
     async def stop_async(self, private_loop: bool = True):
         self._shutdown = True
+        # return held idle leases so the node manager can re-grant the
+        # workers NOW — other drivers may be queued on them (the server
+        # also reclaims by owner on disconnect, but an explicit return
+        # frees the resources before the TCP teardown races the next
+        # lease wait poll)
+        leases = [l for pool in self._idle_leases.values() for l in pool]
+        self._idle_leases.clear()
+        if leases:
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*(self._drop_lease(l) for l in leases),
+                                   return_exceptions=True), 2.0)
+            except Exception:
+                pass
         # flush buffered task events so the GCS timeline isn't truncated
         if self._task_events and self.gcs is not None and not self.gcs.closed:
             batch, self._task_events = self._task_events, []

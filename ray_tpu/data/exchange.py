@@ -27,12 +27,48 @@ def partition_random(block, n: int, seed):
     return [block.take(np.nonzero(assign == j)[0]) for j in range(n)]
 
 
+def _stable_hash(v) -> int:
+    """Process-independent hash. Python's builtin hash() of str/bytes is
+    salted per interpreter (PYTHONHASHSEED), so two partition tasks on
+    different workers would route the same key to different partitions,
+    breaking the key-disjointness invariant reduce_agg/reduce_map_groups
+    rely on. crc32 over a repr-stable byte encoding is deterministic
+    everywhere."""
+    import zlib
+
+    import numpy as np
+    # canonicalize numerics first: pandas materializes int columns as
+    # np.int64 (or float64 when the block has nulls), so 5, np.int64(5)
+    # and 5.0 must hash identically or the same key routes to different
+    # partitions from different blocks
+    if isinstance(v, np.generic):
+        v = v.item()
+    if isinstance(v, float) and not isinstance(v, bool) and v.is_integer():
+        v = int(v)
+    if isinstance(v, bytes):
+        b = v
+    elif isinstance(v, str):
+        b = v.encode("utf-8", "surrogatepass")
+    elif isinstance(v, bool):
+        b = b"\x01" if v else b"\x00"
+    elif isinstance(v, int):
+        b = v.to_bytes((v.bit_length() + 8) // 8 + 1, "little", signed=True)
+    elif isinstance(v, float):
+        import struct
+        b = struct.pack("<d", v)
+    elif v is None:
+        b = b"\xff"
+    else:
+        b = repr(v).encode("utf-8", "surrogatepass")
+    return zlib.crc32(b)
+
+
 def partition_hash(block, key: str, n: int):
     import numpy as np
     if block.num_rows == 0:
         return [block] * n
     col = block.column(key).to_pandas()
-    part = np.asarray(col.map(lambda v: hash(v) % n), np.int64)
+    part = np.asarray(col.map(lambda v: _stable_hash(v) % n), np.int64)
     return [block.take(np.nonzero(part == j)[0]) for j in range(n)]
 
 

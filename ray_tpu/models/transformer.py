@@ -192,7 +192,11 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, return_hidden=False):
+        """return_hidden=True skips the unembed projection and returns the
+        final-norm hidden states [B,L,d] — callers (train_step's chunked
+        cross-entropy) then compute logits a block at a time so the
+        [B,L,vocab] buffer never exists in HBM."""
         cfg = self.cfg
         B, L = tokens.shape
         if positions is None:
@@ -245,11 +249,15 @@ class TransformerLM(nn.Module):
                      init_fn=lambda: jnp.zeros((), jnp.float32))
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
         if cfg.tie_embeddings:
+            if return_hidden:
+                return x
             logits = jnp.einsum("bld,vd->blv", x, embed.astype(cfg.dtype))
         else:
             out = self.param(
                 "unembed", _p(nn.initializers.normal(0.02), "embed", "vocab"),
                 (cfg.d_model, cfg.vocab_size), cfg.param_dtype)
+            if return_hidden:
+                return x
             logits = jnp.einsum("bld,dv->blv", x, out.astype(cfg.dtype))
         return logits.astype(jnp.float32) if cfg.logits_fp32 else logits
 

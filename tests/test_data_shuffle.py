@@ -61,6 +61,21 @@ def test_distributed_sort_globally_ordered(cluster):
     assert total == n and last == n - 1
 
 
+def test_distributed_groupby_string_keys(cluster):
+    """String keys hash with a salted per-interpreter hash() builtin; the
+    exchange must partition them with a process-independent hash or the
+    same key lands in multiple reduce partitions and the aggregate is
+    silently wrong (one output row per key fragment)."""
+    keys = [f"user-{i % 7}" for i in range(30_000)]
+    ds = rd.from_items([{"k": k, "x": 1.0} for k in keys], parallelism=6)
+    out = ds.groupby("k").sum("x").take_all()
+    assert len(out) == 7, [r["k"] for r in out]
+    got = {r["k"]: list(r.values())[1] for r in out}
+    for i in range(7):
+        expect = sum(1 for k in keys if k == f"user-{i}")
+        assert got[f"user-{i}"] == expect
+
+
 def test_distributed_groupby_agg(cluster):
     ds = rd.from_items([{"k": i % 10, "x": float(i)}
                         for i in range(100_000)])

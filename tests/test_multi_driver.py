@@ -1,0 +1,91 @@
+"""Concurrent driver processes against one cluster (reference:
+python/ray/tests/test_multi_node.py driver-exit tests and the
+multi_client_* rows of release/perf_metrics/microbenchmark.json).
+
+Regression for the round-3 hang: server-side lease requests from a
+disconnected driver ("zombie waiters") could win a freed lease after the
+driver exited, leaking the CPU slot forever and starving every other
+driver (multi_client_tasks_async scored 0.0 via timeout)."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+DRIVER = """
+import sys, time
+import ray_tpu
+ray_tpu.init(address=sys.argv[1])
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+vals = ray_tpu.get([add.remote(i, i) for i in range(40)])
+assert vals == [2 * i for i in range(40)], vals
+n, t0 = 0, time.perf_counter()
+while time.perf_counter() - t0 < 1.0:
+    ray_tpu.get([add.remote(n, 1) for _ in range(50)])
+    n += 50
+print("OK", n, flush=True)
+ray_tpu.shutdown()
+"""
+
+CRASHER = """
+import os, sys
+import ray_tpu
+ray_tpu.init(address=sys.argv[1])
+@ray_tpu.remote
+def nop():
+    return None
+ray_tpu.get([nop.remote() for _ in range(10)])
+print("CRASHING", flush=True)
+os._exit(1)   # hard exit WITHOUT returning leases
+"""
+
+
+@pytest.fixture(scope="module")
+def head():
+    ray_tpu.init(num_cpus=1, object_store_memory=128 * 1024 * 1024)
+    yield ray_tpu.get_gcs_address()
+    ray_tpu.shutdown()
+
+
+def _run_drivers(addr, snippet, n, timeout):
+    procs = [subprocess.Popen(
+        [sys.executable, "-u", "-c", snippet, addr],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for _ in range(n)]
+    outs = []
+    deadline = time.time() + timeout
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=max(1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("driver hung: lease starvation across drivers")
+        outs.append((p.returncode, out))
+    return outs
+
+
+def test_four_concurrent_drivers_one_cpu(head):
+    """4 drivers × (40 verified tasks + 1s of churn) on a 1-CPU node:
+    every driver must finish — the freed lease must cycle between LIVE
+    drivers, never park on a dead driver's abandoned request."""
+    outs = _run_drivers(head, DRIVER, 4, timeout=120)
+    for rc, out in outs:
+        assert rc == 0, out
+        assert "OK" in out, out
+
+
+def test_driver_hard_crash_releases_lease(head):
+    """A driver that os._exit()s while holding a lease must not leak the
+    CPU: the next driver has to complete normally."""
+    outs = _run_drivers(head, CRASHER, 1, timeout=60)
+    assert "CRASHING" in outs[0][1]
+    outs = _run_drivers(head, DRIVER, 2, timeout=90)
+    for rc, out in outs:
+        assert rc == 0, out
+        assert "OK" in out, out
