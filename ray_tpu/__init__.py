@@ -15,7 +15,7 @@ from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.serialization import (ActorDiedError, ObjectLostError,
                                             TaskCancelledError, TaskError,
                                             WorkerCrashedError)
-from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.actor import ActorClass, ActorHandle, method
 from ray_tpu.remote_function import RemoteFunction
 
 __version__ = "0.2.0"
@@ -357,6 +357,6 @@ __all__ = [
     "kill", "cancel", "timeline", "get_actor", "nodes", "cluster_resources",
     "available_resources", "ObjectRef", "ActorHandle", "ActorClass",
     "RemoteFunction", "TaskError", "ActorDiedError", "ObjectLostError",
-    "WorkerCrashedError", "TaskCancelledError", "util",
+    "WorkerCrashedError", "TaskCancelledError", "util", "method",
     "get_runtime_context", "get_gcs_address",
 ]

@@ -124,6 +124,11 @@ _flag("actor_resource_wait_s", float, 60.0,
 _flag("infeasible_grace_s", float, 30.0,
       "How long a request may be cluster-wide infeasible before it is "
       "failed (it stays queued as autoscaler demand until then).")
+_flag("spill_uri", str, "",
+      "Spill target as a URI (empty = node-local directory). Any "
+      "fsspec-resolvable scheme works — gs://bucket/spill on TPU pods, "
+      "s3://, memory:// in tests (reference: external_storage.py "
+      "filesystem-or-cloud spill).")
 _flag("spill_check_interval_s", float, 2.0,
       "Period of the object-spill pressure check loop.")
 _flag("spill_high_watermark", float, 0.8,

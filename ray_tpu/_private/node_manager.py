@@ -190,7 +190,18 @@ class NodeManager:
         # GetSystemConfig handshake, node_manager.proto:432)
         cfg.apply(resp.get("system_config") or {})
         await self.gcs.call("subscribe", channel="NODE")
-        self.spill_dir = f"/tmp/raytpu/{self.session_name}/spill_{self.node_id[:8]}"
+        # spill target: node-local dir by default, any fsspec URI when
+        # cfg.spill_uri is set (gs:// on real pods; memory:// in tests)
+        if cfg.spill_uri:
+            from ray_tpu.util import storage as _storage
+            self.spill_dir = _storage.join(
+                cfg.spill_uri, self.session_name,
+                f"spill_{self.node_id[:8]}")
+            self._spill_remote = _storage.is_remote(self.spill_dir)
+        else:
+            self.spill_dir = (f"/tmp/raytpu/{self.session_name}/"
+                              f"spill_{self.node_id[:8]}")
+            self._spill_remote = False
         self.spilled: Dict[bytes, str] = {}
         self._tasks = [
             asyncio.ensure_future(self._log_monitor_loop()),
@@ -1306,7 +1317,10 @@ class NodeManager:
         cap = st["capacity"] or 1
         if st["bytes_in_use"] < trigger_frac * cap:
             return 0
-        _os.makedirs(self.spill_dir, exist_ok=True)
+        if self._spill_remote:
+            from ray_tpu.util import storage as _storage
+        else:
+            _os.makedirs(self.spill_dir, exist_ok=True)
         n = 0
         for oid in self.store.list_objects():
             if oid in self.spilled:
@@ -1321,13 +1335,19 @@ class NodeManager:
             buf = self.store.get(oid)
             if buf is None:
                 continue
-            path = _os.path.join(self.spill_dir, oid.hex())
             try:
-                with open(path, "wb") as f:
-                    meta = buf.metadata
-                    f.write(len(meta).to_bytes(8, "little"))
-                    f.write(meta)
-                    f.write(buf.data)
+                meta = bytes(buf.metadata)
+                if self._spill_remote:
+                    path = _storage.join(self.spill_dir, oid.hex())
+                    _storage.write_bytes(
+                        path, len(meta).to_bytes(8, "little") + meta
+                        + bytes(buf.data))
+                else:
+                    path = _os.path.join(self.spill_dir, oid.hex())
+                    with open(path, "wb") as f:
+                        f.write(len(meta).to_bytes(8, "little"))
+                        f.write(meta)
+                        f.write(buf.data)
             finally:
                 buf.close()
             self.spilled[oid] = path
@@ -1361,10 +1381,16 @@ class NodeManager:
         if path is None:
             return False
         try:
-            with open(path, "rb") as f:
-                mlen = int.from_bytes(f.read(8), "little")
-                meta = f.read(mlen)
-                data = f.read()
+            if self._spill_remote:
+                from ray_tpu.util import storage as _storage
+                raw = _storage.read_bytes(path)
+                mlen = int.from_bytes(raw[:8], "little")
+                meta, data = raw[8:8 + mlen], raw[8 + mlen:]
+            else:
+                with open(path, "rb") as f:
+                    mlen = int.from_bytes(f.read(8), "little")
+                    meta = f.read(mlen)
+                    data = f.read()
             # make room by spilling, not by evicting un-spilled objects
             self._spill_pass(trigger_frac=0.7, target_frac=0.5)
             bufs = self.store.create(oid, len(data), len(meta))

@@ -440,11 +440,19 @@ class Server:
         return f"tcp:{_advertise_host(host)}:{port}"
 
     async def close(self):
-        for srv in self._servers:
-            srv.close()
-            await srv.wait_closed()
+        # connections BEFORE wait_closed: py3.12's Server.wait_closed()
+        # waits for every live connection handler, so closing the
+        # listening socket first deadlocks against our own still-open
+        # peers (observed: driver shutdown hanging >5s after Data runs,
+        # whose workers keep result-push conns to the driver open)
         for conn in list(self.connections):
             await conn.close()
+        for srv in self._servers:
+            srv.close()
+            try:
+                await asyncio.wait_for(srv.wait_closed(), 2.0)
+            except asyncio.TimeoutError:
+                pass
 
 
 def _advertise_host(bind_host: str) -> str:

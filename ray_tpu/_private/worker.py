@@ -195,6 +195,8 @@ class CoreWorker:
             max_workers=1, thread_name_prefix="task-exec")
         self._exec_queue: Optional[asyncio.Queue] = None
         self._consumers: List[asyncio.Task] = []
+        self._group_queues: Dict[str, asyncio.Queue] = {}
+        self._method_groups: Dict[str, str] = {}
         self.actor_instance = None
         self.actor_id: Optional[str] = None
         self.actor_spec: Optional[Dict] = None
@@ -1421,7 +1423,8 @@ class CoreWorker:
                                  namespace=None, max_restarts=0,
                                  max_concurrency=1, scheduling=None,
                                  lifetime=None, method_names=None,
-                                 runtime_env=None) -> str:
+                                 runtime_env=None, concurrency_groups=None,
+                                 method_groups=None) -> str:
         actor_id = ids.new_actor_id(ids.job_id_from_int(self.job_id)).hex()
         cid = await self._ship_function(cls)
         arg_refs: List[ObjectRef] = []
@@ -1439,6 +1442,8 @@ class CoreWorker:
             "owner_address": self.address,
             "lifetime": lifetime,
             "method_names": list(method_names or []),
+            "concurrency_groups": dict(concurrency_groups or {}),
+            "method_groups": dict(method_groups or {}),
         }
         if runtime_env:
             spec["runtime_env"] = await self._package_runtime_env(
@@ -1506,7 +1511,7 @@ class CoreWorker:
         return st
 
     def _build_actor_task_spec(self, actor_id, method, args, kwargs,
-                               num_returns):
+                               num_returns, concurrency_group=None):
         task_id = ids.new_task_id(ids.job_id_from_int(self.job_id))
         return_ids = [ids.object_id_for_return(task_id, i)
                       for i in range(1, num_returns + 1)]
@@ -1520,28 +1525,34 @@ class CoreWorker:
             "return_ids": return_ids, "owner_address": self.address,
             "owner_node": self.node_id,
         }
+        if concurrency_group:
+            spec["concurrency_group"] = concurrency_group
         refs = [ObjectRef(rid, self.address) for rid in return_ids]
         return spec, return_ids, arg_refs, refs
 
     def submit_actor_task_threadsafe(self, actor_id: str, method: str,
                                      args, kwargs, num_returns=1,
-                                     max_task_retries=0) -> List[ObjectRef]:
+                                     max_task_retries=0,
+                                     concurrency_group=None
+                                     ) -> List[ObjectRef]:
         """Fire-and-forget actor submission from a user thread — no loop
         round trip per call. Ordering: the submit buffer is FIFO and
         _finish_actor_submit enqueues synchronously, so calls from one
         thread start in submission order (the reference's
         SequentialActorSubmitQueue guarantee)."""
         spec, return_ids, arg_refs, refs = self._build_actor_task_spec(
-            actor_id, method, args, kwargs, num_returns)
+            actor_id, method, args, kwargs, num_returns, concurrency_group)
         self._enqueue_submit(self._finish_actor_submit, spec, return_ids,
                              arg_refs, max_task_retries)
         return refs
 
     async def submit_actor_task_async(self, actor_id: str, method: str,
                                       args, kwargs, num_returns=1,
-                                      max_task_retries=0) -> List[ObjectRef]:
+                                      max_task_retries=0,
+                                      concurrency_group=None
+                                      ) -> List[ObjectRef]:
         spec, return_ids, arg_refs, refs = self._build_actor_task_spec(
-            actor_id, method, args, kwargs, num_returns)
+            actor_id, method, args, kwargs, num_returns, concurrency_group)
         self._finish_actor_submit(spec, return_ids, arg_refs,
                                   max_task_retries)
         return refs
@@ -1775,7 +1786,7 @@ class CoreWorker:
         # future's done-callback, so the hot execution path spawns no
         # per-call dispatch task
         fut = self.loop.create_future()
-        self._exec_queue.put_nowait((spec, fut))
+        self._queue_for(spec).put_nowait((spec, fut))
         return fut
 
     def h_push_tasks(self, conn, seq, specs: List[Dict]):
@@ -1806,7 +1817,7 @@ class CoreWorker:
         for idx, spec in enumerate(specs):
             fut = self.loop.create_future()
             fut.add_done_callback(make_cb(idx))
-            self._exec_queue.put_nowait((spec, fut))
+            self._queue_for(spec).put_nowait((spec, fut))
 
     h_push_tasks.streaming = True
 
@@ -1821,9 +1832,20 @@ class CoreWorker:
             asyncio.get_event_loop().call_later(0.05, os._exit, 1)
         return True
 
-    async def _exec_consumer(self):
+    def _queue_for(self, spec: Dict) -> "asyncio.Queue":
+        """Route a task to its concurrency group's queue (per-call option
+        wins over the method's declared group; default queue otherwise)."""
+        gq = getattr(self, "_group_queues", None)
+        if not gq:
+            return self._exec_queue
+        group = spec.get("concurrency_group") \
+            or self._method_groups.get(spec.get("method"))
+        return gq.get(group, self._exec_queue)
+
+    async def _exec_consumer(self, queue: Optional["asyncio.Queue"] = None):
+        queue = queue if queue is not None else self._exec_queue
         while not self._shutdown:
-            spec, fut = await self._exec_queue.get()
+            spec, fut = await queue.get()
             if spec["task_id"] in self._cancelled_tasks:
                 self._cancelled_tasks.discard(spec["task_id"])
                 result = self._encode_error(
@@ -2197,13 +2219,28 @@ class CoreWorker:
         self.actor_id = spec["actor_id"]
         self.actor_spec = spec
         maxc = spec.get("max_concurrency", 1)
-        if maxc > 1:
+        groups = spec.get("concurrency_groups") or {}
+        self._method_groups = spec.get("method_groups") or {}
+        extra = sum(groups.values())
+        if maxc > 1 or groups:
             self._inline_ok = False    # parallel methods need real threads
             self.executor = concurrent.futures.ThreadPoolExecutor(
-                max_workers=maxc, thread_name_prefix="actor-exec")
+                max_workers=maxc + extra, thread_name_prefix="actor-exec")
             for _ in range(maxc - 1):
                 self._consumers.append(
                     self._spawn(self._exec_consumer()))
+        # concurrency groups: per-group FIFO queue with its own consumer
+        # pool, so e.g. an "io" group keeps serving while the default
+        # group is busy (reference: ConcurrencyGroupManager + fibers,
+        # core_worker/transport/concurrency_group_manager.h — threads
+        # here, the asyncio loop plays the fiber scheduler)
+        self._group_queues: Dict[str, asyncio.Queue] = {}
+        for gname, limit in groups.items():
+            q: asyncio.Queue = asyncio.Queue()
+            self._group_queues[gname] = q
+            for _ in range(max(1, int(limit))):
+                self._consumers.append(
+                    self._spawn(self._exec_consumer(q)))
         inner = cls.__ray_tpu_actual_class__ if hasattr(
             cls, "__ray_tpu_actual_class__") else cls
         instance = await self.loop.run_in_executor(

@@ -68,11 +68,13 @@ class ActorHandle:
     def _invoke(self, method: str, args, kwargs, opts: Dict[str, Any]):
         from ray_tpu import _get_worker
         w = _get_worker()
-        num_returns = opts.get("num_returns", 1)
+        num_returns = opts.get("num_returns") \
+            or opts.get("method_num_returns", {}).get(method, 1)
         refs = w.submit_actor_task(
             self._actor_id, method, args, kwargs,
             num_returns=num_returns,
-            max_task_retries=opts.get("max_task_retries", 0))
+            max_task_retries=opts.get("max_task_retries", 0),
+            concurrency_group=opts.get("concurrency_group"))
         return refs[0] if num_returns == 1 else refs
 
     def __reduce__(self):
@@ -120,6 +122,42 @@ def _public_methods(cls) -> List[str]:
     return names
 
 
+def _method_groups(cls) -> Dict[str, str]:
+    """method name -> concurrency group declared via @ray_tpu.method."""
+    inner = getattr(cls, "__ray_tpu_actual_class__", cls)
+    out = {}
+    for name, member in inspect.getmembers(inner):
+        group = getattr(member, "__concurrency_group__", None)
+        if group:
+            out[name] = group
+    return out
+
+
+def _method_num_returns(cls) -> Dict[str, int]:
+    """method name -> num_returns declared via @ray_tpu.method."""
+    inner = getattr(cls, "__ray_tpu_actual_class__", cls)
+    out = {}
+    for name, member in inspect.getmembers(inner):
+        n = getattr(member, "__num_returns__", None)
+        if n is not None:
+            out[name] = int(n)
+    return out
+
+
+def method(*, concurrency_group: Optional[str] = None, num_returns=None):
+    """Method decorator (reference: ray.method — python/ray/actor.py).
+    Declares the concurrency group an actor method executes in; groups
+    and their widths are given at class level via
+    ``@ray_tpu.remote(concurrency_groups={"io": 2})``."""
+    def deco(fn):
+        if concurrency_group:
+            fn.__concurrency_group__ = concurrency_group
+        if num_returns is not None:
+            fn.__num_returns__ = num_returns
+        return fn
+    return deco
+
+
 class ActorClass:
     def __init__(self, cls, options: Optional[Dict[str, Any]] = None):
         self._cls = cls
@@ -145,10 +183,14 @@ class ActorClass:
             scheduling=_scheduling_from_options(opts),
             lifetime=opts.get("lifetime"),
             method_names=_public_methods(self._cls),
-            runtime_env=opts.get("runtime_env"))
-        return ActorHandle(actor_id, _public_methods(self._cls),
-                           {"max_task_retries": opts.get("max_task_retries", 0)},
-                           is_owner=opts.get("lifetime") != "detached")
+            runtime_env=opts.get("runtime_env"),
+            concurrency_groups=opts.get("concurrency_groups"),
+            method_groups=_method_groups(self._cls))
+        return ActorHandle(
+            actor_id, _public_methods(self._cls),
+            {"max_task_retries": opts.get("max_task_retries", 0),
+             "method_num_returns": _method_num_returns(self._cls)},
+            is_owner=opts.get("lifetime") != "detached")
 
     def options(self, **new_options) -> "ActorClass":
         return ActorClass(self._cls, {**self._options, **new_options})

@@ -16,6 +16,7 @@ class Dataset:
     def __init__(self, stages: List[exe.Stage]):
         self._stages = stages
         self._materialized: Optional[List[exe.RefBundle]] = None
+        self._last_stats: Optional[exe.ExecutionStats] = None
 
     # ------------------------------------------------------------ transforms
     def _extend(self, stage: exe.Stage) -> "Dataset":
@@ -183,12 +184,37 @@ class Dataset:
     def _execute(self) -> Iterator[exe.RefBundle]:
         if self._materialized is not None:
             return iter(self._materialized)
-        return exe.execute_plan(self._stages)
+        self._last_stats = exe.ExecutionStats()
+        return exe.execute_plan(self._stages, stats=self._last_stats)
+
+    def stats(self) -> str:
+        """Per-operator execution metrics (tasks/rows/bytes/wall) for the
+        most recent execution — runs the plan if it never executed
+        (reference: Dataset.stats(), _internal/stats.py)."""
+        if self._materialized is not None:
+            if self._last_stats is not None:
+                return self._last_stats.summary()
+            st = exe.StageStats("Input")
+            for _, meta in self._materialized:
+                st.tasks += 1
+                st.rows += getattr(meta, "num_rows", 0) or 0
+                st.bytes += getattr(meta, "size_bytes", 0) or 0
+            st.done = True
+            stats = exe.ExecutionStats()
+            stats.stages.append(st)
+            self._last_stats = stats
+            return stats.summary()
+        if self._last_stats is None or not all(
+                s.done for s in self._last_stats.stages):
+            for _ in self._execute():
+                pass
+        return self._last_stats.summary()
 
     def materialize(self) -> "Dataset":
         bundles = list(self._execute())
         ds = Dataset([exe.InputStage(bundles)])
         ds._materialized = bundles
+        ds._last_stats = self._last_stats   # stats of the producing run
         return ds
 
     def get_internal_block_refs(self) -> List:

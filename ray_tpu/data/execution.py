@@ -85,32 +85,41 @@ class ExecutionBudget:
         self.bytes -= est_bytes
 
 
-def _map_block_remote(fn_kind: str, fn, block, batch_format: str,
-                      fn_args, fn_kwargs):
-    """Runs inside a worker: apply one transform to one block.
-    Returns (block, metadata) — the block stays in the executing node's
-    store; the driver only reads the metadata."""
+def _apply_one(fn_kind: str, fn, block, batch_format: str,
+               fn_args, fn_kwargs):
     from ray_tpu.data import block as B
     if fn_kind == "map_batches":
         batch = B.block_to_batch(block, batch_format)
         out = fn(batch, *fn_args, **(fn_kwargs or {}))
-        out_block = B.block_from_batch(out)
-    elif fn_kind == "map":
-        out_block = B.block_from_rows(
+        return B.block_from_batch(out)
+    if fn_kind == "map":
+        return B.block_from_rows(
             [fn(r, *fn_args, **(fn_kwargs or {}))
              for r in B.block_to_rows(block)])
-    elif fn_kind == "filter":
-        out_block = B.block_from_rows(
+    if fn_kind == "filter":
+        return B.block_from_rows(
             [r for r in B.block_to_rows(block)
              if fn(r, *fn_args, **(fn_kwargs or {}))])
-    elif fn_kind == "flat_map":
+    if fn_kind == "flat_map":
         rows = []
         for r in B.block_to_rows(block):
             rows.extend(fn(r, *fn_args, **(fn_kwargs or {})))
-        out_block = B.block_from_rows(rows)
-    else:
-        raise ValueError(fn_kind)
-    return out_block, B.block_metadata(out_block)
+        return B.block_from_rows(rows)
+    raise ValueError(fn_kind)
+
+
+def _map_block_remote(ops, block):
+    """Runs inside a worker: apply a CHAIN of transforms to one block —
+    a fused .map().filter().map_batches() pipeline touches the object
+    store once, not once per operator (reference: operator fusion rule,
+    _internal/logical/rules/operator_fusion.py). Returns (block,
+    metadata); the block stays in the executing node's store and the
+    driver only reads the metadata."""
+    from ray_tpu.data import block as B
+    for (fn_kind, fn, batch_format, fn_args, fn_kwargs) in ops:
+        block = _apply_one(fn_kind, fn, block, batch_format,
+                           fn_args, fn_kwargs)
+    return block, B.block_metadata(block)
 
 
 class Stage:
@@ -132,6 +141,8 @@ class InputStage(Stage):
 
 class ReadStage(Stage):
     """Launches read tasks from serialized read descriptors."""
+
+    name = "Read"
 
     def __init__(self, read_fns: List[Callable], max_in_flight: int = None,
                  concurrency: Optional[int] = None):
@@ -174,18 +185,37 @@ def _with_meta(block):
 
 
 class MapStage(Stage):
+    """One (or a fused chain of) map-family transform(s); each input
+    block becomes one remote task applying every fused op in sequence."""
+
     def __init__(self, fn_kind: str, fn, batch_format: str = "numpy",
                  fn_args=(), fn_kwargs=None, max_in_flight: int = None,
                  concurrency: Optional[int] = None,
                  num_cpus: Optional[float] = None):
-        self.fn_kind = fn_kind
-        self.fn = fn
-        self.batch_format = batch_format
-        self.fn_args = fn_args
-        self.fn_kwargs = fn_kwargs
+        self.ops = [(fn_kind, fn, batch_format, fn_args, fn_kwargs)]
+        self.concurrency = concurrency
         self.num_cpus = num_cpus
         self.max_in_flight = (concurrency or max_in_flight
                               or DEFAULT_MAX_IN_FLIGHT)
+
+    @property
+    def name(self) -> str:
+        return "Map(" + "->".join(k for k, *_ in self.ops) + ")"
+
+    @staticmethod
+    def fused(a: "MapStage", b: "MapStage") -> "MapStage":
+        """a then b as ONE task per block (task-pool stages only; the
+        optimizer never fuses across ActorPoolMapStage/AllToAll)."""
+        out = MapStage.__new__(MapStage)
+        out.ops = a.ops + b.ops
+        out.concurrency = (min(a.concurrency, b.concurrency)
+                           if a.concurrency and b.concurrency
+                           else a.concurrency or b.concurrency)
+        out.num_cpus = (max(a.num_cpus, b.num_cpus)
+                        if a.num_cpus and b.num_cpus
+                        else a.num_cpus or b.num_cpus)
+        out.max_in_flight = min(a.max_in_flight, b.max_in_flight)
+        return out
 
     def execute(self, upstream, budget=None):
         opts = {"num_returns": 2}
@@ -214,9 +244,7 @@ class MapStage(Stage):
                     break
                 ref, meta = nxt
                 peek_est = getattr(meta, "size_bytes", 0) or 0
-                window.append((remote_map.remote(
-                    self.fn_kind, self.fn, ref, self.batch_format,
-                    self.fn_args, self.fn_kwargs), est))
+                window.append((remote_map.remote(self.ops, ref), est))
             if not window:
                 return
             (block_ref, meta_ref), est = window.popleft()
@@ -316,6 +344,10 @@ class AllToAllStage(Stage):
     def __init__(self, kind: str, **kwargs):
         self.kind = kind
         self.kwargs = kwargs
+
+    @property
+    def name(self) -> str:
+        return f"AllToAll({self.kind})"
 
     def execute(self, upstream, budget=None):
         bundles = list(upstream)
@@ -428,11 +460,102 @@ class LimitStage(Stage):
                 return
 
 
+class StageStats:
+    """Per-operator runtime metrics (reference: _internal/stats.py +
+    op_runtime_metrics.py — rows/bytes/tasks/wall per operator,
+    surfaced as Dataset.stats())."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.tasks = 0        # output bundles == tasks for read/map stages
+        self.rows = 0
+        self.bytes = 0
+        self.wall_s = 0.0
+        self.done = False
+
+    def line(self, self_wall_s: Optional[float] = None) -> str:
+        mb = self.bytes / (1024 * 1024)
+        wall = self.wall_s if self_wall_s is None else self_wall_s
+        return (f"{self.name}: {self.tasks} tasks, {self.rows} rows, "
+                f"{mb:.2f} MiB, {wall * 1e3:.0f} ms")
+
+
+class ExecutionStats:
+    def __init__(self):
+        self.stages: List[StageStats] = []
+        self.total_wall_s = 0.0
+
+    def summary(self) -> str:
+        # a stage's measured wall INCLUDES its whole upstream chain
+        # (pull-based generators); report the nested-profiler difference
+        # so each operator shows only its own contribution
+        lines = []
+        prev = 0.0
+        for i, st in enumerate(self.stages):
+            lines.append(
+                f"Operator {i} {st.line(max(0.0, st.wall_s - prev))}")
+            prev = max(prev, st.wall_s)
+        lines.append(f"Total: {self.total_wall_s * 1e3:.0f} ms")
+        return "\n".join(lines)
+
+
+def _instrument(stream: Iterator[RefBundle], st: StageStats
+                ) -> Iterator[RefBundle]:
+    import time
+    while True:
+        t0 = time.perf_counter()
+        try:
+            ref, meta = next(stream)
+        except StopIteration:
+            st.wall_s += time.perf_counter() - t0
+            st.done = True
+            return
+        st.wall_s += time.perf_counter() - t0
+        st.tasks += 1
+        st.rows += getattr(meta, "num_rows", 0) or 0
+        st.bytes += getattr(meta, "size_bytes", 0) or 0
+        yield (ref, meta)
+
+
+def optimize_plan(stages: List[Stage]) -> List[Stage]:
+    """Rule pass: fuse adjacent task-pool map-family stages so a
+    .map().filter() chain pays ONE object-store round trip per block
+    (reference: logical/rules/operator_fusion.py). Actor-pool and
+    all-to-all stages are fusion barriers."""
+    out: List[Stage] = []
+    for s in stages:
+        if (out and type(s) is MapStage and type(out[-1]) is MapStage):
+            out[-1] = MapStage.fused(out[-1], s)
+        else:
+            out.append(s)
+    return out
+
+
 def execute_plan(stages: List[Stage],
-                 budget: Optional[ExecutionBudget] = None
-                 ) -> Iterator[RefBundle]:
+                 budget: Optional[ExecutionBudget] = None,
+                 stats: Optional[ExecutionStats] = None,
+                 optimize: bool = True) -> Iterator[RefBundle]:
     budget = budget or ExecutionBudget()
+    if optimize:
+        stages = optimize_plan(stages)
     stream: Iterator[RefBundle] = iter(())
     for stage in stages:
         stream = stage.execute(stream, budget)
+        if stats is not None:
+            st = StageStats(getattr(stage, "name", None)
+                            or type(stage).__name__)
+            stats.stages.append(st)
+            stream = _instrument(stream, st)
+    if stats is not None:
+        stream = _total_wall(stream, stats)
     return stream
+
+
+def _total_wall(stream: Iterator[RefBundle], stats: ExecutionStats
+                ) -> Iterator[RefBundle]:
+    import time
+    t0 = time.perf_counter()
+    try:
+        yield from stream
+    finally:
+        stats.total_wall_s = time.perf_counter() - t0

@@ -30,6 +30,18 @@ MODEL_REGISTRY = {
     "tpu-1b": TransformerConfig(
         vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
         n_kv_heads=16, d_ff=5632, max_seq_len=4096),
+    # Larger rungs keep hd=128 and add GQA (4:1) — KV projections are
+    # bandwidth, not FLOPs, and 8 KV heads shard cleanly over an 8-way
+    # tensor axis. tpu-3b is the largest single-v5e-chip (16 GB) rung:
+    # it needs bf16 params + adafactor + chunked cross-entropy to fit
+    # (see reports/MFU_ABLATION.md OOM table); tpu-7b (llama-7b-class
+    # FLOPs, MXU-aligned d_ff) is the multi-chip FSDP flagship.
+    "tpu-3b": TransformerConfig(
+        vocab_size=32000, d_model=3072, n_layers=24, n_heads=24,
+        n_kv_heads=8, d_ff=8192, max_seq_len=4096),
+    "tpu-7b": TransformerConfig(
+        vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, d_ff=11264, max_seq_len=4096),
     # MoE family (models/moe.py): expert-parallel over the mesh `expert` axis
     "moe-debug": TransformerConfig(
         vocab_size=1024, d_model=256, n_layers=2, n_heads=4, n_kv_heads=2,

@@ -21,13 +21,20 @@ class Algorithm:
         from ray_tpu.rl.env_runner import EnvRunner
         from ray_tpu.rl.learner import LearnerGroup
 
+        from ray_tpu.rl import envs as _envs
+        from ray_tpu.rl.rl_module import action_spec_of
+        _envs.register_envs()
         self.config = config
         probe = gym.make(config.env, **config.env_config)
-        obs_dim = int(np.prod(probe.observation_space.shape))
-        action_dim = probe.action_space.n
+        obs_shape = probe.observation_space.shape
+        obs_dim = int(np.prod(obs_shape))
+        spec = action_spec_of(probe.action_space)
+        action_dim = spec.get("n") or spec["dim"]
         probe.close()
 
         cfg_dict = dataclasses.asdict(config)
+        cfg_dict["obs_shape"] = list(obs_shape)
+        cfg_dict["action_spec"] = spec
         runner_cls = ray_tpu.remote(EnvRunner)
         self.env_runners = [
             runner_cls.remote({**cfg_dict, "runner_index": i})

@@ -13,19 +13,34 @@ import numpy as np
 
 class EnvRunner:
     def __init__(self, config: Dict):
+        # rollout workers are CPU-side: a per-step policy forward for a
+        # handful of envs is latency-bound, and round-tripping it through
+        # a TPU (tunnel) turns ~3000 steps/s into ~20. The learner is
+        # where the accelerator belongs (reference: env runners are CPU
+        # actors; only Learner workers get GPUs/TPUs). The env var alone
+        # is not enough — device plugins registered via sitecustomize
+        # override it — so pin via jax.config before the backend spins up.
         import gymnasium as gym
         import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass   # backend already initialized (driver-local runner)
+
+        from ray_tpu.rl import envs as _envs   # registers built-in envs
+        _envs.register_envs()
         self.cfg = config
         self.n_envs = config["num_envs_per_env_runner"]
         self.envs = gym.vector.SyncVectorEnv(
             [lambda: gym.make(config["env"], **config.get("env_config", {}))
              for _ in range(self.n_envs)])
-        obs_dim = int(np.prod(self.envs.single_observation_space.shape))
-        action_dim = self.envs.single_action_space.n
-        from ray_tpu.rl.rl_module import DiscreteRLModule
-        self.module = DiscreteRLModule(obs_dim, action_dim,
-                                       config.get("hidden_sizes", (64, 64)),
-                                       seed=config.get("seed", 0))
+        from ray_tpu.rl.rl_module import action_spec_of, make_rl_module
+        obs_shape = self.envs.single_observation_space.shape
+        self.action_spec = action_spec_of(self.envs.single_action_space)
+        self.module = make_rl_module(
+            obs_shape, self.action_spec,
+            config.get("hidden_sizes", (64, 64)),
+            seed=config.get("seed", 0))
         self.rng = jax.random.PRNGKey(config.get("seed", 0)
                                       + config.get("runner_index", 0) * 1000)
         self.obs, _ = self.envs.reset(seed=config.get("seed", 0)
@@ -46,7 +61,8 @@ class EnvRunner:
         T = num_steps or self.cfg["rollout_fragment_length"]
         N = self.n_envs
         obs_buf = np.zeros((T, N) + self.obs.shape[1:], np.float32)
-        act_buf = np.zeros((T, N), np.int64)
+        act_buf = np.zeros((T, N) + self.module.action_event_shape,
+                           self.module.action_np_dtype)
         logp_buf = np.zeros((T, N), np.float32)
         rew_buf = np.zeros((T, N), np.float32)
         done_buf = np.zeros((T, N), np.float32)
@@ -57,7 +73,10 @@ class EnvRunner:
             self.rng, key = jax.random.split(self.rng)
             action, logp, value = self.module.sample_actions(
                 self.module.params, obs.astype(np.float32), key)
-            nxt, rew, term, trunc, _ = self.envs.step(action)
+            env_action = (self.module.clip_actions(action)
+                          if hasattr(self.module, "clip_actions")
+                          else action)
+            nxt, rew, term, trunc, _ = self.envs.step(env_action)
             done = np.logical_or(term, trunc)
             obs_buf[t] = obs
             act_buf[t] = action

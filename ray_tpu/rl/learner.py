@@ -1,9 +1,13 @@
 """JaxLearner + LearnerGroup (reference: rllib/core/learner/learner.py,
 torch_learner.py:64 compute/apply gradients, learner_group.py:80).
-The PPO update is one jitted function (minibatch epochs via host loop);
-multi-learner data parallelism averages gradients through the collective
-store backend (on TPU pods the learners would instead share one jit over
-the device mesh — psum by sharding)."""
+
+The PPO update is one jitted function (minibatch epochs via host loop).
+Multi-learner data parallelism runs the IDENTICAL epoch/minibatch
+schedule on every learner with per-minibatch gradient averaging — the
+same algorithm as n=1, just with an n-times-larger effective minibatch
+(reference: learner_group.py DDP semantics — every learner executes the
+same update loop with synced grads; on TPU pods the learners would
+instead share one jit over the device mesh, psum by sharding)."""
 
 from __future__ import annotations
 
@@ -17,26 +21,31 @@ class JaxLearner:
         import jax
         import jax.numpy as jnp
         import optax
-        from ray_tpu.rl.rl_module import DiscreteRLModule
+
+        from ray_tpu.rl.rl_module import make_rl_module
 
         self.cfg = config
-        self.module = DiscreteRLModule(obs_dim, action_dim,
-                                       config.get("hidden_sizes", (64, 64)),
-                                       seed=config.get("seed", 0))
+        obs_shape = tuple(config.get("obs_shape") or (obs_dim,))
+        action_spec = (config.get("action_spec")
+                       or {"type": "discrete", "n": action_dim})
+        self.module = make_rl_module(
+            obs_shape, action_spec,
+            config.get("hidden_sizes", (64, 64)),
+            seed=config.get("seed", 0))
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(config.get("grad_clip", 0.5)),
             optax.adam(config["lr"]))
         self.opt_state = self.optimizer.init(self.module.params)
+        self.num_updates = 0
+        self._shard: Optional[Dict[str, np.ndarray]] = None
         clip = config["clip_param"]
         vf_coeff = config["vf_loss_coeff"]
         ent_coeff = config["entropy_coeff"]
-        net = self.module.net
+        module = self.module
 
         def loss_fn(params, batch):
-            logits, values = net.apply({"params": params}, batch["obs"])
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, batch["actions"][:, None], axis=1)[:, 0]
+            logp, entropy, values = module.logp_entropy_value(
+                params, batch["obs"], batch["actions"])
             ratio = jnp.exp(logp - batch["logp"])
             adv = batch["advantages"]
             adv = (adv - adv.mean()) / (adv.std() + 1e-8)
@@ -44,12 +53,10 @@ class JaxLearner:
             pg2 = jnp.clip(ratio, 1 - clip, 1 + clip) * adv
             pg_loss = -jnp.minimum(pg1, pg2).mean()
             vf_loss = ((values - batch["value_targets"]) ** 2).mean()
-            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-            total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            ent = entropy.mean()
+            total = pg_loss + vf_coeff * vf_loss - ent_coeff * ent
             return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
-                           "entropy": entropy}
-
-        import jax
+                           "entropy": ent}
 
         @jax.jit
         def update(params, opt_state, batch):
@@ -57,8 +64,7 @@ class JaxLearner:
                 loss_fn, has_aux=True)(params, batch)
             updates, new_opt = self.optimizer.update(grads, opt_state,
                                                      params)
-            import optax as _ox
-            new_params = _ox.apply_updates(params, updates)
+            new_params = optax.apply_updates(params, updates)
             return new_params, new_opt, loss, aux
 
         @jax.jit
@@ -71,8 +77,7 @@ class JaxLearner:
         def apply_grads(params, opt_state, grads):
             updates, new_opt = self.optimizer.update(grads, opt_state,
                                                      params)
-            import optax as _ox
-            return _ox.apply_updates(params, updates), new_opt
+            return optax.apply_updates(params, updates), new_opt
 
         self._update = update
         self._grads_only = grads_only
@@ -83,7 +88,6 @@ class JaxLearner:
         n = len(batch["obs"])
         mb = self.cfg["minibatch_size"]
         rng = np.random.default_rng(0)
-        metrics = {}
         for _ in range(self.cfg["num_epochs"]):
             idx = rng.permutation(n)
             for start in range(0, n, mb):
@@ -91,9 +95,32 @@ class JaxLearner:
                 mini = {k: jnp.asarray(v[sel]) for k, v in batch.items()}
                 self.module.params, self.opt_state, loss, aux = \
                     self._update(self.module.params, self.opt_state, mini)
+                self.num_updates += 1
         metrics = {k: float(v) for k, v in aux.items()}
         metrics["total_loss"] = float(loss)
+        metrics["num_minibatch_updates"] = self.num_updates
         return metrics
+
+    # ------------------------------------------------- multi-learner path
+    def set_batch(self, shard: Dict[str, np.ndarray]) -> int:
+        """Stage this learner's shard for the epoch/minibatch schedule."""
+        self._shard = {k: np.asarray(v) for k, v in shard.items()}
+        return len(self._shard["obs"])
+
+    def minibatch_gradients(self, epoch: int, mb_index: int):
+        """Gradients for minibatch `mb_index` of epoch `epoch` over the
+        staged shard — every learner runs the SAME schedule; the group
+        averages these per minibatch (reference DDP semantics)."""
+        import jax
+        import jax.numpy as jnp
+        n = len(self._shard["obs"])
+        mb = min(self.cfg["minibatch_size"], n)
+        idx = np.random.default_rng(epoch).permutation(n)
+        sel = idx[(mb_index * mb) % n:(mb_index * mb) % n + mb]
+        mini = {k: jnp.asarray(v[sel]) for k, v in self._shard.items()}
+        grads, loss, aux = self._grads_only(self.module.params, mini)
+        return (jax.device_get(grads), float(loss),
+                {k: float(v) for k, v in aux.items()})
 
     def compute_gradients(self, batch: Dict[str, np.ndarray]):
         import jax
@@ -105,7 +132,8 @@ class JaxLearner:
     def apply_gradients(self, grads):
         self.module.params, self.opt_state = self._apply_grads(
             self.module.params, self.opt_state, grads)
-        return True
+        self.num_updates += 1
+        return self.num_updates
 
     def get_weights(self):
         return self.module.get_weights()
@@ -123,6 +151,7 @@ class LearnerGroup:
         import ray_tpu
         self.cfg = config
         self.n = config.get("num_learners", 1)
+        self.num_updates = 0
         if self.n <= 1:
             self.local = JaxLearner(config, obs_dim, action_dim)
             self.remote = []
@@ -135,21 +164,41 @@ class LearnerGroup:
     def update_from_batch(self, batch: Dict[str, np.ndarray]) -> Dict:
         import ray_tpu
         if self.local is not None:
-            return self.local.update_from_batch(batch)
-        # split batch across learners, average gradients per minibatch-free
-        # round (simplified DDP: one grad step per call per learner)
+            m = self.local.update_from_batch(batch)
+            self.num_updates = m["num_minibatch_updates"]
+            return m
+        # n>1 runs the SAME minibatch-epoch PPO as n=1: each learner
+        # holds a shard, every (epoch, minibatch) step computes local
+        # grads which are averaged and applied everywhere — NOT one giant
+        # step on split shards (round-3 weakness #3)
         import jax
         shards = {k: np.array_split(v, self.n) for k, v in batch.items()}
         per = [{k: shards[k][i] for k in batch} for i in range(self.n)]
-        grad_refs = [l.compute_gradients.remote(p)
-                     for l, p in zip(self.remote, per)]
-        grads_losses = ray_tpu.get(grad_refs, timeout=300)
-        grads = [g for g, _ in grads_losses]
-        avg = jax.tree.map(lambda *gs: np.mean(np.stack(gs), axis=0),
-                           *grads)
-        ray_tpu.get([l.apply_gradients.remote(avg) for l in self.remote],
-                    timeout=300)
-        return {"total_loss": float(np.mean([l for _, l in grads_losses]))}
+        rows = ray_tpu.get(
+            [l.set_batch.remote(p) for l, p in zip(self.remote, per)],
+            timeout=300)
+        mb = self.cfg["minibatch_size"]
+        # ceil: the tail minibatch is included, same as the n=1 loop's
+        # range(0, n, mb) (a floor would silently drop up to mb-1 rows
+        # of experience per shard per epoch)
+        n_mb = max(1, -(-min(rows) // max(1, mb)))
+        losses, aux = [], {}
+        for epoch in range(self.cfg["num_epochs"]):
+            for j in range(n_mb):
+                outs = ray_tpu.get(
+                    [l.minibatch_gradients.remote(epoch, j)
+                     for l in self.remote], timeout=300)
+                grads = [g for g, _, _ in outs]
+                losses = [l for _, l, _ in outs]
+                aux = outs[0][2]
+                avg = jax.tree.map(
+                    lambda *gs: np.mean(np.stack(gs), axis=0), *grads)
+                avg_ref = ray_tpu.put(avg)
+                self.num_updates = ray_tpu.get(
+                    [l.apply_gradients.remote(avg_ref)
+                     for l in self.remote], timeout=300)[0]
+        return {**aux, "total_loss": float(np.mean(losses)),
+                "num_minibatch_updates": self.num_updates}
 
     def get_weights(self):
         import ray_tpu

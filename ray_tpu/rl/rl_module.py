@@ -1,11 +1,23 @@
 """RLModule: the framework-agnostic model API, jax/flax implementation
-(reference: rllib/core/rl_module/ — here a flax policy+value module with
+(reference: rllib/core/rl_module/ — flax policy+value modules with
 pure-function forward passes so env runners and learners share one
-parameter pytree)."""
+parameter pytree).
+
+Three module families (reference: rllib/models/ catalog — MLP, CNN and
+continuous-action heads):
+- DiscreteRLModule: MLP trunk, categorical head (flat observations)
+- ConvDiscreteRLModule: shared CNN encoder, categorical head (image obs)
+- ContinuousRLModule: MLP trunk, diagonal-Gaussian head (Box actions,
+  reference: rllib TorchDiagGaussian action dist)
+
+Every module exposes the same surface: `sample_actions` (env-runner side)
+and `logp_entropy_value` (a pure, jit-traceable function the PPO/IMPALA
+losses call), so learners are action-space agnostic."""
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+import math
+from typing import Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -23,28 +35,83 @@ class PolicyValueNet(nn.Module):
         for h in self.hidden_sizes:
             x = nn.tanh(nn.Dense(h)(x))
         logits = nn.Dense(self.action_dim)(x)
-        v = x
+        v = obs
         for h in self.hidden_sizes:
             v = nn.tanh(nn.Dense(h)(v))
         value = nn.Dense(1)(v)[..., 0]
         return logits, value
 
 
-class DiscreteRLModule:
-    """Policy/value module for discrete action spaces."""
+class GaussianPolicyValueNet(nn.Module):
+    """Diagonal-Gaussian policy for Box action spaces; log_std is a free
+    state-independent parameter (rllib's default for PPO)."""
+    action_dim: int
+    hidden_sizes: Sequence[int] = (64, 64)
 
-    def __init__(self, obs_dim: int, action_dim: int,
-                 hidden_sizes: Sequence[int] = (64, 64), seed: int = 0):
-        self.obs_dim = obs_dim
-        self.action_dim = action_dim
-        self.net = PolicyValueNet(action_dim, tuple(hidden_sizes))
-        self.params = self.net.init(
-            jax.random.PRNGKey(seed), jnp.zeros((1, obs_dim)))["params"]
-        self._forward = jax.jit(
-            lambda p, o: self.net.apply({"params": p}, o))
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for h in self.hidden_sizes:
+            x = nn.tanh(nn.Dense(h)(x))
+        mean = nn.Dense(self.action_dim,
+                        kernel_init=nn.initializers.variance_scaling(
+                            0.01, "fan_avg", "uniform"))(x)
+        log_std = self.param("log_std", nn.initializers.zeros,
+                             (self.action_dim,))
+        v = obs
+        for h in self.hidden_sizes:
+            v = nn.tanh(nn.Dense(h)(v))
+        value = nn.Dense(1)(v)[..., 0]
+        return mean, jnp.broadcast_to(log_std, mean.shape), value
 
+
+class ConvPolicyValueNet(nn.Module):
+    """Small shared CNN encoder + categorical/value heads for [H,W,C]
+    observations."""
+    action_dim: int
+    hidden_sizes: Sequence[int] = (64,)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        x = nn.relu(nn.Conv(16, (3, 3), strides=(2, 2))(x))
+        x = nn.relu(nn.Conv(32, (3, 3), strides=(2, 2))(x))
+        x = x.reshape(x.shape[:-3] + (-1,))
+        for h in self.hidden_sizes:
+            x = nn.relu(nn.Dense(h)(x))
+        logits = nn.Dense(self.action_dim)(x)
+        value = nn.Dense(1)(x)[..., 0]
+        return logits, value
+
+
+class _ModuleBase:
     def forward(self, params, obs):
         return self._forward(params, obs)
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.device_put(weights)
+
+
+class DiscreteRLModule(_ModuleBase):
+    """Policy/value module for discrete action spaces (flat obs)."""
+
+    action_np_dtype = np.int64
+    action_event_shape: Tuple[int, ...] = ()
+
+    def __init__(self, obs_dim: int, action_dim: int,
+                 hidden_sizes: Sequence[int] = (64, 64), seed: int = 0,
+                 net: nn.Module = None, obs_shape: Tuple[int, ...] = None):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.net = net or PolicyValueNet(action_dim, tuple(hidden_sizes))
+        shape = tuple(obs_shape) if obs_shape else (obs_dim,)
+        self.params = self.net.init(
+            jax.random.PRNGKey(seed), jnp.zeros((1,) + shape))["params"]
+        self._forward = jax.jit(
+            lambda p, o: self.net.apply({"params": p}, o))
 
     def sample_actions(self, params, obs, rng):
         logits, value = self._forward(params, obs)
@@ -53,8 +120,103 @@ class DiscreteRLModule:
         logp_a = jnp.take_along_axis(logp, action[:, None], axis=1)[:, 0]
         return (np.asarray(action), np.asarray(logp_a), np.asarray(value))
 
-    def get_weights(self):
-        return jax.device_get(self.params)
+    def logp_entropy_value(self, params, obs, actions):
+        """Pure/traceable: per-sample log-prob of `actions`, policy
+        entropy and value estimates — the learner's loss contract."""
+        logits, value = self.net.apply({"params": params}, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, actions[:, None], axis=1)[:, 0]
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1)
+        return logp, entropy, value
 
-    def set_weights(self, weights):
-        self.params = jax.device_put(weights)
+
+class ConvDiscreteRLModule(DiscreteRLModule):
+    """Discrete actions over image observations ([H,W,C])."""
+
+    def __init__(self, obs_shape: Tuple[int, ...], action_dim: int,
+                 hidden_sizes: Sequence[int] = (64,), seed: int = 0):
+        super().__init__(int(np.prod(obs_shape)), action_dim,
+                         hidden_sizes, seed,
+                         net=ConvPolicyValueNet(action_dim,
+                                                tuple(hidden_sizes)),
+                         obs_shape=obs_shape)
+
+
+class ContinuousRLModule(_ModuleBase):
+    """Diagonal-Gaussian policy for Box action spaces. Actions are
+    sampled unsquashed (the env runner clips to the space bounds at step
+    time, matching rllib's default PPO setup)."""
+
+    action_np_dtype = np.float32
+
+    def __init__(self, obs_dim: int, action_dim: int,
+                 hidden_sizes: Sequence[int] = (64, 64), seed: int = 0,
+                 low=None, high=None):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.action_event_shape = (action_dim,)
+        self.low = None if low is None else np.asarray(low, np.float32)
+        self.high = None if high is None else np.asarray(high, np.float32)
+        self.net = GaussianPolicyValueNet(action_dim, tuple(hidden_sizes))
+        self.params = self.net.init(
+            jax.random.PRNGKey(seed), jnp.zeros((1, obs_dim)))["params"]
+        self._forward = jax.jit(
+            lambda p, o: self.net.apply({"params": p}, o))
+
+    def forward(self, params, obs):
+        mean, log_std, value = self._forward(params, obs)
+        return mean, value
+
+    def sample_actions(self, params, obs, rng):
+        mean, log_std, value = self._forward(params, obs)
+        std = jnp.exp(log_std)
+        noise = jax.random.normal(rng, mean.shape)
+        action = mean + std * noise
+        logp = (-0.5 * (noise ** 2) - log_std
+                - 0.5 * math.log(2 * math.pi)).sum(-1)
+        return (np.asarray(action), np.asarray(logp), np.asarray(value))
+
+    def logp_entropy_value(self, params, obs, actions):
+        mean, log_std, value = self.net.apply({"params": params}, obs)
+        z = (actions - mean) / jnp.exp(log_std)
+        logp = (-0.5 * (z ** 2) - log_std
+                - 0.5 * math.log(2 * math.pi)).sum(-1)
+        entropy = (log_std + 0.5 * (1 + math.log(2 * math.pi))).sum(-1)
+        return logp, entropy, value
+
+    def clip_actions(self, actions: np.ndarray) -> np.ndarray:
+        if self.low is None:
+            return actions
+        return np.clip(actions, self.low, self.high)
+
+
+def action_spec_of(space) -> Dict:
+    """gymnasium space -> serializable action spec."""
+    import gymnasium as gym
+    if isinstance(space, gym.spaces.Discrete):
+        return {"type": "discrete", "n": int(space.n)}
+    if isinstance(space, gym.spaces.Box):
+        return {"type": "box", "dim": int(np.prod(space.shape)),
+                "low": np.asarray(space.low).ravel().tolist(),
+                "high": np.asarray(space.high).ravel().tolist()}
+    raise ValueError(f"unsupported action space: {space}")
+
+
+def make_rl_module(obs_shape: Tuple[int, ...], action_spec: Dict,
+                   hidden_sizes: Sequence[int] = (64, 64), seed: int = 0):
+    """Module factory keyed by obs rank + action spec (reference:
+    rllib/core/rl_module/default catalog selection)."""
+    obs_shape = tuple(obs_shape)
+    if action_spec["type"] == "discrete":
+        if len(obs_shape) == 3:
+            return ConvDiscreteRLModule(obs_shape, action_spec["n"],
+                                        hidden_sizes, seed=seed)
+        return DiscreteRLModule(int(np.prod(obs_shape)), action_spec["n"],
+                                hidden_sizes, seed=seed)
+    if action_spec["type"] == "box":
+        return ContinuousRLModule(int(np.prod(obs_shape)),
+                                  action_spec["dim"], hidden_sizes,
+                                  seed=seed, low=action_spec.get("low"),
+                                  high=action_spec.get("high"))
+    raise ValueError(action_spec)

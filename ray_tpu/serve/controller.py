@@ -330,31 +330,28 @@ class ServeController:
                                            "replacing", app_name, name)
                         except Exception:
                             alive.append(r)   # slow ≠ dead
+                    lens = self._probe_loads(dep)
                     with self._lock:
                         if len(alive) != len(dep["replicas"]):
                             dep["replicas"] = alive
                             dep["version"] += 1
                             self._bump_dep(dep)
-                        self._autoscale(app_name, name, dep)
+                        self._autoscale(app_name, name, dep, lens)
                         self._reconcile_deployment(dep)
+                    self._publish_loads(dep, lens)
                 self._reconcile_proxies()
             except Exception:
                 logger.exception("reconcile loop iteration failed")
 
-    def _autoscale(self, app_name, name, dep):
+    def _autoscale(self, app_name, name, dep, lens=None):
         """Reference-shaped policy (serve/autoscaling_policy.py): average
         total queue depth over a look-back window, derive the DESIRED
         replica count from target_ongoing_requests, and apply it only
         after the condition has held for the up/downscale delay — bursts
         neither flap replicas up nor drain them mid-dip."""
-        import ray_tpu
         auto = dep["spec"]["config"].get("autoscaling_config")
-        if not auto or not dep["replicas"]:
-            return
-        try:
-            lens = ray_tpu.get([r.get_queue_len.remote()
-                                for r in dep["replicas"]], timeout=5)
-        except Exception:
+        if not auto or not dep["replicas"] or lens is None \
+                or len(lens) != len(dep["replicas"]):
             return
         key = (app_name, name)
         now = time.monotonic()
@@ -363,13 +360,40 @@ class ServeController:
             auto, hist, float(sum(lens)), dep["target"], now,
             self._up_since, self._down_since, key)
 
+    def _probe_loads(self, dep: Dict):
+        """One queue-depth probe per reconcile tick, shared by autoscaling
+        and the router load push."""
+        import ray_tpu
+        replicas = list(dep["replicas"])
+        if not replicas:
+            return None
+        try:
+            return ray_tpu.get([r.get_queue_len.remote() for r in replicas],
+                               timeout=5)
+        except Exception:
+            return None
+
+    def _publish_loads(self, dep: Dict, lens):
+        """Push probed queue depths to routers: every handle then shares
+        ONE load view instead of its private in-flight counts (reference:
+        pow_2_scheduler probes replica queue lengths,
+        replica_scheduler/pow_2_scheduler.py:52 — here the controller
+        probes once and fans out over long-poll)."""
+        if lens is None:
+            return
+        with self._lock:
+            if lens != dep.get("loads"):
+                dep["loads"] = lens
+                self._bump_dep(dep)
+
     def get_deployment_info(self, app_name: str, name: str) -> Dict:
         with self._lock:
             dep = self.apps.get(app_name, {}).get(name)
             if dep is None:
                 return {"version": -1, "replicas": []}
             return {"version": dep["version"],
-                    "replicas": list(dep["replicas"])}
+                    "replicas": list(dep["replicas"]),
+                    "loads": list(dep.get("loads") or [])}
 
     def get_status(self) -> Dict:
         with self._lock:

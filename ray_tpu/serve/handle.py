@@ -153,6 +153,7 @@ class _Router:
         self.app_name = app_name
         self.replicas: List = []        # actor handles
         self.inflight: Dict[int, int] = {}
+        self.shared_load: Dict[int, int] = {}  # controller-probed depths
         self.version = -1
         self.lock = threading.Lock()
         self._last_refresh = 0.0
@@ -170,6 +171,7 @@ class _Router:
                 self.replicas = info["replicas"]
                 self.inflight = {i: 0 for i in range(len(self.replicas))}
                 self.model_map.clear()
+            self.shared_load = dict(enumerate(info.get("loads") or []))
 
     def _controller(self):
         from ray_tpu.serve.api import _get_controller
@@ -188,6 +190,7 @@ class _Router:
                 self.replicas = info["replicas"]
                 self.inflight = {i: 0 for i in range(len(self.replicas))}
                 self.model_map.clear()
+            self.shared_load = dict(enumerate(info.get("loads") or []))
 
     def pick(self, model_id: str = ""):
         self.refresh()
@@ -204,9 +207,15 @@ class _Router:
             elif n == 1:
                 idx = 0
             else:
+                # P2C on the SHARED load signal (controller-probed queue
+                # depth, pushed over long-poll) plus this handle's own
+                # in-flight count — many independent handles converge on
+                # one view instead of each degrading toward random
+                # (reference: pow_2_scheduler.py:52 queue-length probes)
                 a, b = random.sample(range(n), 2)
-                idx = a if self.inflight.get(a, 0) <= \
-                    self.inflight.get(b, 0) else b
+                score = lambda i: (self.shared_load.get(i, 0)  # noqa: E731
+                                   + self.inflight.get(i, 0))
+                idx = a if score(a) <= score(b) else b
             if model_id:
                 self.model_map[model_id] = idx
             self.inflight[idx] = self.inflight.get(idx, 0) + 1

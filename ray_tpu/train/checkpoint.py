@@ -40,12 +40,22 @@ class Checkpoint:
             return cls.from_directory(d)
 
     def to_directory(self, path: Optional[str] = None) -> str:
+        from ray_tpu.util import storage as _storage
         if path is None:
             path = tempfile.mkdtemp(prefix="rt_ckpt_")
         os.makedirs(path, exist_ok=True)
         if self._blob is not None:
             with tarfile.open(fileobj=BytesIO(self._blob)) as tar:
                 tar.extractall(path, filter="data")
+        elif self.path is not None and _storage.is_remote(self.path):
+            # URI-persisted checkpoint: single tar object (see persist)
+            tar_uri = _storage.join(self.path, "ckpt.tar")
+            if _storage.exists(tar_uri):
+                raw = _storage.read_bytes(tar_uri)
+                with tarfile.open(fileobj=BytesIO(raw)) as tar:
+                    tar.extractall(path, filter="data")
+            else:
+                _storage.download_dir(self.path, path)
         elif self.path is not None and os.path.abspath(self.path) != \
                 os.path.abspath(path):
             shutil.copytree(self.path, path, dirs_exist_ok=True)
@@ -59,8 +69,24 @@ class Checkpoint:
                 return cloudpickle.load(f)
 
     def persist(self, storage_dir: str, name: Optional[str] = None) -> str:
-        """Write this checkpoint under storage_dir; returns the path."""
+        """Write this checkpoint under storage_dir (local path or any
+        fsspec URI — gs://bucket/exp on real pods; reference: Train's
+        StorageContext uploads to pyarrow filesystems). Returns the new
+        path/URI."""
+        from ray_tpu.util import storage as _storage
         name = name or f"checkpoint_{uuid.uuid4().hex[:8]}"
+        if _storage.is_remote(storage_dir):
+            uri = _storage.join(storage_dir, name)
+            blob = self._blob
+            if blob is None:
+                buf = BytesIO()
+                with tarfile.open(fileobj=buf, mode="w") as tar:
+                    tar.add(self.path, arcname=".")
+                blob = buf.getvalue()
+            _storage.write_bytes(_storage.join(uri, "ckpt.tar"), blob)
+            self.path = uri
+            self._blob = None
+            return uri
         path = os.path.join(storage_dir, name)
         self.to_directory(path)
         self.path = path
@@ -77,13 +103,14 @@ class CheckpointManager:
 
     def __init__(self, storage_dir: str, num_to_keep: Optional[int] = None,
                  score_attribute: Optional[str] = None, order: str = "max"):
+        from ray_tpu.util import storage as _storage
         self.storage_dir = storage_dir
         self.num_to_keep = num_to_keep
         self.score_attribute = score_attribute
         self.order = order
         self.checkpoints = []   # [(score, path, metrics)]
         self._counter = 0
-        os.makedirs(storage_dir, exist_ok=True)
+        _storage.makedirs(storage_dir)
 
     def register(self, ckpt: Checkpoint, metrics: Dict[str, Any]) -> str:
         self._counter += 1
@@ -120,9 +147,13 @@ class CheckpointManager:
             ranked = list(self.checkpoints)   # FIFO: oldest dropped
             ranked = ranked[::-1]
         keep = set(id(t) for t in ranked[:self.num_to_keep])
+        from ray_tpu.util import storage as _storage
         for t in list(self.checkpoints):
             if id(t) not in keep:
-                shutil.rmtree(t[1], ignore_errors=True)
+                if _storage.is_remote(t[1]):
+                    _storage.delete_dir(t[1])
+                else:
+                    shutil.rmtree(t[1], ignore_errors=True)
                 self.checkpoints.remove(t)
 
     def best_checkpoint(self):

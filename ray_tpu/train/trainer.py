@@ -46,13 +46,14 @@ class JaxTrainer:
     def fit(self) -> Result:
         rc = self.run_config
         name = rc.name or f"train_{int(time.time())}"
+        from ray_tpu.util import storage as _storage
         storage = rc.storage_path or os.path.join(
             tempfile.gettempdir(), "ray_tpu_results")
-        exp_dir = os.path.join(storage, name)
-        os.makedirs(exp_dir, exist_ok=True)
+        exp_dir = _storage.join(storage, name)
+        _storage.makedirs(exp_dir)
         ckpt_cfg = rc.checkpoint_config or CheckpointConfig()
         manager = CheckpointManager(
-            os.path.join(exp_dir, "checkpoints"),
+            _storage.join(exp_dir, "checkpoints"),
             num_to_keep=ckpt_cfg.num_to_keep,
             score_attribute=ckpt_cfg.checkpoint_score_attribute,
             order=ckpt_cfg.checkpoint_score_order)

@@ -1,0 +1,163 @@
+"""External storage plane: one URI-addressed filesystem abstraction for
+object spilling and Train checkpoints (reference:
+python/ray/_private/external_storage.py:72 — filesystem-or-cloud spill
+targets; python/ray/train/_internal/storage.py StorageContext — pyarrow
+filesystems behind RunConfig.storage_path).
+
+URIs: plain paths and file:// map to the local filesystem via the
+standard library (no import cost on hot paths); any other scheme
+(gs://, s3://, memory://, ...) resolves through fsspec. memory:// is
+fsspec's in-process filesystem and doubles as the fake-remote backend in
+tests — the code path is byte-for-byte the one gs:// takes."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+
+def _split(uri: str) -> Tuple[str, str]:
+    """-> (scheme, path); plain paths get scheme ''. """
+    if "://" not in uri:
+        return "", uri
+    scheme, rest = uri.split("://", 1)
+    if scheme == "file":
+        return "", "/" + rest.lstrip("/")
+    return scheme, uri
+
+
+def is_remote(uri: str) -> bool:
+    return _split(uri)[0] != ""
+
+
+def _fs(uri: str):
+    import fsspec
+    return fsspec.core.url_to_fs(uri)   # (fs, path)
+
+
+def join(uri: str, *parts: str) -> str:
+    if is_remote(uri):
+        return "/".join([uri.rstrip("/")] + [p.strip("/") for p in parts])
+    # file:// normalizes to a plain local path
+    return os.path.join(_split(uri)[1], *parts)
+
+
+def makedirs(uri: str) -> None:
+    scheme, path = _split(uri)
+    if not scheme:
+        os.makedirs(path, exist_ok=True)
+        return
+    fs, p = _fs(uri)
+    fs.makedirs(p, exist_ok=True)
+
+
+def write_bytes(uri: str, data: bytes) -> None:
+    scheme, path = _split(uri)
+    if not scheme:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        return
+    fs, p = _fs(uri)
+    parent = p.rsplit("/", 1)[0]
+    if parent:
+        fs.makedirs(parent, exist_ok=True)
+    with fs.open(p, "wb") as f:
+        f.write(data)
+
+
+def read_bytes(uri: str) -> bytes:
+    scheme, path = _split(uri)
+    if not scheme:
+        with open(path, "rb") as f:
+            return f.read()
+    fs, p = _fs(uri)
+    with fs.open(p, "rb") as f:
+        return f.read()
+
+
+def exists(uri: str) -> bool:
+    scheme, path = _split(uri)
+    if not scheme:
+        return os.path.exists(path)
+    fs, p = _fs(uri)
+    return fs.exists(p)
+
+
+def delete(uri: str) -> bool:
+    scheme, path = _split(uri)
+    try:
+        if not scheme:
+            os.unlink(path)
+        else:
+            fs, p = _fs(uri)
+            fs.rm(p)
+        return True
+    except (OSError, FileNotFoundError):
+        return False
+
+
+def delete_dir(uri: str) -> bool:
+    scheme, path = _split(uri)
+    try:
+        if not scheme:
+            import shutil
+            shutil.rmtree(path, ignore_errors=True)
+        else:
+            fs, p = _fs(uri)
+            fs.rm(p, recursive=True)
+        return True
+    except (OSError, FileNotFoundError):
+        return False
+
+
+def listdir(uri: str) -> List[str]:
+    """Child names (not full paths); empty list if missing."""
+    scheme, path = _split(uri)
+    try:
+        if not scheme:
+            return sorted(os.listdir(path))
+        fs, p = _fs(uri)
+        return sorted(x.rstrip("/").rsplit("/", 1)[-1]
+                      for x in fs.ls(p, detail=False))
+    except (OSError, FileNotFoundError):
+        return []
+
+
+def upload_dir(local_dir: str, uri: str) -> None:
+    """Recursively copy a local directory to the URI."""
+    for root, _dirs, files in os.walk(local_dir):
+        rel = os.path.relpath(root, local_dir)
+        for fname in files:
+            dst = join(uri, fname) if rel == "." \
+                else join(uri, rel.replace(os.sep, "/"), fname)
+            with open(os.path.join(root, fname), "rb") as f:
+                write_bytes(dst, f.read())
+
+
+def download_dir(uri: str, local_dir: str) -> None:
+    """Recursively copy a URI directory tree to a local directory."""
+    scheme, path = _split(uri)
+    os.makedirs(local_dir, exist_ok=True)
+    if not scheme:
+        import shutil
+        for name in os.listdir(path):
+            src = os.path.join(path, name)
+            dst = os.path.join(local_dir, name)
+            if os.path.isdir(src):
+                shutil.copytree(src, dst, dirs_exist_ok=True)
+            else:
+                shutil.copy2(src, dst)
+        return
+    fs, p = _fs(uri)
+    base = p.rstrip("/")
+    for info in fs.find(base):
+        rel = info[len(base):].lstrip("/")
+        dst = os.path.join(local_dir, *rel.split("/"))
+        os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+        with fs.open(info, "rb") as f:
+            data = f.read()
+        with open(dst, "wb") as f:
+            f.write(data)

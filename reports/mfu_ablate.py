@@ -78,6 +78,35 @@ GRID = [
     {"model": "llama-1b", "B": 8, "L": 1024, "attn": "flash",
      "remat_policy": "nothing", "n_heads": 16, "n_kv_heads": 16,
      "opt": "adafactor"},
+    # wave 3 (round 4): chunked cross-entropy kills the [B,L,32000]
+    # logits buffer — does it unlock tpu-1b B=16 / the tpu-3b rung?
+    {"model": "tpu-1b", "B": 8, "L": 1024, "attn": "flash",
+     "remat_policy": "dots", "opt": "adafactor", "loss_chunk": 256},
+    {"model": "tpu-1b", "B": 16, "L": 1024, "attn": "flash",
+     "remat_policy": "dots", "opt": "adafactor", "loss_chunk": 256},
+    # tpu-3b: largest-single-chip attempt — bf16 params + adafactor +
+    # chunked CE; `dots` likely OOMs on saved activations at d=3072
+    {"model": "tpu-3b", "B": 8, "L": 1024, "attn": "flash",
+     "remat_policy": "dots", "opt": "adafactor", "loss_chunk": 256,
+     "param_dtype": "bf16"},
+    {"model": "tpu-3b", "B": 8, "L": 1024, "attn": "flash",
+     "remat_policy": "nothing", "opt": "adafactor", "loss_chunk": 256,
+     "param_dtype": "bf16"},
+    {"model": "tpu-3b", "B": 4, "L": 1024, "attn": "flash",
+     "remat_policy": "dots", "opt": "adafactor", "loss_chunk": 256,
+     "param_dtype": "bf16"},
+    {"model": "tpu-3b", "B": 16, "L": 1024, "attn": "flash",
+     "remat_policy": "nothing", "opt": "adafactor", "loss_chunk": 256,
+     "param_dtype": "bf16"},
+    {"model": "tpu-3b", "B": 8, "L": 2048, "attn": "flash",
+     "remat_policy": "nothing", "opt": "adafactor", "loss_chunk": 256,
+     "param_dtype": "bf16"},
+    {"model": "tpu-3b", "B": 4, "L": 2048, "attn": "flash",
+     "remat_policy": "dots", "opt": "adafactor", "loss_chunk": 256,
+     "param_dtype": "bf16"},
+    {"model": "tpu-7b", "B": 4, "L": 1024, "attn": "flash",
+     "remat_policy": "nothing", "opt": "adafactor", "loss_chunk": 256,
+     "param_dtype": "bf16"},
 ]
 
 OUT = os.path.join(os.path.dirname(__file__), "mfu_ablation.jsonl")
@@ -103,9 +132,16 @@ def run_one(spec: dict) -> dict:
     from ray_tpu.parallel import MeshConfig, make_mesh
     from ray_tpu.parallel.train_step import make_train_fns
 
+    import jax.numpy as jnp
+
     cfg = MODEL_REGISTRY[spec["model"]]
     overrides = {k: spec[k] for k in
                  ("n_heads", "n_kv_heads", "d_ff", "d_model") if k in spec}
+    if spec.get("param_dtype") == "bf16":
+        # pure-bf16 training: halves params+grads HBM (the 3b rung's only
+        # way onto one 16 GB chip); master-weight fp32 remains the
+        # default for every smaller config
+        overrides["param_dtype"] = jnp.bfloat16
     cfg = dataclasses.replace(
         cfg, attention_impl=spec.get("attn", "auto"),
         remat_policy=spec.get("remat_policy", "dots"),
@@ -116,7 +152,8 @@ def run_one(spec: dict) -> dict:
     opt = (optax.adafactor(3e-4) if spec.get("opt") == "adafactor"
            else optax.adamw(3e-4))
     init_fn, step_fn, _ = make_train_fns(
-        model, opt, mesh, batch_shape=(B, L + 1))
+        model, opt, mesh, batch_shape=(B, L + 1),
+        loss_chunk=spec.get("loss_chunk"))
     t_compile = time.perf_counter()
     state = init_fn(jax.random.PRNGKey(0))
     tokens = jax.random.randint(jax.random.PRNGKey(1), (B, L + 1), 0,
@@ -150,12 +187,13 @@ def main():
             for line in f:
                 try:
                     r = json.loads(line)
-                    if "error" in r and r["error"] != "OOM":
-                        continue    # transient failures retry on rerun
+                    if r.get("error") == "timeout":
+                        continue    # only timeouts retry on rerun
                     done.add(json.dumps(
                         {k: r[k] for k in sorted(r)
                          if k in ("model", "B", "L", "attn", "remat_policy",
-                                  "n_heads", "n_kv_heads", "opt")},
+                                  "n_heads", "n_kv_heads", "opt",
+                                  "loss_chunk", "param_dtype")},
                         sort_keys=True))
                 except json.JSONDecodeError:
                     pass

@@ -1,0 +1,95 @@
+"""External storage plane: URI-addressed spilling and checkpoints
+(reference: python/ray/_private/external_storage.py:72 filesystem-or-S3
+spill, python/ray/train/_internal/storage.py StorageContext). fsspec's
+memory:// backend plays the remote filesystem — the code path is the one
+gs://bucket takes on a real pod."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu.util import storage
+
+
+def test_storage_uri_round_trip_memory_fs():
+    uri = "memory://bucket/a/b/data.bin"
+    storage.write_bytes(uri, b"hello-remote")
+    assert storage.exists(uri)
+    assert storage.read_bytes(uri) == b"hello-remote"
+    assert "data.bin" in storage.listdir("memory://bucket/a/b")
+    assert storage.is_remote(uri) and not storage.is_remote("/tmp/x")
+    assert storage.delete(uri)
+    assert not storage.exists(uri)
+
+
+def test_storage_dir_round_trip(tmp_path):
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "top.txt").write_bytes(b"t")
+    (src / "sub" / "nested.txt").write_bytes(b"n")
+    storage.upload_dir(str(src), "memory://bucket/exp1")
+    dst = tmp_path / "dst"
+    storage.download_dir("memory://bucket/exp1", str(dst))
+    assert (dst / "top.txt").read_bytes() == b"t"
+    assert (dst / "sub" / "nested.txt").read_bytes() == b"n"
+
+
+def test_checkpoint_persist_restore_uri(tmp_path):
+    from ray_tpu.train import Checkpoint
+    ck = Checkpoint.from_dict({"w": np.arange(5), "step": 3})
+    uri = ck.persist("memory://ckpts/run1", "checkpoint_000001")
+    assert uri.startswith("memory://")
+    restored = Checkpoint(path=uri).to_dict()
+    assert restored["step"] == 3
+    assert np.array_equal(restored["w"], np.arange(5))
+
+
+def test_checkpoint_manager_retention_on_uri():
+    from ray_tpu.train import Checkpoint
+    from ray_tpu.train.checkpoint import CheckpointManager
+    mgr = CheckpointManager("memory://ckpts/run2", num_to_keep=2,
+                            score_attribute="acc", order="max")
+    for i, acc in enumerate([0.1, 0.9, 0.5]):
+        mgr.register(Checkpoint.from_dict({"i": i}), {"acc": acc})
+    assert len(mgr.checkpoints) == 2
+    best = mgr.best_checkpoint()
+    assert best.metrics["acc"] == 0.9
+    assert best.to_dict()["i"] == 1
+
+
+def test_spill_to_uri_and_restore(tmp_path):
+    """Node-manager spilling through the URI backend: fill a small store
+    past the watermark, assert objects land under the spill URI and come
+    back transparently on get(). Uses fsspec's local:// scheme (each
+    node manager is its own process, so memory:// would not be
+    observable here) — local:// goes through the identical fsspec
+    write/read code path as gs://, only the filesystem class differs."""
+    import ray_tpu
+
+    spill_uri = f"local://{tmp_path}/remote-spill"
+    os.environ["RAY_TPU_SPILL_URI"] = spill_uri
+    try:
+        ray_tpu.init(num_cpus=1,
+                     object_store_memory=64 * 1024 * 1024)
+        blobs = [np.ones(8 * 1024 * 1024, np.uint8) * i
+                 for i in range(10)]
+        refs = [ray_tpu.put(b) for b in blobs]    # 80 MB > 64 MB store
+        import time
+        deadline = time.time() + 30
+        spilled_files = []
+        while time.time() < deadline:
+            root = str(tmp_path / "remote-spill")
+            spilled_files = [f for d, _, fs in os.walk(root) for f in fs] \
+                if os.path.isdir(root) else []
+            if spilled_files:
+                break
+            time.sleep(0.5)
+        assert spilled_files, "nothing spilled to the URI target"
+        # every object still readable (restore path)
+        for i, r in enumerate(refs):
+            got = ray_tpu.get(r)
+            assert got[0] == i and got.nbytes == blobs[i].nbytes
+    finally:
+        os.environ.pop("RAY_TPU_SPILL_URI", None)
+        ray_tpu.shutdown()

@@ -1,0 +1,81 @@
+"""Shared load signal for handle routing: the controller probes replica
+queue depths and pushes them to every router, so a FRESH handle (zero
+local in-flight knowledge) avoids a replica another handle has already
+buried (reference: pow-2 scheduler queue-length probes,
+_private/replica_scheduler/pow_2_scheduler.py:52; round-3 weakness #6 —
+client-local counts degrade toward random with many handles and dogpile
+cold replicas)."""
+
+import time
+import uuid
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_session():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@serve.deployment(num_replicas=2, max_ongoing_requests=16)
+class Worker:
+    def __init__(self):
+        self.uid = uuid.uuid4().hex[:8]
+
+    def __call__(self, payload):
+        return self.uid
+
+    def slow(self, t):
+        time.sleep(t)
+        return self.uid
+
+
+def test_fresh_handle_avoids_buried_replica(serve_session):
+    handle1 = serve.run(Worker.bind(), name="loadsig")
+    # bury ONE replica via sticky multiplex routing: every slow call with
+    # the same model id pins to the replica that served it first
+    sticky = handle1.options(multiplexed_model_id="pin")
+    slow_calls = [sticky.slow.remote(20.0) for _ in range(6)]
+    time.sleep(1.0)
+    busy_uid = sticky.remote("probe").result(timeout=30)
+
+    # wait for the controller's load probe to publish nonzero depths
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        handle1._router.refresh(force=True)
+        if any(v >= 5 for v in handle1._router.shared_load.values()):
+            break
+        time.sleep(0.5)
+    else:
+        pytest.fail(
+            f"controller never published loads: "
+            f"{handle1._router.shared_load}")
+
+    # a FRESH handle has no local in-flight history; only the shared
+    # signal can warn it off the buried replica
+    handle2 = serve.get_app_handle("loadsig")
+    assert handle2._router is not handle1._router
+    uids = [handle2.remote("x").result(timeout=30) for _ in range(10)]
+    n_busy = sum(1 for u in uids if u == busy_uid)
+    # client-local P2C would send ~5/10 into the 20s queue; the shared
+    # signal must keep nearly all of them on the idle replica
+    assert n_busy <= 2, (f"{n_busy}/10 requests dogpiled the buried "
+                         f"replica (busy={busy_uid}, uids={uids})")
+    for c in slow_calls:
+        del c
+
+
+def test_shared_load_included_in_info(serve_session):
+    handle = serve.run(Worker.bind(), name="loadsig2",
+                       route_prefix="/loadsig2")
+    handle.remote("x").result(timeout=30)
+    info = ray_tpu.get(
+        serve.api._get_controller().get_deployment_info.remote(
+            "loadsig2", "Worker"), timeout=30)
+    assert "loads" in info and isinstance(info["loads"], list)
