@@ -295,7 +295,10 @@ def timeline(filename: Optional[str] = None):
             "dur": max(1.0, (end - start) * 1e6),
             "pid": (row.get("node_id") or "node")[:8],
             "tid": (row.get("worker_id") or "worker")[:8],
-            "args": {"task_id": row["task_id"], "state": row.get("state")},
+            "args": {"task_id": row["task_id"], "state": row.get("state"),
+                     "trace_id": row.get("trace_id"),
+                     "span_id": row.get("span_id"),
+                     "parent_span_id": row.get("parent_span_id")},
         })
     if filename:
         with open(filename, "w") as f:

@@ -84,9 +84,13 @@ _flag("actor_push_batch", int, 32,
       "sender (amortizes frame + dispatch overhead; reference pipelines "
       "per-call over C++ gRPC, actor_task_submitter.h:75 — Python pays "
       "more per frame, so we batch).")
-_flag("task_push_batch", int, 8,
+_flag("task_push_batch", int, 32,
       "Max queued same-signature tasks pushed to a leased worker in one "
       "frame.")
+_flag("task_events_per_s", int, 2000,
+      "Per-process task-event budget; beyond it the recorder keeps a "
+      "deterministic 1-in-8 sample by task id (all states of sampled "
+      "tasks are kept, so the timeline stays representative).")
 _flag("gcs_wal_fsync", bool, False,
       "fsync the GCS write-ahead log after every append. Off by default: "
       "the WAL then survives a process kill but not a host crash (the "

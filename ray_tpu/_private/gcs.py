@@ -52,7 +52,12 @@ class GcsServer:
         self.port = port
         self.session_name = session_name
         self.persist_path = persist_path
-        self._wal = None
+        # persistence behind the store-client interface (reference:
+        # gcs/store_client/ — file impl today, external URI impl for
+        # off-node durability; see _private/store_client.py)
+        from ray_tpu._private.store_client import store_client_for
+        self.store_client = store_client_for(
+            persist_path, fsync=cfg.gcs_wal_fsync) if persist_path else None
         self._wal_actors: set = set()   # actors whose full row is in WAL
         self.address: Optional[str] = None
 
@@ -114,6 +119,15 @@ class GcsServer:
         self._load_snapshot()
         self._replay_wal()
         self.address = await self.server.listen_tcp("0.0.0.0", self.port)
+        if self.store_client is not None:
+            # discovery channel: raylets that lose the GCS re-read this
+            # before reconnecting, so a restart on a new port/host heals
+            # the cluster (reference: raylets re-resolve the GCS address
+            # from Redis under GCS-FT)
+            try:
+                self.store_client.write_address(self.address)
+            except Exception:
+                logger.exception("address publish failed")
         # restart path: snapshot-restored actors that never reached ALIVE
         # must be (re)scheduled — the client's retried create_actor hits
         # the idempotent early-return and will wait forever otherwise
@@ -146,30 +160,16 @@ class GcsServer:
         }
 
     def _save_snapshot(self):
-        if not self.persist_path:
+        if self.store_client is None:
             return
-        import os
-
         import msgpack
-        tmp = f"{self.persist_path}.tmp"
-        os.makedirs(os.path.dirname(self.persist_path) or ".", exist_ok=True)
         # msgpack, not json: actor specs and KV entries embed raw bytes
         # (function-table ids, pickled args) that json would stringify
-        with open(tmp, "wb") as f:
-            f.write(msgpack.packb(self._snapshot_state(), use_bin_type=True))
-        os.replace(tmp, self.persist_path)
+        self.store_client.save_snapshot(
+            msgpack.packb(self._snapshot_state(), use_bin_type=True))
         # the snapshot covers everything the WAL recorded: start it fresh
-        if self._wal is not None:
-            try:
-                self._wal.close()
-            except Exception:
-                pass
-            self._wal = None
         self._wal_actors.clear()
-        try:
-            os.unlink(self.persist_path + ".wal")
-        except OSError:
-            pass
+        self.store_client.wal_reset()
 
     def _log_op(self, op: str, data: Dict):
         """Append one mutation to the write-ahead log. Closes the
@@ -178,47 +178,29 @@ class GcsServer:
         restart (reference: every mutation goes through the Redis store
         client synchronously, redis_store_client.h:106).
 
-        Durability grade: flush() only by default — survives a process
-        kill, NOT a host crash (set cfg.gcs_wal_fsync for fsync-per-append
-        at a large latency cost)."""
-        if not self.persist_path:
+        Durability grade: the file store flush()es by default — survives
+        a process kill, NOT a host crash (cfg.gcs_wal_fsync upgrades
+        that); external URI stores are snapshot-interval only (see
+        ExternalStoreClient)."""
+        if self.store_client is None or not self.store_client.wal_enabled:
             return
         import msgpack
         try:
-            if self._wal is None:
-                import os
-                os.makedirs(os.path.dirname(self.persist_path) or ".",
-                            exist_ok=True)
-                self._wal = open(self.persist_path + ".wal", "ab")
-            rec = msgpack.packb([op, data], use_bin_type=True)
-            self._wal.write(len(rec).to_bytes(4, "little") + rec)
-            self._wal.flush()
-            if cfg.gcs_wal_fsync:
-                import os
-                os.fsync(self._wal.fileno())
+            self.store_client.wal_append(
+                msgpack.packb([op, data], use_bin_type=True))
         except Exception:
             logger.exception("WAL append failed")
 
     def _replay_wal(self):
-        import os
-
         import msgpack
-        path = (self.persist_path or "") + ".wal"
-        if not self.persist_path or not os.path.exists(path):
+        if self.store_client is None:
             return
         n = 0
         try:
-            with open(path, "rb") as f:
-                raw = f.read()
-            off = 0
-            while off + 4 <= len(raw):
-                ln = int.from_bytes(raw[off:off + 4], "little")
-                if off + 4 + ln > len(raw):
-                    break      # torn tail write: ignore
-                op, data = msgpack.unpackb(raw[off + 4:off + 4 + ln],
-                                           raw=False, strict_map_key=False)
+            for rec in self.store_client.wal_records():
+                op, data = msgpack.unpackb(rec, raw=False,
+                                           strict_map_key=False)
                 self._apply_op(op, data)
-                off += 4 + ln
                 n += 1
         except Exception:
             logger.exception("WAL replay failed at record %d", n)
@@ -246,17 +228,14 @@ class GcsServer:
             self.placement_groups[d["pg_id"]] = d["row"]
 
     def _load_snapshot(self):
-        if not self.persist_path:
-            return
-        import os
-
         import msgpack
-        if not os.path.exists(self.persist_path):
+        if self.store_client is None:
             return
         try:
-            with open(self.persist_path, "rb") as f:
-                snap = msgpack.unpackb(f.read(), raw=False,
-                                       strict_map_key=False)
+            raw = self.store_client.load_snapshot()
+            if raw is None:
+                return
+            snap = msgpack.unpackb(raw, raw=False, strict_map_key=False)
         except Exception:
             logger.exception("snapshot load failed; starting fresh")
             return

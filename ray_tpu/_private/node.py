@@ -124,7 +124,8 @@ def start_node(gcs_address: str, num_cpus: Optional[float] = None,
                resources: Optional[Dict[str, float]] = None,
                object_store_memory: Optional[int] = None,
                labels: Optional[Dict[str, str]] = None,
-               session_name: str = "session") -> LocalNode:
+               session_name: str = "session",
+               gcs_address_source: Optional[str] = None) -> LocalNode:
     res = dict(resources or {})
     if num_cpus is not None:
         res["CPU"] = float(num_cpus)
@@ -133,6 +134,8 @@ def start_node(gcs_address: str, num_cpus: Optional[float] = None,
            "--resources", json.dumps(res),
            "--labels", json.dumps(labels or {}),
            "--session-name", session_name]
+    if gcs_address_source:
+        cmd += ["--gcs-address-source", gcs_address_source]
     if not object_store_memory:
         from ray_tpu._private.config import cfg
         object_store_memory = cfg.object_store_memory or None

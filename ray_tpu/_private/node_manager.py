@@ -66,8 +66,14 @@ class NodeManager:
                  labels: Optional[Dict[str, str]] = None,
                  session_name: str = "session",
                  store_bytes: int = 0, port: int = 0,
-                 store_path: Optional[str] = None):
+                 store_path: Optional[str] = None,
+                 gcs_address_source: Optional[str] = None):
         self.gcs_address = gcs_address
+        # discovery channel for GCS-FT: a restarted GCS (possibly on a
+        # new port/host) publishes its address through its store client;
+        # the heartbeat reconnect path re-reads it (reference: raylets
+        # re-resolve the GCS address from Redis)
+        self.gcs_address_source = gcs_address_source
         self.node_id = node_id or os.urandom(16).hex()
         self.session_name = session_name
         self.labels = labels or {}
@@ -259,6 +265,12 @@ class NodeManager:
             except (rpc.RpcError, rpc.ConnectionLost):
                 logger.warning("heartbeat failed; reconnecting to GCS")
                 last_sent = None
+                if self.gcs_address_source:
+                    fresh = self._read_gcs_address()
+                    if fresh and fresh != self.gcs_address:
+                        logger.info("GCS moved: %s -> %s",
+                                    self.gcs_address, fresh)
+                        self.gcs_address = fresh
                 try:
                     self.gcs = await rpc.connect(
                         self.gcs_address, handlers=self.gcs.handlers,
@@ -273,6 +285,13 @@ class NodeManager:
                 except Exception:
                     pass
             await asyncio.sleep(cfg.heartbeat_interval_s)
+
+    def _read_gcs_address(self) -> Optional[str]:
+        try:
+            from ray_tpu._private.store_client import store_client_for
+            return store_client_for(self.gcs_address_source).read_address()
+        except Exception:
+            return None
 
     def _reported_available(self) -> Dict[str, float]:
         avail = dict(self.available)
@@ -1470,6 +1489,9 @@ def main():
     parser.add_argument("--session-name", default="session")
     parser.add_argument("--store-bytes", type=int, default=0)
     parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--gcs-address-source", default=None,
+                        help="GCS persist path/URI whose published "
+                             "address is re-read on reconnect (GCS-FT)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO,
                         format="[node] %(asctime)s %(levelname)s %(message)s")
@@ -1479,7 +1501,8 @@ def main():
                          resources=json.loads(args.resources),
                          labels=json.loads(args.labels),
                          session_name=args.session_name,
-                         store_bytes=args.store_bytes, port=args.port)
+                         store_bytes=args.store_bytes, port=args.port,
+                         gcs_address_source=args.gcs_address_source)
         addr = await nm.start()
         print(f"NODE_ADDRESS={addr}", flush=True)
         print(f"NODE_ID={nm.node_id}", flush=True)

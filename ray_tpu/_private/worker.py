@@ -87,6 +87,12 @@ class _InlineBridgeError(BaseException):
 # execution-thread context: which method is running (bridge-use tracking)
 _exec_tls = threading.local()
 
+# (trace_id, span_id) of the task running on the current loop context —
+# async actor methods execute as coroutines, where a contextvar is the
+# per-task store; sync methods run on executor threads and use _exec_tls
+_trace_ctx: "contextvars.ContextVar" = __import__(
+    "contextvars").ContextVar("ray_tpu_trace", default=None)
+
 
 class PendingTask:
     __slots__ = ("spec", "return_ids", "retries_left", "arg_refs", "done",
@@ -202,6 +208,12 @@ class CoreWorker:
         self.actor_spec: Optional[Dict] = None
         self.current_task_name: Optional[str] = None
         self.current_task_id: Optional[bytes] = None
+        # trace root (reference: tracing_helper.py:34 — spans wrap
+        # remote calls with the context riding in task metadata). The
+        # ACTIVE context lives in _exec_tls / _trace_ctx, not here:
+        # multi-consumer workers run tasks concurrently and instance
+        # attributes would cross-contaminate their traces
+        self._root_trace_id = os.urandom(8).hex()
         self._orig_visible: Dict[str, Optional[str]] = {}
         self._visible_dirty: set = set()
         self._cancelled_tasks: set = set()
@@ -304,6 +316,9 @@ class CoreWorker:
         self._lease_reaper = self._spawn(self._reap_leases())
         self._task_events: List[Dict] = []
         self._task_events_dropped = 0
+        self._ev_window_t0 = 0.0
+        self._ev_window_n = 0
+        self._ev_budget = 10**9   # refreshed from cfg each window
         self._event_flusher = self._spawn(self._flush_task_events())
         self._install_ref_hooks()
         self._subscribed_actor_channel = False
@@ -398,10 +413,24 @@ class CoreWorker:
     # ------------------------------------------------------------ task events
     def _record_task_event(self, task_id: bytes, state: str, **extra):
         """Buffered task state transitions, flushed to the GCS task-event
-        sink. Bounded: under throughput bursts old events drop rather than
-        letting the buffer (and its per-flush msgpack cost) grow without
-        limit (reference: TaskEventBuffer max size + dropped counter,
-        src/ray/core_worker/task_event_buffer.h:220)."""
+        sink. Bounded two ways: a size cap (old events drop rather than
+        letting the buffer grow without limit — reference:
+        TaskEventBuffer max size + dropped counter,
+        task_event_buffer.h:220) and a RATE budget — past
+        cfg.task_events_per_s the recorder keeps only a deterministic
+        1-in-8 sample keyed by task id, so every process samples the
+        SAME tasks and sampled rows still get all their states (the
+        timeline stays representative instead of eating ~3 events/call
+        of control-plane CPU at full throughput)."""
+        now = time.monotonic()
+        if now - self._ev_window_t0 >= 1.0:
+            self._ev_window_t0 = now
+            self._ev_window_n = 0
+            self._ev_budget = cfg.task_events_per_s
+        self._ev_window_n += 1
+        if self._ev_window_n > self._ev_budget and task_id[-1] & 7:
+            self._task_events_dropped += 1
+            return
         ev = self._task_events
         if len(ev) >= 10000:
             del ev[:5000]
@@ -540,10 +569,31 @@ class CoreWorker:
 
     async def get_many_async(self, refs: List[ObjectRef],
                              timeout: Optional[float] = None):
-        coros = [self.get_async(r) for r in refs]
+        # OWNED refs resolve passively (executors push results to the
+        # owner; awaiting just parks on a completion event) — await them
+        # sequentially instead of gather's one-asyncio.Task-per-ref,
+        # which is measurable at bench throughput (200-ref batches).
+        # Borrowed refs need an ACTIVE remote fetch, so those still get
+        # eager tasks to keep transfers concurrent.
+        async def _all():
+            eager = {i: asyncio.ensure_future(self.get_async(r))
+                     for i, r in enumerate(refs)
+                     if r.id not in self.owned}
+            out = []
+            try:
+                for i, r in enumerate(refs):
+                    fut = eager.pop(i, None)
+                    out.append(await (fut if fut is not None
+                                      else self.get_async(r)))
+            finally:
+                # an early error/cancellation (incl. wait_for timeout)
+                # must not orphan the remaining eager fetch tasks
+                for fut in eager.values():
+                    fut.cancel()
+            return out
         if timeout is None:
-            return await asyncio.gather(*coros)
-        return await asyncio.wait_for(asyncio.gather(*coros), timeout)
+            return await _all()
+        return await asyncio.wait_for(_all(), timeout)
 
     async def get_async(self, ref: ObjectRef):
         val, is_exc = await self._resolve(ref)
@@ -955,6 +1005,18 @@ class CoreWorker:
                                    max_retries, scheduling, name, runtime_env),
             self.loop).result()
 
+    def _trace_fields(self) -> Dict[str, Optional[str]]:
+        """New span chained under the caller's context: a task submitted
+        from inside another task inherits its trace id and points its
+        parent at the enclosing task's span. The enclosing context comes
+        from the executing thread (sync methods) or the coroutine's
+        contextvar (async methods) — never shared instance state."""
+        ctx = getattr(_exec_tls, "trace", None) or _trace_ctx.get()
+        trace_id, parent = ctx if ctx else (None, None)
+        return {"trace_id": trace_id or self._root_trace_id,
+                "span_id": ids.span_id(),
+                "parent_span_id": parent}
+
     def _build_task_spec(self, func, args, kwargs, num_returns, name):
         """Caller-thread-safe part of task submission: ids + arg encoding
         (ids are urandom-based; serialization touches no loop state)."""
@@ -970,6 +1032,7 @@ class CoreWorker:
                        for k, v in (kwargs or {}).items()},
             "return_ids": return_ids, "owner_address": self.address,
             "owner_node": self.node_id,
+            **self._trace_fields(),
         }
         refs = [ObjectRef(rid, self.address) for rid in return_ids]
         return spec, return_ids, arg_refs, refs
@@ -1088,7 +1151,8 @@ class CoreWorker:
         st = self._sig_queues.get(sig)
         if st is None:
             st = {"queue": __import__("collections").deque(),
-                  "dispatchers": 0, "busy": 0, "resources": resources,
+                  "dispatchers": 0, "busy": 0, "grants": 0,
+                  "resources": resources,
                   "scheduling": scheduling, "env_hash": env_hash}
             self._sig_queues[sig] = st
         st["queue"].append(pt)
@@ -1110,12 +1174,15 @@ class CoreWorker:
             self._spawn(self._dispatch_loop(sig, st))
 
     async def _dispatch_loop(self, sig, st):
+        my_grants = -1
+        cur_batch = 1
         try:
             while st["queue"]:
                 try:
                     lease = await self._acquire_lease(
                         st["resources"], st["scheduling"],
                         st.get("env_hash"))
+                    st["grants"] += 1
                 except Exception as e:
                     if st["queue"]:
                         pt = st["queue"].popleft()
@@ -1125,17 +1192,25 @@ class CoreWorker:
                     continue
                 lease_ok = True
                 while st["queue"] and lease_ok:
-                    # batch into one frame ONLY when client-side
-                    # parallelism is exhausted (every dispatcher slot
-                    # busy): with slots free, queued tasks belong on
-                    # OTHER leases — possibly other nodes (spillback,
-                    # spread) — not serialized behind this one. Acks
-                    # stream back per-task either way
+                    # adaptive frame batching: serialize queued tasks
+                    # behind THIS lease only when there is evidence no
+                    # other lease is coming — i.e. no grant has landed
+                    # for this signature since our last round (the
+                    # 1-worker case: parked dispatchers stay parked, so
+                    # the batch doubles toward task_push_batch). Any
+                    # fresh grant or an idle lease resets to single-task
+                    # frames so work spreads across workers/nodes
+                    # (spillback, spread). Acks stream back per-task
+                    if (st["grants"] != my_grants
+                            or self._idle_leases.get(sig)):
+                        cur_batch = 1
+                    else:
+                        cur_batch = min(cur_batch * 2,
+                                        cfg.task_push_batch)
+                    my_grants = st["grants"]
                     batch = [st["queue"].popleft()]
-                    if st["dispatchers"] >= cfg.max_dispatchers_per_sig:
-                        while st["queue"] and \
-                                len(batch) < cfg.task_push_batch:
-                            batch.append(st["queue"].popleft())
+                    while st["queue"] and len(batch) < cur_batch:
+                        batch.append(st["queue"].popleft())
                     st["busy"] += 1
                     # work remains behind us: make sure it isn't stuck
                     # waiting for this (possibly dependent) task
@@ -1524,6 +1599,7 @@ class CoreWorker:
                        for k, v in (kwargs or {}).items()},
             "return_ids": return_ids, "owner_address": self.address,
             "owner_node": self.node_id,
+            **self._trace_fields(),
         }
         if concurrency_group:
             spec["concurrency_group"] = concurrency_group
@@ -2064,7 +2140,10 @@ class CoreWorker:
             spec["task_id"], "RUNNING", name=spec.get("name"),
             job_id=spec.get("job_id"), node_id=self.node_id,
             worker_id=self.worker_id,
+            trace_id=spec.get("trace_id"), span_id=spec.get("span_id"),
+            parent_span_id=spec.get("parent_span_id"),
             type="ACTOR_TASK" if spec.get("actor_id") else "NORMAL_TASK")
+        trace_pair = (spec.get("trace_id"), spec.get("span_id"))
         if not spec.get("actor_id"):
             # actor workers keep the mask set at become_actor for life
             self._apply_accelerator_ids(spec)
@@ -2085,18 +2164,25 @@ class CoreWorker:
         self.current_task_id = spec["task_id"]
         if asyncio.iscoroutinefunction(getattr(fn, "__call__", fn)) or \
                 asyncio.iscoroutinefunction(fn):
-            value = await fn(*args, **kwargs)
+            tok = _trace_ctx.set(trace_pair)
+            try:
+                value = await fn(*args, **kwargs)
+            finally:
+                _trace_ctx.reset(tok)
         else:
             key = spec.get("method") or spec.get("func_id")
 
             def _call():
                 token = self._apply_runtime_env(spec)
                 prev = getattr(_exec_tls, "method_key", None)
+                prev_trace = getattr(_exec_tls, "trace", None)
                 _exec_tls.method_key = key
+                _exec_tls.trace = trace_pair
                 try:
                     return fn(*args, **kwargs)
                 finally:
                     _exec_tls.method_key = prev
+                    _exec_tls.trace = prev_trace
                     self._restore_runtime_env(token)
             # adaptive inline execution: methods with a sub-threshold
             # running-average duration skip the thread-pool round trip

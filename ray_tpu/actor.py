@@ -63,6 +63,9 @@ class ActorHandle:
         if name not in self._method_names:
             raise AttributeError(
                 f"actor has no method {name!r}; methods: {self._method_names}")
+        # NOT cached on the handle: that would create a reference cycle
+        # (handle -> method -> handle) deferring the owner handle's
+        # refcount-driven __del__ (= actor termination) to a gc pass
         return ActorMethod(self, name)
 
     def _invoke(self, method: str, args, kwargs, opts: Dict[str, Any]):

@@ -25,3 +25,25 @@ try:
     jax.config.update("jax_num_cpu_devices", 8)
 except Exception:   # backend already initialized (env vars took effect)
     pass
+
+import pytest  # noqa: E402
+
+# Two tiers (suite wall-clock grows ~6 min/round; the full matrix is for
+# rounds/CI, the fast tier for inner-loop dev):
+#   fast:  python -m pytest tests/ -m 'not slow'   (~1/3 of the time)
+#   full:  python -m pytest tests/
+_SLOW_FILES = {
+    "test_chaos.py", "test_cluster_launcher.py", "test_data_shuffle.py",
+    "test_data_ingest.py", "test_gcs_ft.py", "test_jax_distributed.py",
+    "test_multi_node.py", "test_object_transfer.py",
+    "test_rl_regression.py", "test_rl_algos.py", "test_rl_multi_agent.py",
+    "test_runtime_env_pip.py", "test_serve_harden.py", "test_serve.py",
+    "test_slice_gang.py", "test_train_e2e.py", "test_tune.py",
+    "test_view_sync.py", "test_sharded_checkpoint.py",
+}
+
+
+def pytest_collection_modifyitems(items):
+    for item in items:
+        if item.path.name in _SLOW_FILES:
+            item.add_marker(pytest.mark.slow)

@@ -58,6 +58,24 @@ def test_checkpoint_manager_retention_on_uri():
     assert best.to_dict()["i"] == 1
 
 
+def test_external_store_client_round_trip():
+    """GCS store-client external impl: snapshot + address on a remote
+    URI (reference: redis_store_client.h — off-node GCS state so a
+    replacement GCS can restart elsewhere)."""
+    from ray_tpu._private.store_client import (ExternalStoreClient,
+                                               FileStoreClient,
+                                               store_client_for)
+    sc = store_client_for("memory://gcs-ft/clusterA")
+    assert isinstance(sc, ExternalStoreClient)
+    assert sc.load_snapshot() is None and sc.read_address() is None
+    sc.save_snapshot(b"state-v1")
+    sc.write_address("tcp:10.0.0.5:6379")
+    sc2 = store_client_for("memory://gcs-ft/clusterA")
+    assert sc2.load_snapshot() == b"state-v1"
+    assert sc2.read_address() == "tcp:10.0.0.5:6379"
+    assert isinstance(store_client_for("/tmp/x.bin"), FileStoreClient)
+
+
 def test_spill_to_uri_and_restore(tmp_path):
     """Node-manager spilling through the URI backend: fill a small store
     past the watermark, assert objects land under the spill URI and come

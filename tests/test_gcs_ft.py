@@ -101,6 +101,82 @@ def test_gcs_restart_recovers_state(gcs_restart_cluster):
     assert ray_tpu.get(f.remote(41), timeout=60) == 42
 
 
+def test_gcs_restart_on_different_port(tmp_path):
+    """Store-client GCS-FT: kill the GCS, restart it from the same store
+    on a NEW port. Node managers re-discover the published address and
+    re-register; in-flight tasks complete; new work runs against the
+    restarted GCS (reference: Redis-backed GCS-FT — raylets re-resolve
+    the GCS address from the store, redis_store_client.h:106,
+    python/ray/tests/test_gcs_fault_tolerance.py)."""
+    from ray_tpu._private import node as node_mod
+    persist = str(tmp_path / "gcs_store.bin")
+    session = f"ftmove{os.getpid()}"
+    port1 = _free_port()
+    gcs_proc, gcs_addr = _spawn_gcs(port1, persist, session)
+    node = node_mod.start_node(gcs_addr, num_cpus=2, session_name=session,
+                               gcs_address_source=persist)
+    ray_tpu.init(address=gcs_addr)
+    try:
+        w = ray_tpu._get_worker()
+        w.gcs_call("kv_put", ns="user", key=b"moved", value=b"yes")
+
+        @ray_tpu.remote
+        def slow(x):
+            time.sleep(1.5)
+            return x * 10
+
+        # warm the worker pool + ship the function while the GCS lives:
+        # in-flight completion during an outage is a data-plane property
+        # of EXISTING workers (fresh spawns need the GCS for function
+        # fetch, same as the reference)
+        assert ray_tpu.get(slow.remote(0), timeout=60) == 0
+        refs = [slow.remote(i) for i in range(4)]
+        gcs_proc.send_signal(signal.SIGKILL)
+        gcs_proc.wait()
+
+        port2 = _free_port()
+        assert port2 != port1
+        gcs_proc, new_addr = _spawn_gcs(port2, persist, session)
+        assert new_addr != gcs_addr
+
+        # in-flight tasks complete (data plane never touches the GCS)
+        assert ray_tpu.get(refs, timeout=90) == [0, 10, 20, 30]
+
+        # the node manager re-reads the published address and
+        # re-registers with the NEW GCS
+        import subprocess as sp
+        deadline = time.time() + 60
+        nodes = []
+        while time.time() < deadline:
+            out = sp.run(
+                [sys.executable, "-c",
+                 "import sys, ray_tpu\n"
+                 "ray_tpu.init(address=sys.argv[1])\n"
+                 "w = ray_tpu._get_worker()\n"
+                 "ns = [n for n in w.gcs_call('get_all_nodes')"
+                 " if n['alive']]\n"
+                 "print('ALIVE', len(ns))\n"
+                 "print('KV', w.gcs_call('kv_get', ns='user',"
+                 " key=b'moved'))\n"
+                 "import ray_tpu as r\n"
+                 "@r.remote\n"
+                 "def f(x): return x + 1\n"
+                 "print('TASK', r.get(f.remote(41), timeout=60))\n"
+                 "r.shutdown()\n", new_addr],
+                capture_output=True, text=True, timeout=120)
+            if "ALIVE 1" in out.stdout and "TASK 42" in out.stdout:
+                nodes = [1]
+                break
+            time.sleep(2)
+        assert nodes, f"node never re-registered with moved GCS: {out.stdout}\n{out.stderr}"
+        assert "KV b'yes'" in out.stdout
+    finally:
+        ray_tpu.shutdown()
+        node.kill()
+        if gcs_proc.poll() is None:
+            gcs_proc.kill()
+
+
 def test_gcs_restart_while_tasks_inflight(gcs_restart_cluster):
     ctx = gcs_restart_cluster
 

@@ -1,0 +1,87 @@
+"""Trace context propagation (reference:
+python/ray/util/tracing/tracing_helper.py:34 — spans wrap remote calls
+with the trace context carried in task metadata; here the context is
+(trace_id, span_id, parent_span_id) stamped on every task spec and
+surfaced via task events / the timeline export)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 1, "resources": {"n1": 1.0}})
+    c.add_node(num_cpus=1, resources={"n2": 1.0})
+    ray_tpu.init(address=c.address)
+    c.wait_for_nodes()
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _events_by_name(w, names, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        rows = {r.get("name"): r
+                for r in w.gcs_call("list_task_events", limit=10000)}
+        if all(n in rows and rows[n].get("span_id") for n in names):
+            return rows
+        time.sleep(0.5)
+    raise AssertionError(f"missing events {names}: have {list(rows)}")
+
+
+def test_parent_child_linkage_across_nodes(cluster):
+    """driver -> outer (node 1) -> inner (node 2): one trace id end to
+    end, inner's parent span == outer's span, outer's parent is the
+    driver's root context (no parent span)."""
+
+    @ray_tpu.remote(resources={"n2": 0.1}, num_cpus=0.1, name="inner_t")
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote(resources={"n1": 0.1}, num_cpus=0.1, name="outer_t")
+    def outer():
+        return ray_tpu.get(inner.remote(1))
+
+    assert ray_tpu.get(outer.remote(), timeout=60) == 2
+    w = ray_tpu._get_worker()
+    rows = _events_by_name(w, ["outer_t", "inner_t"])
+    o, i = rows["outer_t"], rows["inner_t"]
+    assert o["trace_id"] == i["trace_id"], (o, i)
+    assert i["parent_span_id"] == o["span_id"], (o, i)
+    assert not o.get("parent_span_id"), o
+    assert o["node_id"] != i["node_id"], "tasks did not cross nodes"
+
+
+def test_actor_calls_carry_trace(cluster):
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return "ok"
+
+    a = A.remote()
+    assert ray_tpu.get(a.m.remote(), timeout=60) == "ok"
+    w = ray_tpu._get_worker()
+    rows = _events_by_name(w, ["m"])
+    assert rows["m"].get("trace_id") and rows["m"].get("span_id")
+
+
+def test_timeline_export_includes_spans(cluster, tmp_path):
+    @ray_tpu.remote(name="traced_task")
+    def t():
+        return 1
+
+    assert ray_tpu.get(t.remote(), timeout=60) == 1
+    w = ray_tpu._get_worker()
+    _events_by_name(w, ["traced_task"])
+    out = ray_tpu.timeline(str(tmp_path / "tl.json"))
+    import json
+    with open(out) as f:
+        events = json.load(f)
+    traced = [e for e in events if e["name"] == "traced_task"]
+    assert traced and traced[0]["args"]["trace_id"], traced[:1]
