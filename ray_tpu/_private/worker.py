@@ -569,27 +569,45 @@ class CoreWorker:
 
     async def get_many_async(self, refs: List[ObjectRef],
                              timeout: Optional[float] = None):
-        # OWNED refs resolve passively (executors push results to the
-        # owner; awaiting just parks on a completion event) — await them
-        # sequentially instead of gather's one-asyncio.Task-per-ref,
-        # which is measurable at bench throughput (200-ref batches).
-        # Borrowed refs need an ACTIVE remote fetch, so those still get
-        # eager tasks to keep transfers concurrent.
+        # OWNED refs COMPLETE passively (executors push results/locations
+        # to the owner; waiting just parks on a completion event), so
+        # completion is awaited sequentially instead of gather's
+        # one-asyncio.Task-per-ref — measurable at bench throughput
+        # (200-ref batches). Anything needing ACTIVE work — a borrowed
+        # ref's remote fetch, or an owned result that completed onto
+        # ANOTHER node's store — gets an eager task so transfers overlap
+        # instead of serializing one pull at a time.
         async def _all():
-            eager = {i: asyncio.ensure_future(self.get_async(r))
-                     for i, r in enumerate(refs)
-                     if r.id not in self.owned}
-            out = []
+            n = len(refs)
+            out = [None] * n
+            tasks: Dict[int, "asyncio.Future"] = {}
             try:
                 for i, r in enumerate(refs):
-                    fut = eager.pop(i, None)
-                    out.append(await (fut if fut is not None
-                                      else self.get_async(r)))
+                    if r.id not in self.owned:
+                        tasks[i] = asyncio.ensure_future(self.get_async(r))
+                for i, r in enumerate(refs):
+                    if i in tasks:
+                        continue
+                    entry = self.owned.get(r.id)
+                    while entry is not None and not entry.get("complete"):
+                        ev = self.object_events.setdefault(
+                            r.id, asyncio.Event())
+                        await ev.wait()
+                        entry = self.owned.get(r.id)
+                    loc = self.memory_store.get(r.id)
+                    if loc is not None and loc[0] == "loc" \
+                            and loc[1] != self.node_id:
+                        tasks[i] = asyncio.ensure_future(self.get_async(r))
+                    else:
+                        out[i] = await self.get_async(r)
+                for i, t in list(tasks.items()):
+                    out[i] = await t
+                    del tasks[i]
             finally:
                 # an early error/cancellation (incl. wait_for timeout)
-                # must not orphan the remaining eager fetch tasks
-                for fut in eager.values():
-                    fut.cancel()
+                # must not orphan in-flight fetch tasks
+                for t in tasks.values():
+                    t.cancel()
             return out
         if timeout is None:
             return await _all()

@@ -34,11 +34,45 @@ class ClientProxyServer:
         self.gcs_address = gcs_address
         self.port = port
         self.address: Optional[str] = None
-        self._funcs: Dict[bytes, Any] = {}        # func_id -> callable/class
-        self._objects: Dict[bytes, Any] = {}      # obj_id -> ObjectRef
-        self._actors: Dict[str, Any] = {}         # actor_id -> ActorHandle
+        # Per-CONNECTION sessions (reference: proxier.py runs one server
+        # per job; here sessions share the proxy driver process but each
+        # client gets its OWN function table / ref table / actor table,
+        # and disconnect frees the session's refs and kills its
+        # non-detached actors — one client's leaks cannot pin another's
+        # objects or grow its tables).
+        self._sessions: Dict[int, Dict[str, Dict]] = {}
         self._lock = threading.Lock()
         self._next = 0
+
+    def _session(self, conn) -> Dict[str, Dict]:
+        key = conn.peer_info.setdefault("client_session", id(conn))
+        st = self._sessions.get(key)
+        if st is None:
+            st = self._sessions[key] = {"funcs": {}, "objects": {},
+                                        "actors": {}}
+        return st
+
+    def _on_disconnect(self, conn):
+        key = conn.peer_info.get("client_session")
+        st = self._sessions.pop(key, None) if key is not None else None
+        if not st:
+            return
+        st["objects"].clear()     # drop the session's ref pins
+        detached = st.get("detached") or set()
+        victims = [h for aid, h in st["actors"].items()
+                   if aid not in detached]
+        if victims:
+            import asyncio
+
+            import ray_tpu
+
+            def _reap(handles):
+                for h in handles:
+                    try:
+                        ray_tpu.kill(h)
+                    except Exception:
+                        pass
+            asyncio.get_event_loop().run_in_executor(None, _reap, victims)
 
     def _new_id(self) -> bytes:
         import os
@@ -46,9 +80,9 @@ class ClientProxyServer:
             self._next += 1
             return self._next.to_bytes(8, "little") + os.urandom(8)
 
-    def _track(self, ref) -> bytes:
+    def _track(self, conn, ref) -> bytes:
         oid = self._new_id()
-        self._objects[oid] = ref
+        self._session(conn)["objects"][oid] = ref
         return oid
 
     # -- handlers (run on the proxy's rpc loop; blocking work uses the
@@ -60,13 +94,14 @@ class ClientProxyServer:
         value = cloudpickle.loads(payload)
         ref = await asyncio.get_event_loop().run_in_executor(
             None, ray_tpu.put, value)
-        return self._track(ref)
+        return self._track(conn, ref)
 
     async def h_get(self, conn, oids: List[bytes], timeout=None):
         import asyncio
 
         import ray_tpu
-        refs = [self._objects[o] for o in oids]
+        objects = self._session(conn)["objects"]
+        refs = [objects[o] for o in oids]
 
         def fetch():
             vals = ray_tpu.get(refs, timeout=timeout)
@@ -83,8 +118,9 @@ class ClientProxyServer:
         import asyncio
 
         import ray_tpu
-        refs = [self._objects[o] for o in oids]
-        by_ref = {id(self._objects[o]): o for o in oids}
+        objects = self._session(conn)["objects"]
+        refs = [objects[o] for o in oids]
+        by_ref = {id(objects[o]): o for o in oids}
         ready, rest = await asyncio.get_event_loop().run_in_executor(
             None, lambda: ray_tpu.wait(refs, num_returns=num_returns,
                                        timeout=timeout))
@@ -92,16 +128,18 @@ class ClientProxyServer:
                 "not_ready": [by_ref[id(r)] for r in rest]}
 
     def h_register_function(self, conn, func_id: bytes, payload: bytes):
-        if func_id not in self._funcs:
-            self._funcs[func_id] = cloudpickle.loads(payload)
+        funcs = self._session(conn)["funcs"]
+        if func_id not in funcs:
+            funcs[func_id] = cloudpickle.loads(payload)
         return True
 
-    def _decode_args(self, args_payload: bytes):
+    def _decode_args(self, conn, args_payload: bytes):
         args, kwargs = cloudpickle.loads(args_payload)
+        objects = self._session(conn)["objects"]
 
         def resolve(v):
             if isinstance(v, _ServerRefMarker):
-                return self._objects[v.oid]
+                return objects[v.oid]
             return v
         return ([resolve(a) for a in args],
                 {k: resolve(v) for k, v in kwargs.items()})
@@ -111,30 +149,35 @@ class ClientProxyServer:
         import asyncio
 
         import ray_tpu
-        fn = self._funcs[func_id]
-        args, kwargs = self._decode_args(args_payload)
+        fn = self._session(conn)["funcs"][func_id]
+        args, kwargs = self._decode_args(conn, args_payload)
         rf = ray_tpu.remote(fn)
         if opts:
             rf = rf.options(**opts)
         refs = await asyncio.get_event_loop().run_in_executor(
             None, lambda: rf.remote(*args, **kwargs))
         refs = refs if isinstance(refs, list) else [refs]
-        return [self._track(r) for r in refs]
+        return [self._track(conn, r) for r in refs]
 
     async def h_create_actor(self, conn, func_id: bytes, args_payload: bytes,
                              opts: Dict):
         import asyncio
 
         import ray_tpu
-        cls = self._funcs[func_id]
-        args, kwargs = self._decode_args(args_payload)
+        cls = self._session(conn)["funcs"][func_id]
+        args, kwargs = self._decode_args(conn, args_payload)
         ac = ray_tpu.remote(cls)
         if opts:
             ac = ac.options(**opts)
         handle = await asyncio.get_event_loop().run_in_executor(
             None, lambda: ac.remote(*args, **kwargs))
         actor_id = handle._actor_id
-        self._actors[actor_id] = handle
+        st = self._session(conn)
+        st["actors"][actor_id] = handle
+        if (opts or {}).get("lifetime") == "detached":
+            # detached actors outlive their creator BY CONTRACT — track
+            # for calls but exclude from disconnect reaping
+            st.setdefault("detached", set()).add(actor_id)
         return actor_id
 
     async def h_call_actor(self, conn, actor_id: str, method_name: str,
@@ -142,18 +185,18 @@ class ClientProxyServer:
         import asyncio
 
         import ray_tpu
-        handle = self._actors[actor_id]
-        args, kwargs = self._decode_args(args_payload)
+        handle = self._session(conn)["actors"][actor_id]
+        args, kwargs = self._decode_args(conn, args_payload)
         ref = await asyncio.get_event_loop().run_in_executor(
             None, lambda: getattr(handle, method_name).remote(
                 *args, **kwargs))
-        return self._track(ref)
+        return self._track(conn, ref)
 
     async def h_kill_actor(self, conn, actor_id: str):
         import asyncio
 
         import ray_tpu
-        handle = self._actors.pop(actor_id, None)
+        handle = self._session(conn)["actors"].pop(actor_id, None)
         if handle is not None:
             # blocking bridge must not run on this loop (it IS the
             # driver's loop) — executor thread instead
@@ -162,8 +205,9 @@ class ClientProxyServer:
         return True
 
     def h_free(self, conn, oids: List[bytes]):
+        objects = self._session(conn)["objects"]
         for o in oids:
-            self._objects.pop(o, None)
+            objects.pop(o, None)
         return True
 
     async def h_cluster_resources(self, conn):
@@ -187,6 +231,7 @@ class ClientProxyServer:
             "ping": lambda conn: "pong",
         }
         self.server = rpc.Server(handlers, name="client-proxy")
+        self.server.on_disconnect = self._on_disconnect
         self.address = await self.server.listen_tcp("0.0.0.0", self.port)
         return self.address
 

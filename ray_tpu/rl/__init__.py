@@ -6,9 +6,10 @@ from ray_tpu.rl.multi_agent import (MultiAgentConfig, MultiAgentEnv,
                                     MultiAgentEnvRunner, MultiAgentPPO)
 from ray_tpu.rl.offline import BC, BCConfig, record_experiences
 from ray_tpu.rl.replay_buffer import ReplayBuffer
+from ray_tpu.rl.sac import SAC
 from ray_tpu.rl.vtrace import vtrace
 
-__all__ = ["Algorithm", "PPO", "IMPALA", "DQN", "AlgorithmConfig",
+__all__ = ["Algorithm", "PPO", "IMPALA", "DQN", "SAC", "AlgorithmConfig",
            "ReplayBuffer", "vtrace", "MultiAgentEnv", "MultiAgentConfig",
            "MultiAgentEnvRunner", "MultiAgentPPO", "BC", "BCConfig",
            "record_experiences"]

@@ -28,6 +28,12 @@ class AlgorithmConfig:
     hidden_sizes: tuple = (64, 64)
     num_learners: int = 1
     seed: int = 0
+    # off-policy knobs (DQN / SAC)
+    replay_capacity: int = 50_000
+    tau: float = 0.005              # polyak target coefficient
+    initial_alpha: float = 0.2      # SAC entropy temperature (auto-tuned)
+    target_entropy: Optional[float] = None   # default: -action_dim
+    updates_per_step: float = 1.0   # grad updates per env step (SAC)
 
     # fluent builder API (reference: AlgorithmConfig chaining)
     def environment(self, env: str, env_config: Optional[Dict] = None):

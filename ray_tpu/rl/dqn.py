@@ -42,6 +42,8 @@ class QEnvRunner:
             seed=config.get("seed", 0) + config.get("runner_index", 0))
         self._episode_returns = []
         self._running_returns = np.zeros(self.n_envs)
+        # mask for gymnasium NextStep autoreset steps (see rl/sac.py)
+        self._resetting = np.zeros(self.n_envs, bool)
 
     def set_weights(self, weights):
         import jax
@@ -62,17 +64,20 @@ class QEnvRunner:
             action = np.where(explore, random_a, greedy)
             nxt, rew, term, trunc, _ = self.envs.step(action)
             done = np.logical_or(term, trunc)
-            obs_b.append(obs.copy())
-            act_b.append(action)
-            rew_b.append(rew)
-            # bootstrap through time-limit truncation, not termination
-            done_b.append(term.astype(np.float32))
-            next_b.append(nxt.copy())
-            self._running_returns += rew
+            valid = ~self._resetting
+            if valid.any():
+                obs_b.append(obs[valid].copy())
+                act_b.append(action[valid])
+                rew_b.append(rew[valid])
+                # bootstrap through time-limit truncation, not termination
+                done_b.append(term[valid].astype(np.float32))
+                next_b.append(nxt[valid].copy())
+            self._running_returns += np.where(valid, rew, 0.0)
             for i, d in enumerate(done):
                 if d:
                     self._episode_returns.append(self._running_returns[i])
                     self._running_returns[i] = 0.0
+            self._resetting = done
             obs = nxt
         self.obs = obs
         cat = lambda xs: np.concatenate(xs, 0)  # noqa: E731

@@ -1,0 +1,338 @@
+"""SAC: soft actor-critic for continuous control (reference:
+rllib/algorithms/sac/ — squashed-Gaussian policy, twin Q with a min
+target, polyak-averaged target networks, auto-tuned entropy temperature;
+the whole update is ONE jitted function, target sync by tau each step).
+
+TPU-first shape: every grad update (actor + both critics + alpha) is a
+single compiled step over a replay minibatch — no per-network Python
+round trips."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.replay_buffer import ReplayBuffer
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+def make_nets(action_dim: int, hidden_sizes: Sequence[int]):
+    from flax import linen as nn
+
+    class Policy(nn.Module):
+        @nn.compact
+        def __call__(self, obs):
+            x = obs
+            for h in hidden_sizes:
+                x = nn.relu(nn.Dense(h)(x))
+            mean = nn.Dense(action_dim)(x)
+            log_std = nn.Dense(action_dim)(x)
+            import jax.numpy as jnp
+            return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    class TwinQ(nn.Module):
+        @nn.compact
+        def __call__(self, obs, action):
+            import jax.numpy as jnp
+            x = jnp.concatenate([obs, action], -1)
+            qs = []
+            for _ in range(2):
+                h = x
+                for w in hidden_sizes:
+                    h = nn.relu(nn.Dense(w)(h))
+                qs.append(nn.Dense(1)(h)[..., 0])
+            return qs[0], qs[1]
+
+    return Policy(), TwinQ()
+
+
+def squashed_sample(mean, log_std, key):
+    """a = tanh(u), u ~ N(mean, std); returns (action, logp) with the
+    tanh change-of-variables correction (SAC paper appendix C)."""
+    import jax
+    import jax.numpy as jnp
+    std = jnp.exp(log_std)
+    u = mean + std * jax.random.normal(key, mean.shape)
+    logp_u = (-0.5 * ((u - mean) / std) ** 2 - log_std
+              - 0.5 * math.log(2 * math.pi)).sum(-1)
+    a = jnp.tanh(u)
+    logp = logp_u - jnp.log(1 - a ** 2 + 1e-6).sum(-1)
+    return a, logp
+
+
+class SacEnvRunner:
+    """Stochastic transition collector; actions squashed to [-1,1] and
+    affine-mapped to the env's Box bounds."""
+
+    def __init__(self, config: Dict):
+        import gymnasium as gym
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")   # rollouts: CPU
+        except Exception:
+            pass
+        import jax.numpy as jnp
+        self.cfg = config
+        self.n_envs = config["num_envs_per_env_runner"]
+        self.envs = gym.vector.SyncVectorEnv(
+            [lambda: gym.make(config["env"], **config.get("env_config", {}))
+             for _ in range(self.n_envs)])
+        space = self.envs.single_action_space
+        self.low = np.asarray(space.low, np.float32)
+        self.high = np.asarray(space.high, np.float32)
+        obs_dim = int(np.prod(self.envs.single_observation_space.shape))
+        action_dim = int(np.prod(space.shape))
+        self.policy, _ = make_nets(action_dim,
+                                   tuple(config.get("hidden_sizes",
+                                                    (64, 64))))
+        self.params = self.policy.init(
+            jax.random.PRNGKey(config.get("seed", 0)),
+            jnp.zeros((1, obs_dim)))["params"]
+        self._fwd = jax.jit(
+            lambda p, o: self.policy.apply({"params": p}, o))
+        self.rng = jax.random.PRNGKey(config.get("seed", 0)
+                                      + config.get("runner_index", 0) * 997)
+        self.obs, _ = self.envs.reset(
+            seed=config.get("seed", 0) + config.get("runner_index", 0))
+        self._episode_returns = []
+        self._running_returns = np.zeros(self.n_envs)
+        # gymnasium >=1.0 NextStep autoreset: the step AFTER a done is a
+        # reset step (action ignored, reward 0, obs = fresh episode).
+        # Recording it would poison the replay buffer with a bogus
+        # final_obs -> reset_obs transition, so it is masked out.
+        self._resetting = np.zeros(self.n_envs, bool)
+
+    def set_weights(self, weights):
+        import jax
+        self.params = jax.device_put(weights)
+        return True
+
+    def _to_env(self, a: np.ndarray) -> np.ndarray:
+        return self.low + (a + 1.0) * 0.5 * (self.high - self.low)
+
+    def sample(self, num_steps: Optional[int] = None,
+               random_actions: bool = False) -> Dict[str, np.ndarray]:
+        import jax
+        T = num_steps or self.cfg["rollout_fragment_length"]
+        N = self.n_envs
+        obs_b, act_b, rew_b, done_b, next_b = [], [], [], [], []
+        obs = self.obs
+        for _ in range(T):
+            if random_actions:
+                a = np.random.default_rng().uniform(-1, 1,
+                                                    (N,) + self.low.shape)
+            else:
+                self.rng, key = jax.random.split(self.rng)
+                mean, log_std = self._fwd(self.params,
+                                          obs.astype(np.float32))
+                a, _ = squashed_sample(mean, log_std, key)
+                a = np.asarray(a)
+            nxt, rew, term, trunc, _ = self.envs.step(self._to_env(a))
+            valid = ~self._resetting
+            if valid.any():
+                obs_b.append(obs[valid].copy())
+                act_b.append(a[valid])
+                rew_b.append(rew[valid])
+                done_b.append(term[valid].astype(np.float32))
+                next_b.append(nxt[valid].copy())
+            self._running_returns += np.where(valid, rew, 0.0)
+            done = np.logical_or(term, trunc)
+            for i, d in enumerate(done):
+                if d:
+                    self._episode_returns.append(self._running_returns[i])
+                    self._running_returns[i] = 0.0
+            self._resetting = done
+            obs = nxt
+        self.obs = obs
+        cat = lambda xs: np.concatenate(xs, 0)  # noqa: E731
+        return {"obs": cat(obs_b).astype(np.float32),
+                "actions": cat(act_b).astype(np.float32),
+                "rewards": cat(rew_b).astype(np.float32),
+                "dones": cat(done_b).astype(np.float32),
+                "next_obs": cat(next_b).astype(np.float32)}
+
+    def get_metrics(self) -> Dict:
+        return {"episode_return_mean":
+                float(np.mean(self._episode_returns[-20:]))
+                if self._episode_returns else None,
+                "num_episodes": len(self._episode_returns)}
+
+
+class SAC:
+    """Driver: replay collection + one jitted actor/critic/alpha update."""
+
+    def __init__(self, config: AlgorithmConfig):
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        import optax
+        import ray_tpu
+
+        self.config = config
+        cfg = dataclasses.asdict(config)
+        probe = gym.make(config.env, **config.env_config)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        action_dim = int(np.prod(probe.action_space.shape))
+        probe.close()
+
+        runner_cls = ray_tpu.remote(SacEnvRunner)
+        self.env_runners = [runner_cls.remote({**cfg, "runner_index": i})
+                            for i in range(config.num_env_runners)]
+        self.buffer = ReplayBuffer(config.replay_capacity, seed=config.seed)
+        self.policy, self.qnet = make_nets(action_dim,
+                                           tuple(config.hidden_sizes))
+        k0, k1 = jax.random.split(jax.random.PRNGKey(config.seed))
+        obs0 = jnp.zeros((1, obs_dim))
+        act0 = jnp.zeros((1, action_dim))
+        pi_params = self.policy.init(k0, obs0)["params"]
+        q_params = self.qnet.init(k1, obs0, act0)["params"]
+        log_alpha = jnp.asarray(math.log(config.initial_alpha))
+        self.state = {"pi": pi_params, "q": q_params,
+                      "q_target": q_params, "log_alpha": log_alpha}
+        self.opt = {
+            "pi": optax.adam(config.lr),
+            "q": optax.adam(config.lr),
+            "alpha": optax.adam(config.lr),
+        }
+        self.opt_state = {
+            "pi": self.opt["pi"].init(pi_params),
+            "q": self.opt["q"].init(q_params),
+            "alpha": self.opt["alpha"].init(log_alpha),
+        }
+        gamma = config.gamma
+        tau = config.tau
+        target_entropy = (config.target_entropy
+                          if config.target_entropy is not None
+                          else -float(action_dim))
+        policy, qnet = self.policy, self.qnet
+        opt = self.opt
+
+        def q_loss(q_params, state, batch, key):
+            mean, log_std = policy.apply({"params": state["pi"]},
+                                         batch["next_obs"])
+            a2, logp2 = squashed_sample(mean, log_std, key)
+            tq1, tq2 = qnet.apply({"params": state["q_target"]},
+                                  batch["next_obs"], a2)
+            alpha = jnp.exp(state["log_alpha"])
+            target = batch["rewards"] + gamma * (1 - batch["dones"]) * (
+                jnp.minimum(tq1, tq2) - alpha * logp2)
+            target = jax.lax.stop_gradient(target)
+            q1, q2 = qnet.apply({"params": q_params},
+                                batch["obs"], batch["actions"])
+            return ((q1 - target) ** 2 + (q2 - target) ** 2).mean()
+
+        def pi_loss(pi_params, state, batch, key):
+            mean, log_std = policy.apply({"params": pi_params},
+                                         batch["obs"])
+            a, logp = squashed_sample(mean, log_std, key)
+            q1, q2 = qnet.apply({"params": state["q"]}, batch["obs"], a)
+            alpha = jax.lax.stop_gradient(jnp.exp(state["log_alpha"]))
+            return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp
+
+        def alpha_loss(log_alpha, logp):
+            return (-jnp.exp(log_alpha)
+                    * jax.lax.stop_gradient(logp + target_entropy)).mean()
+
+        @jax.jit
+        def update(state, opt_state, batch, key):
+            k1, k2 = jax.random.split(key)
+            ql, q_grads = jax.value_and_grad(q_loss)(
+                state["q"], state, batch, k1)
+            qu, new_q_opt = opt["q"].update(q_grads, opt_state["q"],
+                                            state["q"])
+            new_q = optax.apply_updates(state["q"], qu)
+            state = {**state, "q": new_q}
+            (pl, logp), pi_grads = jax.value_and_grad(
+                pi_loss, has_aux=True)(state["pi"], state, batch, k2)
+            pu, new_pi_opt = opt["pi"].update(pi_grads, opt_state["pi"],
+                                              state["pi"])
+            new_pi = optax.apply_updates(state["pi"], pu)
+            al, a_grad = jax.value_and_grad(alpha_loss)(
+                state["log_alpha"], logp)
+            au, new_a_opt = opt["alpha"].update(
+                a_grad, opt_state["alpha"], state["log_alpha"])
+            new_log_alpha = optax.apply_updates(state["log_alpha"], au)
+            new_target = jax.tree.map(
+                lambda t, q: (1 - tau) * t + tau * q,
+                state["q_target"], new_q)
+            new_state = {"pi": new_pi, "q": new_q, "q_target": new_target,
+                         "log_alpha": new_log_alpha}
+            new_opt = {"pi": new_pi_opt, "q": new_q_opt,
+                       "alpha": new_a_opt}
+            return new_state, new_opt, {"q_loss": ql, "pi_loss": pl,
+                                        "alpha": jnp.exp(new_log_alpha)}
+
+        self._update = update
+        self._key = jax.random.PRNGKey(config.seed + 7)
+        self.iteration = 0
+        self._warmup = True
+        self._sync_runner_weights()
+
+    def _sync_runner_weights(self):
+        import jax
+        import ray_tpu
+        ref = ray_tpu.put(jax.device_get(self.state["pi"]))
+        ray_tpu.get([r.set_weights.remote(ref) for r in self.env_runners],
+                    timeout=300)
+
+    def training_step(self) -> Dict:
+        import jax
+        import jax.numpy as jnp
+        import ray_tpu
+        cfg = self.config
+        t0 = time.perf_counter()
+        batches = ray_tpu.get(
+            [r.sample.remote(random_actions=self._warmup)
+             for r in self.env_runners], timeout=600)
+        self._warmup = False
+        steps = 0
+        for b in batches:
+            self.buffer.add(b)
+            steps += len(b["obs"])
+        metrics = {}
+        if len(self.buffer) >= cfg.minibatch_size:
+            n_updates = max(1, int(steps * cfg.updates_per_step))
+            for _ in range(n_updates):
+                mb = self.buffer.sample(cfg.minibatch_size)
+                mb = {k: jnp.asarray(v) for k, v in mb.items()}
+                self._key, sub = jax.random.split(self._key)
+                self.state, self.opt_state, metrics = self._update(
+                    self.state, self.opt_state, mb, sub)
+            metrics = {k: float(v) for k, v in metrics.items()}
+        self._sync_runner_weights()
+        wall = time.perf_counter() - t0
+        runner_metrics = ray_tpu.get(
+            [r.get_metrics.remote() for r in self.env_runners],
+            timeout=120)
+        returns = [m["episode_return_mean"] for m in runner_metrics
+                   if m["episode_return_mean"] is not None]
+        return {"episode_return_mean":
+                float(np.mean(returns)) if returns else None,
+                "num_env_steps_sampled": steps,
+                "env_steps_per_s": steps / max(1e-9, wall),
+                "replay_size": len(self.buffer), **metrics}
+
+    def train(self) -> Dict:
+        self.iteration += 1
+        out = self.training_step()
+        out["training_iteration"] = self.iteration
+        return out
+
+    def get_weights(self):
+        import jax
+        return jax.device_get(self.state["pi"])
+
+    def stop(self):
+        import ray_tpu
+        for r in self.env_runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self.env_runners = []

@@ -54,6 +54,7 @@ def cmd_start(args):
         node = node_mod.start_node(
             addr, num_cpus=args.num_cpus,
             resources=json.loads(args.resources),
+            labels=json.loads(args.labels),
             object_store_memory=args.object_store_memory or None)
         print(f"node {node.node_id[:12]} joined {addr}")
 
@@ -192,6 +193,9 @@ def main(argv=None):
     ps.add_argument("--address", default=None)
     ps.add_argument("--num-cpus", type=float, default=None)
     ps.add_argument("--resources", default="{}")
+    ps.add_argument("--labels", default="{}",
+                    help="node labels JSON (e.g. the autoscaler's "
+                         "ray-tpu-provider-id)")
     ps.add_argument("--object-store-memory", type=int, default=0)
     ps.add_argument("--dashboard", action="store_true")
     ps.add_argument("--dashboard-port", type=int, default=8265)

@@ -42,6 +42,53 @@ def proxy_addr():
     proc.wait(timeout=10)
 
 
+def test_session_isolation(proxy_addr):
+    """Per-connection sessions (reference: proxier.py one-server-per-job
+    isolation): another connection can NEITHER read nor free a
+    session's refs, and the proxy survives a session's disconnect."""
+    import asyncio
+
+    from ray_tpu import client as rc
+    from ray_tpu._private import rpc
+
+    ctx = rc.connect(proxy_addr)
+    try:
+        import ray_tpu
+        ref = ray_tpu.put({"secret": 42})
+        oid = ref.id
+
+        # a SECOND connection probing the first session's oid must fail
+        # (its session tables are its own), while the owner still reads
+        async def probe():
+            conn = await rpc.connect(proxy_addr, name="intruder")
+            try:
+                out = await conn.call("get", oids=[oid], timeout=30)
+                return out
+            except rpc.RpcError as e:
+                return ("denied", str(e))
+            finally:
+                await conn.close()
+
+        out = asyncio.run(probe())
+        assert out[0] == "denied" and "KeyError" in out[1], out
+        assert ray_tpu.get(ref, timeout=60) == {"secret": 42}
+    finally:
+        ctx.disconnect()
+
+    # proxy stays healthy for fresh sessions after a disconnect
+    ctx2 = rc.connect(proxy_addr)
+    try:
+        import ray_tpu
+
+        @ray_tpu.remote
+        def ping():
+            return "alive"
+
+        assert ray_tpu.get(ping.remote(), timeout=60) == "alive"
+    finally:
+        ctx2.disconnect()
+
+
 def test_client_tasks_actors_objects(proxy_addr):
     from ray_tpu import client as rc
     ctx = rc.connect(proxy_addr)

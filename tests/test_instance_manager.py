@@ -1,0 +1,168 @@
+"""Autoscaler v2 instance manager + GCE VM provider (reference:
+python/ray/autoscaler/v2/instance_manager/instance_manager.py:29 state
+machine; _private/gcp/node_provider.py compute-engine half)."""
+
+import pytest
+
+from ray_tpu.autoscaler.instance_manager import (Instance, InstanceManager,
+                                                 Status)
+from ray_tpu.autoscaler.node_provider import GceVmNodeProvider
+
+
+class FakeCloudProvider:
+    """In-memory provider whose 'cloud' the test scripts directly."""
+
+    def __init__(self):
+        self.cloud = set()
+        self.fail_next_create = False
+        self.n = 0
+
+    def create_node(self, node_type, resources, labels):
+        if self.fail_next_create:
+            self.fail_next_create = False
+            raise RuntimeError("quota exceeded")
+        self.n += 1
+        pid = f"vm-{self.n}"
+        self.cloud.add(pid)
+        return pid
+
+    def terminate_node(self, pid):
+        self.cloud.discard(pid)
+
+    def non_terminated_nodes(self):
+        return list(self.cloud)
+
+
+NODE_TYPES = {"cpu_worker": {"resources": {"CPU": 8.0},
+                             "labels": {"team": "infra"}}}
+
+
+def _ray_node(pid):
+    return {"node_id": pid, "alive": True, "total": {"CPU": 8.0}}
+
+
+def test_scale_up_through_states():
+    prov = FakeCloudProvider()
+    im = InstanceManager(prov, NODE_TYPES)
+    im.set_target("cpu_worker", 2)
+    acts = im.reconcile([])
+    assert len(acts["launched"]) == 2
+    sts = [i.status for i in im.instances.values()]
+    assert sts.count(Status.REQUESTED) == 2
+    # next step: cloud lists them -> ALLOCATED
+    im.reconcile([])
+    assert all(i.status == Status.ALLOCATED
+               for i in im.instances.values())
+    # nodes register with the GCS -> RAY_RUNNING
+    im.reconcile([_ray_node(i.provider_id)
+                  for i in im.instances.values()])
+    assert all(i.status == Status.RAY_RUNNING
+               for i in im.instances.values())
+    # steady state: nothing more to do
+    acts = im.reconcile([_ray_node(i.provider_id)
+                         for i in im.instances.values()])
+    assert acts == {"launched": [], "terminated": [], "failed": []}
+
+
+def test_allocation_failure_retries_and_history():
+    prov = FakeCloudProvider()
+    prov.fail_next_create = True
+    im = InstanceManager(prov, NODE_TYPES)
+    im.set_target("cpu_worker", 1)
+    acts = im.reconcile([])
+    assert len(acts["failed"]) == 1
+    failed = next(i for i in im.instances.values()
+                  if i.status == Status.ALLOCATION_FAILED)
+    assert any("create failed" in h[2] for h in failed.history)
+    # failed instance is terminal; the deficit relaunches a NEW instance
+    acts = im.reconcile([])
+    assert len(acts["launched"]) == 1
+    assert len(im.instances) == 2
+
+
+def test_vanished_instance_marked_failed():
+    prov = FakeCloudProvider()
+    im = InstanceManager(prov, NODE_TYPES)
+    im.set_target("cpu_worker", 1)
+    im.reconcile([])
+    im.reconcile([])    # ALLOCATED
+    inst = next(iter(im.instances.values()))
+    prov.cloud.clear()  # preempted / deleted out of band
+    acts = im.reconcile([])
+    assert inst.status == Status.ALLOCATION_FAILED
+    assert acts["failed"] == [inst.instance_id]
+
+
+def test_scale_down_prefers_not_yet_running():
+    prov = FakeCloudProvider()
+    im = InstanceManager(prov, NODE_TYPES)
+    im.set_target("cpu_worker", 3)
+    im.reconcile([])
+    im.reconcile([])            # all ALLOCATED
+    insts = list(im.instances.values())
+    # only the first registers with ray
+    im.reconcile([_ray_node(insts[0].provider_id)])
+    assert insts[0].status == Status.RAY_RUNNING
+    im.set_target("cpu_worker", 1)
+    acts = im.reconcile([_ray_node(insts[0].provider_id)])
+    assert len(acts["terminated"]) == 2
+    assert insts[0].status == Status.RAY_RUNNING   # survivor = running one
+    # delete confirmed next step
+    im.reconcile([_ray_node(insts[0].provider_id)])
+    sts = sorted(i.status for i in im.instances.values())
+    assert sts.count(Status.TERMINATED) == 2
+    assert im.summary()["cpu_worker"][Status.RAY_RUNNING] == 1
+
+
+class FakeGceApi:
+    def __init__(self):
+        self.instances = {}
+        self.calls = []
+
+    def __call__(self, method, path, body=None):
+        self.calls.append((method, path))
+        if method == "POST":
+            assert body["machineType"].endswith("n2-standard-8")
+            assert body["labels"]["ray-tpu-node-type"] == "cpu-worker"
+            assert "startup-script" in body["metadata"]["items"][0]["key"]
+            self.instances[body["name"]] = "PROVISIONING"
+            return {}
+        if method == "GET":
+            return {"items": [{"name": n, "status": st}
+                              for n, st in self.instances.items()]}
+        if method == "DELETE":
+            self.instances.pop(path.rsplit("/", 1)[1], None)
+            return {}
+        raise AssertionError(method)
+
+
+def test_gce_vm_provider_lifecycle():
+    api = FakeGceApi()
+    p = GceVmNodeProvider("proj", "us-central1-a", "10.0.0.1:6379", api=api)
+    name = p.create_node("cpu_worker", {"CPU": 8}, {"team": "ml"})
+    assert name in api.instances
+    assert p.non_terminated_nodes() == [name]
+    api.instances[name] = "RUNNING"
+    assert p.non_terminated_nodes() == [name]
+    api.instances[name] = "TERMINATED"   # preempted
+    assert p.non_terminated_nodes() == []
+    p.terminate_node(name)
+    assert name not in api.instances
+
+
+def test_instance_manager_with_gce_provider_end_to_end():
+    api = FakeGceApi()
+    p = GceVmNodeProvider("proj", "us-central1-a", "10.0.0.1:6379", api=api)
+    im = InstanceManager(p, NODE_TYPES)
+    im.set_target("cpu_worker", 2)
+    im.reconcile([])
+    assert len(api.instances) == 2
+    for n in api.instances:
+        api.instances[n] = "RUNNING"
+    im.reconcile([])
+    assert im.summary()["cpu_worker"][Status.ALLOCATED] == 2
+    im.set_target("cpu_worker", 0)
+    im.reconcile([])
+    im.reconcile([])
+    assert not api.instances
+    assert im.summary()["cpu_worker"][Status.TERMINATED] == 2
