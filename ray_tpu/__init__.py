@@ -308,6 +308,9 @@ def timeline(filename: Optional[str] = None):
 
 
 def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    cc = _client()
+    if cc is not None:
+        return cc.get_actor(name, namespace)
     info = _get_worker().gcs_call("get_named_actor", name=name,
                                   namespace=namespace)
     if info is None:

@@ -192,6 +192,23 @@ class ClientProxyServer:
                 *args, **kwargs))
         return self._track(conn, ref)
 
+    async def h_get_actor(self, conn, name: str, namespace: str = "default"):
+        """Look up a (typically detached) named actor and attach its
+        handle to THIS session — the path by which a reconnecting client
+        regains access to actors that outlived its previous session
+        (reference: ray.get_actor through the client proxy)."""
+        import asyncio
+
+        import ray_tpu
+        handle = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: ray_tpu.get_actor(name, namespace))
+        st = self._session(conn)
+        st["actors"][handle._actor_id] = handle
+        # looked-up actors are never reaped on disconnect: this session
+        # did not create them
+        st.setdefault("detached", set()).add(handle._actor_id)
+        return handle._actor_id
+
     async def h_kill_actor(self, conn, actor_id: str):
         import asyncio
 
@@ -226,6 +243,7 @@ class ClientProxyServer:
             "create_actor": self.h_create_actor,
             "call_actor": self.h_call_actor,
             "kill_actor": self.h_kill_actor,
+            "get_actor": self.h_get_actor,
             "free": self.h_free,
             "cluster_resources": self.h_cluster_resources,
             "ping": lambda conn: "pong",
@@ -402,6 +420,11 @@ class ClientContext:
 
     def kill(self, actor: ClientActorHandle):
         self._call("kill_actor", actor_id=actor._actor_id)
+
+    def get_actor(self, name: str,
+                  namespace: str = "default") -> ClientActorHandle:
+        actor_id = self._call("get_actor", name=name, namespace=namespace)
+        return ClientActorHandle(self, actor_id)
 
     def cluster_resources(self):
         return self._call("cluster_resources")

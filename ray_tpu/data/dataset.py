@@ -291,31 +291,49 @@ class Dataset:
         return [Dataset([exe.InputStage(s)]) for s in shards]
 
     # ---------------------------------------------------------------- writes
+    # Distributed: each block is written by a REMOTE task on whatever
+    # node holds it (reference: ray.data write_* fan out write tasks;
+    # the driver never materializes block bytes), and paths go through
+    # the URI storage plane so gs://bucket/out works like a local dir.
+    def _write_blocks(self, path: str, fmt: str):
+        from ray_tpu.util import storage
+        storage.makedirs(path)
+
+        def _write_one(block, dst):
+            import io as _io
+            import json as _json
+
+            from ray_tpu.data import block as B
+            from ray_tpu.util import storage as _storage
+            buf = _io.BytesIO()
+            if fmt == "parquet":
+                import pyarrow.parquet as pq
+                pq.write_table(block, buf)
+            elif fmt == "csv":
+                import pyarrow.csv as pcsv
+                pcsv.write_csv(block, buf)
+            else:
+                for row in B.block_to_rows(block):
+                    buf.write((_json.dumps(row, default=str) + "\n")
+                              .encode())
+            _storage.write_bytes(dst, buf.getvalue())
+            return True
+
+        ext = {"parquet": "parquet", "csv": "csv", "json": "json"}[fmt]
+        task = ray_tpu.remote(_write_one)
+        from ray_tpu.util import storage as _s
+        refs = [task.remote(ref, _s.join(path, f"part-{i:05d}.{ext}"))
+                for i, (ref, _) in enumerate(self._execute())]
+        ray_tpu.get(refs)
+
     def write_parquet(self, path: str):
-        import os
-        import pyarrow.parquet as pq
-        os.makedirs(path, exist_ok=True)
-        for i, (ref, _) in enumerate(self._execute()):
-            pq.write_table(ray_tpu.get(ref),
-                           os.path.join(path, f"part-{i:05d}.parquet"))
+        self._write_blocks(path, "parquet")
 
     def write_csv(self, path: str):
-        import os
-        import pyarrow.csv as pcsv
-        os.makedirs(path, exist_ok=True)
-        for i, (ref, _) in enumerate(self._execute()):
-            pcsv.write_csv(ray_tpu.get(ref),
-                           os.path.join(path, f"part-{i:05d}.csv"))
+        self._write_blocks(path, "csv")
 
     def write_json(self, path: str):
-        import json
-        import os
-        os.makedirs(path, exist_ok=True)
-        for i, (ref, _) in enumerate(self._execute()):
-            block = ray_tpu.get(ref)
-            with open(os.path.join(path, f"part-{i:05d}.json"), "w") as f:
-                for row in block_lib.block_to_rows(block):
-                    f.write(json.dumps(row, default=str) + "\n")
+        self._write_blocks(path, "json")
 
     def __repr__(self):
         return f"Dataset(stages={len(self._stages)})"

@@ -517,11 +517,35 @@ def _instrument(stream: Iterator[RefBundle], st: StageStats
         yield (ref, meta)
 
 
+def _pushdown_limits(stages: List[Stage]) -> List[Stage]:
+    """Move a LimitStage ahead of row-count-preserving map stages so
+    upstream work stops as soon as `n` rows exist (reference:
+    logical/rules/limit_pushdown.py). Only `map` preserves cardinality
+    1:1 (filter/flat_map/map_batches may change it), and the original
+    limit stays in place as the exact cut."""
+    out = list(stages)
+    i = 1
+    while i < len(out):
+        s = out[i]
+        if isinstance(s, LimitStage):
+            j = i
+            while j > 0 and type(out[j - 1]) is MapStage \
+                    and all(k == "map" for k, *_ in out[j - 1].ops):
+                j -= 1
+            if j < i:
+                out.insert(j, LimitStage(s.limit))
+                i += 1    # the insertion shifted everything right
+        i += 1
+    return out
+
+
 def optimize_plan(stages: List[Stage]) -> List[Stage]:
-    """Rule pass: fuse adjacent task-pool map-family stages so a
-    .map().filter() chain pays ONE object-store round trip per block
-    (reference: logical/rules/operator_fusion.py). Actor-pool and
-    all-to-all stages are fusion barriers."""
+    """Rule passes (reference: _internal/logical/rules/):
+    1. limit pushdown past row-preserving maps
+    2. fuse adjacent task-pool map-family stages so a .map().filter()
+       chain pays ONE object-store round trip per block
+       (operator_fusion.py). Actor-pool/all-to-all stages are barriers."""
+    stages = _pushdown_limits(stages)
     out: List[Stage] = []
     for s in stages:
         if (out and type(s) is MapStage and type(out[-1]) is MapStage):

@@ -26,7 +26,10 @@ class Algorithm:
         _envs.register_envs()
         self.config = config
         probe = gym.make(config.env, **config.env_config)
-        obs_shape = probe.observation_space.shape
+        from ray_tpu.rl.connectors import pipeline_output_shape
+        # the learner's module sees CONNECTED observations
+        obs_shape = pipeline_output_shape(config.connectors or (),
+                                          probe.observation_space.shape)
         obs_dim = int(np.prod(obs_shape))
         spec = action_spec_of(probe.action_space)
         action_dim = spec.get("n") or spec["dim"]

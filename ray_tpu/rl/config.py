@@ -28,6 +28,9 @@ class AlgorithmConfig:
     hidden_sizes: tuple = (64, 64)
     num_learners: int = 1
     seed: int = 0
+    # env-to-module connector pipeline, e.g.
+    # [("frame_stack", {"k": 4}), ("normalize_obs", {})]
+    connectors: tuple = ()
     # off-policy knobs (DQN / SAC)
     replay_capacity: int = 50_000
     tau: float = 0.005              # polyak target coefficient

@@ -22,10 +22,20 @@ class QEnvRunner:
         import gymnasium as gym
         self.cfg = config
         self.n_envs = config["num_envs_per_env_runner"]
+        # SAME_STEP autoreset + final-obs patching (see rl/sac.py)
         self.envs = gym.vector.SyncVectorEnv(
             [lambda: gym.make(config["env"], **config.get("env_config", {}))
-             for _ in range(self.n_envs)])
-        obs_dim = int(np.prod(self.envs.single_observation_space.shape))
+             for _ in range(self.n_envs)],
+            autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
+        from ray_tpu.rl.connectors import (apply_pipeline, build_pipeline,
+                                           peek_pipeline,
+                                           pipeline_output_shape)
+        self._pipeline = build_pipeline(config.get("connectors") or ())
+        self._apply_pipeline = apply_pipeline
+        self._peek_pipeline = peek_pipeline
+        obs_dim = int(np.prod(pipeline_output_shape(
+            config.get("connectors") or (),
+            self.envs.single_observation_space.shape)))
         self.action_dim = self.envs.single_action_space.n
         from ray_tpu.rl.dqn import QNet   # self-import for actor pickling
         import jax
@@ -40,10 +50,10 @@ class QEnvRunner:
             config.get("seed", 0) + config.get("runner_index", 0) * 1000)
         self.obs, _ = self.envs.reset(
             seed=config.get("seed", 0) + config.get("runner_index", 0))
+        self._cobs = self._apply_pipeline(
+            self._pipeline, self.obs.astype(np.float32), is_reset=True)
         self._episode_returns = []
         self._running_returns = np.zeros(self.n_envs)
-        # mask for gymnasium NextStep autoreset steps (see rl/sac.py)
-        self._resetting = np.zeros(self.n_envs, bool)
 
     def set_weights(self, weights):
         import jax
@@ -56,30 +66,38 @@ class QEnvRunner:
         N = self.n_envs
         obs_b, act_b, rew_b, done_b, next_b = [], [], [], [], []
         obs = self.obs
+        cobs = self._cobs
         for _ in range(T):
-            q = np.asarray(self._q(self.params, obs.astype(np.float32)))
+            q = np.asarray(self._q(self.params, cobs.astype(np.float32)))
             greedy = q.argmax(-1)
             random_a = self.rng.integers(0, self.action_dim, N)
             explore = self.rng.random(N) < epsilon
             action = np.where(explore, random_a, greedy)
-            nxt, rew, term, trunc, _ = self.envs.step(action)
+            nxt, rew, term, trunc, info = self.envs.step(action)
             done = np.logical_or(term, trunc)
-            valid = ~self._resetting
-            if valid.any():
-                obs_b.append(obs[valid].copy())
-                act_b.append(action[valid])
-                rew_b.append(rew[valid])
-                # bootstrap through time-limit truncation, not termination
-                done_b.append(term[valid].astype(np.float32))
-                next_b.append(nxt[valid].copy())
-            self._running_returns += np.where(valid, rew, 0.0)
+            true_next = nxt.astype(np.float32)
+            if done.any() and "final_obs" in info:
+                true_next = true_next.copy()
+                mask = info.get("_final_obs", done)
+                for i in np.nonzero(mask)[0]:
+                    true_next[i] = info["final_obs"][i]
+            obs_b.append(cobs.copy())
+            act_b.append(action)
+            rew_b.append(rew)
+            # bootstrap through time-limit truncation, not termination
+            done_b.append(term.astype(np.float32))
+            next_b.append(self._peek_pipeline(self._pipeline, true_next))
+            self._running_returns += rew
             for i, d in enumerate(done):
                 if d:
                     self._episode_returns.append(self._running_returns[i])
                     self._running_returns[i] = 0.0
-            self._resetting = done
             obs = nxt
+            cobs = self._apply_pipeline(self._pipeline,
+                                        nxt.astype(np.float32),
+                                        reset_mask=done)
         self.obs = obs
+        self._cobs = cobs
         cat = lambda xs: np.concatenate(xs, 0)  # noqa: E731
         return {"obs": cat(obs_b).astype(np.float32),
                 "actions": cat(act_b).astype(np.int64),
@@ -122,7 +140,9 @@ class DQN:
         self.config = config
         cfg = dataclasses.asdict(config)
         probe = gym.make(config.env, **config.env_config)
-        obs_dim = int(np.prod(probe.observation_space.shape))
+        from ray_tpu.rl.connectors import pipeline_output_shape
+        obs_dim = int(np.prod(pipeline_output_shape(
+            config.connectors or (), probe.observation_space.shape)))
         action_dim = probe.action_space.n
         probe.close()
 

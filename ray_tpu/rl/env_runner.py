@@ -31,11 +31,23 @@ class EnvRunner:
         _envs.register_envs()
         self.cfg = config
         self.n_envs = config["num_envs_per_env_runner"]
+        # SAME_STEP autoreset: a done step returns the RESET observation
+        # (the true final obs rides in infos), so every recorded
+        # transition is real — gymnasium >=1.0's default NextStep mode
+        # would interleave a bogus action-ignored reset step into the
+        # rollout (stale obs, reward 0) that GAE/vtrace would train on
         self.envs = gym.vector.SyncVectorEnv(
             [lambda: gym.make(config["env"], **config.get("env_config", {}))
-             for _ in range(self.n_envs)])
+             for _ in range(self.n_envs)],
+            autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
+        from ray_tpu.rl.connectors import (apply_pipeline, build_pipeline,
+                                           pipeline_output_shape)
         from ray_tpu.rl.rl_module import action_spec_of, make_rl_module
-        obs_shape = self.envs.single_observation_space.shape
+        raw_shape = self.envs.single_observation_space.shape
+        self._pipeline = build_pipeline(config.get("connectors") or ())
+        self._apply_pipeline = apply_pipeline
+        obs_shape = pipeline_output_shape(config.get("connectors") or (),
+                                          raw_shape)
         self.action_spec = action_spec_of(self.envs.single_action_space)
         self.module = make_rl_module(
             obs_shape, self.action_spec,
@@ -45,6 +57,10 @@ class EnvRunner:
                                       + config.get("runner_index", 0) * 1000)
         self.obs, _ = self.envs.reset(seed=config.get("seed", 0)
                                       + config.get("runner_index", 0))
+        # connected view of the current obs: the module (and therefore
+        # the learner's batches) only ever sees pipeline output
+        self._cobs = self._apply_pipeline(
+            self._pipeline, self.obs.astype(np.float32), is_reset=True)
         self.gamma = config["gamma"]
         self.lam = config["lambda_"]
         self._episode_returns = []
@@ -60,7 +76,7 @@ class EnvRunner:
         import jax
         T = num_steps or self.cfg["rollout_fragment_length"]
         N = self.n_envs
-        obs_buf = np.zeros((T, N) + self.obs.shape[1:], np.float32)
+        obs_buf = np.zeros((T, N) + self._cobs.shape[1:], np.float32)
         act_buf = np.zeros((T, N) + self.module.action_event_shape,
                            self.module.action_np_dtype)
         logp_buf = np.zeros((T, N), np.float32)
@@ -69,16 +85,17 @@ class EnvRunner:
         val_buf = np.zeros((T, N), np.float32)
 
         obs = self.obs
+        cobs = self._cobs
         for t in range(T):
             self.rng, key = jax.random.split(self.rng)
             action, logp, value = self.module.sample_actions(
-                self.module.params, obs.astype(np.float32), key)
+                self.module.params, cobs.astype(np.float32), key)
             env_action = (self.module.clip_actions(action)
                           if hasattr(self.module, "clip_actions")
                           else action)
             nxt, rew, term, trunc, _ = self.envs.step(env_action)
             done = np.logical_or(term, trunc)
-            obs_buf[t] = obs
+            obs_buf[t] = cobs
             act_buf[t] = action
             logp_buf[t] = logp
             rew_buf[t] = rew
@@ -90,11 +107,15 @@ class EnvRunner:
                     self._episode_returns.append(self._running_returns[i])
                     self._running_returns[i] = 0.0
             obs = nxt
+            cobs = self._apply_pipeline(self._pipeline,
+                                        nxt.astype(np.float32),
+                                        reset_mask=done)
         self.obs = obs
+        self._cobs = cobs
 
         # bootstrap value for the final obs
         _, last_val = self.module.forward(self.module.params,
-                                          obs.astype(np.float32))
+                                          cobs.astype(np.float32))
         last_val = np.asarray(last_val)
         adv = np.zeros((T, N), np.float32)
         lastgaelam = np.zeros(N, np.float32)
@@ -122,20 +143,21 @@ class EnvRunner:
         import jax
         T = num_steps or self.cfg["rollout_fragment_length"]
         N = self.n_envs
-        obs_buf = np.zeros((T, N) + self.obs.shape[1:], np.float32)
+        obs_buf = np.zeros((T, N) + self._cobs.shape[1:], np.float32)
         act_buf = np.zeros((T, N), np.int64)
         logp_buf = np.zeros((T, N), np.float32)
         rew_buf = np.zeros((T, N), np.float32)
         done_buf = np.zeros((T, N), np.float32)
 
         obs = self.obs
+        cobs = self._cobs
         for t in range(T):
             self.rng, key = jax.random.split(self.rng)
             action, logp, _value = self.module.sample_actions(
-                self.module.params, obs.astype(np.float32), key)
+                self.module.params, cobs.astype(np.float32), key)
             nxt, rew, term, trunc, _ = self.envs.step(action)
             done = np.logical_or(term, trunc)
-            obs_buf[t] = obs
+            obs_buf[t] = cobs
             act_buf[t] = action
             logp_buf[t] = logp
             rew_buf[t] = rew
@@ -146,13 +168,17 @@ class EnvRunner:
                     self._episode_returns.append(self._running_returns[i])
                     self._running_returns[i] = 0.0
             obs = nxt
+            cobs = self._apply_pipeline(self._pipeline,
+                                        nxt.astype(np.float32),
+                                        reset_mask=done)
         self.obs = obs
+        self._cobs = cobs
         _, last_val = self.module.forward(self.module.params,
-                                          obs.astype(np.float32))
+                                          cobs.astype(np.float32))
         return {"obs": obs_buf, "actions": act_buf,
                 "behavior_logp": logp_buf, "rewards": rew_buf,
                 "dones": done_buf,
-                "bootstrap_obs": np.asarray(obs, np.float32),
+                "bootstrap_obs": np.asarray(cobs, np.float32),
                 "bootstrap_value": np.asarray(last_val, np.float32)}
 
     def get_metrics(self) -> Dict:

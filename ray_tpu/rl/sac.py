@@ -80,13 +80,25 @@ class SacEnvRunner:
         import jax.numpy as jnp
         self.cfg = config
         self.n_envs = config["num_envs_per_env_runner"]
+        # SAME_STEP autoreset (see rl/env_runner.py) — the done step
+        # returns the reset obs; the TRUE final obs rides in infos and
+        # patches next_obs so Q targets never bootstrap across episodes
         self.envs = gym.vector.SyncVectorEnv(
             [lambda: gym.make(config["env"], **config.get("env_config", {}))
-             for _ in range(self.n_envs)])
+             for _ in range(self.n_envs)],
+            autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
         space = self.envs.single_action_space
         self.low = np.asarray(space.low, np.float32)
         self.high = np.asarray(space.high, np.float32)
-        obs_dim = int(np.prod(self.envs.single_observation_space.shape))
+        from ray_tpu.rl.connectors import (apply_pipeline, build_pipeline,
+                                           peek_pipeline,
+                                           pipeline_output_shape)
+        self._pipeline = build_pipeline(config.get("connectors") or ())
+        self._apply_pipeline = apply_pipeline
+        self._peek_pipeline = peek_pipeline
+        obs_dim = int(np.prod(pipeline_output_shape(
+            config.get("connectors") or (),
+            self.envs.single_observation_space.shape)))
         action_dim = int(np.prod(space.shape))
         self.policy, _ = make_nets(action_dim,
                                    tuple(config.get("hidden_sizes",
@@ -100,13 +112,10 @@ class SacEnvRunner:
                                       + config.get("runner_index", 0) * 997)
         self.obs, _ = self.envs.reset(
             seed=config.get("seed", 0) + config.get("runner_index", 0))
+        self._cobs = self._apply_pipeline(
+            self._pipeline, self.obs.astype(np.float32), is_reset=True)
         self._episode_returns = []
         self._running_returns = np.zeros(self.n_envs)
-        # gymnasium >=1.0 NextStep autoreset: the step AFTER a done is a
-        # reset step (action ignored, reward 0, obs = fresh episode).
-        # Recording it would poison the replay buffer with a bogus
-        # final_obs -> reset_obs transition, so it is masked out.
-        self._resetting = np.zeros(self.n_envs, bool)
 
     def set_weights(self, weights):
         import jax
@@ -123,6 +132,7 @@ class SacEnvRunner:
         N = self.n_envs
         obs_b, act_b, rew_b, done_b, next_b = [], [], [], [], []
         obs = self.obs
+        cobs = self._cobs
         for _ in range(T):
             if random_actions:
                 a = np.random.default_rng().uniform(-1, 1,
@@ -130,26 +140,36 @@ class SacEnvRunner:
             else:
                 self.rng, key = jax.random.split(self.rng)
                 mean, log_std = self._fwd(self.params,
-                                          obs.astype(np.float32))
+                                          cobs.astype(np.float32))
                 a, _ = squashed_sample(mean, log_std, key)
                 a = np.asarray(a)
-            nxt, rew, term, trunc, _ = self.envs.step(self._to_env(a))
-            valid = ~self._resetting
-            if valid.any():
-                obs_b.append(obs[valid].copy())
-                act_b.append(a[valid])
-                rew_b.append(rew[valid])
-                done_b.append(term[valid].astype(np.float32))
-                next_b.append(nxt[valid].copy())
-            self._running_returns += np.where(valid, rew, 0.0)
+            nxt, rew, term, trunc, info = self.envs.step(self._to_env(a))
             done = np.logical_or(term, trunc)
+            # true next obs: at done steps the env already reset, the
+            # actual final observation is in infos (SAME_STEP mode)
+            true_next = nxt.astype(np.float32)
+            if done.any() and "final_obs" in info:
+                true_next = true_next.copy()
+                mask = info.get("_final_obs", done)
+                for i in np.nonzero(mask)[0]:
+                    true_next[i] = info["final_obs"][i]
+            cnext = self._peek_pipeline(self._pipeline, true_next)
+            obs_b.append(cobs.copy())
+            act_b.append(a)
+            rew_b.append(rew)
+            done_b.append(term.astype(np.float32))  # bootstrap truncation
+            next_b.append(cnext)
+            self._running_returns += rew
             for i, d in enumerate(done):
                 if d:
                     self._episode_returns.append(self._running_returns[i])
                     self._running_returns[i] = 0.0
-            self._resetting = done
             obs = nxt
+            cobs = self._apply_pipeline(self._pipeline,
+                                        nxt.astype(np.float32),
+                                        reset_mask=done)
         self.obs = obs
+        self._cobs = cobs
         cat = lambda xs: np.concatenate(xs, 0)  # noqa: E731
         return {"obs": cat(obs_b).astype(np.float32),
                 "actions": cat(act_b).astype(np.float32),
@@ -177,7 +197,9 @@ class SAC:
         self.config = config
         cfg = dataclasses.asdict(config)
         probe = gym.make(config.env, **config.env_config)
-        obs_dim = int(np.prod(probe.observation_space.shape))
+        from ray_tpu.rl.connectors import pipeline_output_shape
+        obs_dim = int(np.prod(pipeline_output_shape(
+            config.connectors or (), probe.observation_space.shape)))
         action_dim = int(np.prod(probe.action_space.shape))
         probe.close()
 

@@ -250,7 +250,12 @@ class ServeController:
                 else:
                     dep["spec"] = spec
                     dep["target"] = spec["config"]["num_replicas"]
-                    self._replace_replicas(dep)   # code/config change
+                    # code/config change -> ROLLING update: bump the
+                    # generation; _reconcile_deployment adds new-gen
+                    # replicas first and retires old-gen ones one at a
+                    # time, so capacity never drops to zero mid-deploy
+                    # (reference: serve deployment_state rolling updates)
+                    dep["gen"] = dep.get("gen", 0) + 1
                 auto = spec["config"].get("autoscaling_config")
                 if auto:
                     dep["target"] = max(auto["min_replicas"],
@@ -275,12 +280,39 @@ class ServeController:
 
     def _reconcile_deployment(self, dep: Dict):
         import ray_tpu
+        gen = dep.get("gen", 0)
+        gens = dep.setdefault("replica_gens", [])
+        while len(gens) < len(dep["replicas"]):
+            gens.append(gen)        # legacy/pre-roll replicas
+        del gens[len(dep["replicas"]):]
         changed = False
-        while len(dep["replicas"]) < dep["target"]:
-            dep["replicas"].append(self._make_replica(dep["spec"]))
+        new_count = sum(1 for g in gens if g == gen)
+        old_idx = [i for i, g in enumerate(gens) if g != gen]
+        if new_count < dep["target"]:
+            if old_idx:
+                # mid-roll: surge ONE new-generation replica per
+                # reconcile tick — gradual replacement
+                dep["replicas"].append(self._make_replica(dep["spec"]))
+                gens.append(gen)
+            else:
+                # fresh deploy / plain scale-up: fill to target now
+                while new_count < dep["target"]:
+                    dep["replicas"].append(self._make_replica(dep["spec"]))
+                    gens.append(gen)
+                    new_count += 1
             changed = True
-        while len(dep["replicas"]) > dep["target"]:
+        elif old_idx:
+            # current generation is at target: retire ONE old replica
+            victim = dep["replicas"].pop(old_idx[0])
+            gens.pop(old_idx[0])
+            try:
+                ray_tpu.kill(victim)
+            except Exception:
+                pass
+            changed = True
+        while len(dep["replicas"]) > dep["target"] and not old_idx:
             victim = dep["replicas"].pop()
+            gens.pop()
             try:
                 ray_tpu.kill(victim)
             except Exception:
@@ -296,17 +328,6 @@ class ServeController:
 
     def _bump_dep(self, dep: Dict):
         self._bump(self._dep_key(dep))
-
-    def _replace_replicas(self, dep: Dict):
-        import ray_tpu
-        for v in dep["replicas"]:
-            try:
-                ray_tpu.kill(v)
-            except Exception:
-                pass
-        dep["replicas"] = []
-        dep["version"] += 1
-        self._bump_dep(dep)
 
     def _reconcile_loop(self):
         import ray_tpu
@@ -333,6 +354,11 @@ class ServeController:
                     lens = self._probe_loads(dep)
                     with self._lock:
                         if len(alive) != len(dep["replicas"]):
+                            alive_set = {id(r) for r in alive}
+                            gens = dep.get("replica_gens") or []
+                            dep["replica_gens"] = [
+                                g for r, g in zip(dep["replicas"], gens)
+                                if id(r) in alive_set]
                             dep["replicas"] = alive
                             dep["version"] += 1
                             self._bump_dep(dep)

@@ -89,6 +89,42 @@ def test_session_isolation(proxy_addr):
         ctx2.disconnect()
 
 
+def test_detached_actor_survives_and_reattaches(proxy_addr):
+    """Detached actors outlive the creating session; a reconnecting
+    client reattaches by name via get_actor (reference: ray.get_actor
+    through the client proxy; proxier session isolation)."""
+    from ray_tpu import client as rc
+
+    ctx = rc.connect(proxy_addr)
+    try:
+        import ray_tpu
+
+        @ray_tpu.remote
+        class Keeper:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        k = Keeper.options(lifetime="detached", name="keeper").remote()
+        assert ray_tpu.get(k.incr.remote(), timeout=60) == 1
+    finally:
+        ctx.disconnect()
+
+    time.sleep(1.0)   # let disconnect reaping (of non-detached) run
+    ctx2 = rc.connect(proxy_addr)
+    try:
+        import ray_tpu
+        k2 = ray_tpu.get_actor("keeper")
+        # state survived the session that created it
+        assert ray_tpu.get(k2.incr.remote(), timeout=60) == 2
+        ray_tpu.kill(k2)
+    finally:
+        ctx2.disconnect()
+
+
 def test_client_tasks_actors_objects(proxy_addr):
     from ray_tpu import client as rc
     ctx = rc.connect(proxy_addr)

@@ -62,6 +62,32 @@ def test_stats_reports_rows_and_bytes(ray_start):
     assert "Read" in s and "100 rows" in s and "Total:" in s, s
 
 
+def test_limit_pushdown_past_map(ray_start):
+    """range(10k).map(f).limit(50): the limit moves ahead of the map, so
+    only ~1 block's rows are mapped instead of all 10k (reference:
+    logical/rules/limit_pushdown.py)."""
+    plan = exe.optimize_plan([
+        exe.InputStage([]),
+        exe.MapStage("map", lambda r: r),
+        exe.LimitStage(50),
+    ])
+    kinds = [type(s).__name__ for s in plan]
+    assert kinds == ["InputStage", "LimitStage", "MapStage", "LimitStage"]
+    # NOT pushed past cardinality-changing stages
+    plan2 = exe.optimize_plan([
+        exe.MapStage("filter", lambda r: True), exe.LimitStage(5)])
+    assert [type(s).__name__ for s in plan2] == ["MapStage", "LimitStage"]
+    # end-to-end correctness
+    ds = rd.range(10_000, parallelism=8) \
+        .map(lambda r: {"v": r["id"] * 2}).limit(50)
+    rows = ds.take_all()
+    assert [r["v"] for r in rows] == [i * 2 for i in range(50)]
+    stats = ds.stats()
+    map_line = next(ln for ln in stats.splitlines() if "Map(" in ln)
+    # the pushed-down limit cuts BEFORE the map: 50 rows mapped, not 10k
+    assert " 50 rows" in map_line, stats
+
+
 def test_fused_semantics_match_unfused(ray_start):
     base = rd.range(60, parallelism=3)
     fused = base.map(lambda r: {"v": r["id"] + 1}) \

@@ -58,6 +58,23 @@ def test_checkpoint_manager_retention_on_uri():
     assert best.to_dict()["i"] == 1
 
 
+def test_dataset_write_to_uri(tmp_path):
+    """Distributed writers go through the storage plane: write_parquet
+    to a local:// URI (same fsspec path as gs://) and read it back."""
+    import ray_tpu
+    import ray_tpu.data as rd
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+        out = f"local://{tmp_path}/ds-out"
+        rd.range(100, parallelism=4).write_parquet(out)
+        parts = sorted((tmp_path / "ds-out").glob("*.parquet"))
+        assert len(parts) == 4
+        back = rd.read_parquet([str(p) for p in parts])
+        assert sorted(r["id"] for r in back.take_all()) == list(range(100))
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_external_store_client_round_trip():
     """GCS store-client external impl: snapshot + address on a remote
     URI (reference: redis_store_client.h — off-node GCS state so a

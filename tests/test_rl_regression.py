@@ -46,7 +46,7 @@ def test_ppo_cartpole_threshold(ray_start):
                            rollout_fragment_length=64)
               .training(train_batch_size=512, minibatch_size=128,
                         num_epochs=6, lr=3e-4, entropy_coeff=0.01))
-    first, best = _run_until(config, stop_reward=150, max_iters=25)
+    first, best = _run_until(config, stop_reward=150, max_iters=40)
     assert best >= 150, (first, best)
 
 
