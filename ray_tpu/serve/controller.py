@@ -302,21 +302,18 @@ class ServeController:
                     new_count += 1
             changed = True
         elif old_idx:
-            # current generation is at target: retire ONE old replica
+            # current generation is at target: retire ONE old replica —
+            # gracefully: routers stop picking it (version bump below),
+            # the process lives until its queue drains (reference:
+            # replica graceful_shutdown_wait_loop)
             victim = dep["replicas"].pop(old_idx[0])
             gens.pop(old_idx[0])
-            try:
-                ray_tpu.kill(victim)
-            except Exception:
-                pass
+            self._start_drain(dep, victim)
             changed = True
         while len(dep["replicas"]) > dep["target"] and not old_idx:
             victim = dep["replicas"].pop()
             gens.pop()
-            try:
-                ray_tpu.kill(victim)
-            except Exception:
-                pass
+            self._start_drain(dep, victim)
             changed = True
         if changed:
             dep["version"] += 1
@@ -352,6 +349,7 @@ class ServeController:
                         except Exception:
                             alive.append(r)   # slow ≠ dead
                     lens = self._probe_loads(dep)
+                    self._reap_draining(dep)
                     with self._lock:
                         if len(alive) != len(dep["replicas"]):
                             alive_set = {id(r) for r in alive}
@@ -385,6 +383,57 @@ class ServeController:
         dep["target"] = autoscale_decision(
             auto, hist, float(sum(lens)), dep["target"], now,
             self._up_since, self._down_since, key)
+
+    def _start_drain(self, dep: Dict, victim):
+        """Enroll a retired replica for graceful drain (deadline from
+        the deployment's graceful_shutdown_timeout_s, default 30s).
+        Caller holds self._lock."""
+        timeout = float(dep["spec"]["config"]
+                        .get("graceful_shutdown_timeout_s", 30.0))
+        dep.setdefault("draining", []).append(
+            (victim, time.time() + timeout))
+
+    def _reap_draining(self, dep: Dict):
+        """Kill retired replicas once their queues empty (or the drain
+        deadline passes) — in-flight requests routed before the router
+        saw the new replica set complete instead of dying. Probes run
+        as ONE batched get outside the lock; only dead replicas or
+        expired deadlines reap (slow != dead, same as health checks)."""
+        import ray_tpu
+        with self._lock:
+            snapshot = list(dep.get("draining") or [])
+        if not snapshot:
+            return
+        refs = [h.get_queue_len.remote() for h, _ in snapshot]
+        done, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=5)
+        done_set = {r.id for r in done}
+        victims, keep = [], []
+        now = time.time()
+        for (h, deadline), ref in zip(snapshot, refs):
+            qlen = None
+            dead = False
+            if ref.id in done_set:
+                try:
+                    qlen = ray_tpu.get(ref, timeout=1)
+                except ray_tpu.ActorDiedError:
+                    dead = True
+                except Exception:
+                    pass
+            if dead or now > deadline or qlen == 0:
+                victims.append(h)
+            else:
+                keep.append((h, deadline))   # busy or merely slow
+        for h in victims:
+            try:
+                ray_tpu.kill(h)
+            except Exception:
+                pass
+        with self._lock:
+            current = dep.get("draining") or []
+            # keep anything enrolled since the snapshot + the keepers
+            snap_ids = {id(h) for h, _ in snapshot}
+            dep["draining"] = keep + [e for e in current
+                                      if id(e[0]) not in snap_ids]
 
     def _probe_loads(self, dep: Dict):
         """One queue-depth probe per reconcile tick, shared by autoscaling
@@ -445,7 +494,8 @@ class ServeController:
                 self.routes.pop(prefix, None)
         self._bump("routes")
         for dep in app.values():
-            for r in dep["replicas"]:
+            draining = [h for h, _ in dep.get("draining") or []]
+            for r in list(dep["replicas"]) + draining:
                 try:
                     ray_tpu.kill(r)
                 except Exception:

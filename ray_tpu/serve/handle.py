@@ -19,15 +19,25 @@ import ray_tpu
 class DeploymentResponse:
     """Future for one deployment call."""
 
-    def __init__(self, ref, router=None, replica_id=None):
+    def __init__(self, ref, router=None, replica_id=None, resubmit=None):
         self._ref = ref
         self._router = router
         self._replica_id = replica_id
+        self._resubmit = resubmit
         self._done = False
 
     def result(self, timeout: Optional[float] = None):
         try:
             return ray_tpu.get(self._ref, timeout=timeout)
+        except ray_tpu.ActorDiedError:
+            # the replica died after accepting the call (e.g. retired
+            # mid-roll before the router refreshed): re-route ONCE
+            # through the handle against the current replica set
+            self._settle()
+            if self._resubmit is None:
+                raise
+            retry, self._resubmit = self._resubmit, None
+            return retry().result(timeout=timeout)
         finally:
             self._settle()
 
@@ -41,6 +51,12 @@ class DeploymentResponse:
             try:
                 from ray_tpu._private.worker import global_worker
                 return await global_worker.core.get_async(self._ref)
+            except ray_tpu.ActorDiedError:
+                self._settle()
+                if self._resubmit is None:
+                    raise
+                retry, self._resubmit = self._resubmit, None
+                return await retry()
             finally:
                 self._settle()
         return _get().__await__()
@@ -243,7 +259,8 @@ class DeploymentHandle:
         self._router = _Router(deployment_name, app_name)
 
     def _invoke(self, method: str, args, kwargs,
-                retry: int = 2) -> DeploymentResponse:
+                retry: int = 2,
+                allow_resubmit: bool = True) -> DeploymentResponse:
         # unwrap nested responses so replicas receive resolved values
         args = tuple(a._object_ref if isinstance(a, DeploymentResponse)
                      else a for a in args)
@@ -263,7 +280,17 @@ class DeploymentHandle:
                     return DeploymentResponseGenerator(
                         replica, sid, self._router, idx)
                 ref = replica.handle_request.remote(method, args, kwargs)
-                return DeploymentResponse(ref, self._router, idx)
+                # one resubmit only: the retried response carries NO
+                # further resubmit, so a crash loop surfaces instead of
+                # retrying unboundedly past the caller's timeout
+                resub = None
+                if allow_resubmit:
+                    resub = lambda: (  # noqa: E731
+                        self._router.refresh(force=True)
+                        or self._invoke(method, args, kwargs, retry=retry,
+                                        allow_resubmit=False))
+                return DeploymentResponse(ref, self._router, idx,
+                                          resubmit=resub)
             except Exception as e:
                 self._router._dec(idx)
                 self._router.refresh(force=True)

@@ -107,6 +107,15 @@ GRID = [
     {"model": "tpu-7b", "B": 4, "L": 1024, "attn": "flash",
      "remat_policy": "nothing", "opt": "adafactor", "loss_chunk": 256,
      "param_dtype": "bf16"},
+    # wave 4: probe the dots-activation boundary around the 3b winner
+    {"model": "tpu-3b", "B": 6, "L": 1024, "attn": "flash",
+     "remat_policy": "dots", "opt": "adafactor", "loss_chunk": 128,
+     "param_dtype": "bf16"},
+    {"model": "tpu-3b", "B": 4, "L": 1536, "attn": "flash",
+     "remat_policy": "dots", "opt": "adafactor", "loss_chunk": 128,
+     "param_dtype": "bf16"},
+    {"model": "tpu-1b", "B": 12, "L": 1024, "attn": "flash",
+     "remat_policy": "dots", "opt": "adafactor", "loss_chunk": 128},
 ]
 
 OUT = os.path.join(os.path.dirname(__file__), "mfu_ablation.jsonl")
