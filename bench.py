@@ -396,9 +396,11 @@ def main():
         ray_tpu.shutdown()
 
     # phase B — multi-client suite: logical CPUs >= 4 so the N driver
-    # processes run CONCURRENT workers like the reference's 64-core box
+    # processes run CONCURRENT workers like the reference's 64-core box.
+    # 1 GiB store: 4 putters x 4 kept 32 MiB refs is exactly 512 MiB,
+    # which turns the put bench into a spill-thrash measurement
     ray_tpu.init(num_cpus=max(4, os.cpu_count() or 1),
-                 object_store_memory=512 * 1024 * 1024)
+                 object_store_memory=1024 * 1024 * 1024)
     try:
         for key, fn in [
             ("multi_client_tasks_async_per_s",
