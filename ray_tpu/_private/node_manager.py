@@ -200,6 +200,7 @@ class NodeManager:
         # cfg.spill_uri is set (gs:// on real pods; memory:// in tests)
         if cfg.spill_uri:
             from ray_tpu.util import storage as _storage
+            _storage.validate_root(cfg.spill_uri, "spill")
             self.spill_dir = _storage.join(
                 cfg.spill_uri, self.session_name,
                 f"spill_{self.node_id[:8]}")

@@ -177,8 +177,19 @@ class ExternalStoreClient(StoreClient):
 
 def store_client_for(target: str, fsync: bool = False) -> StoreClient:
     """path -> FileStoreClient; URI (scheme://) -> ExternalStoreClient."""
-    if "://" in target and not target.startswith("file://"):
-        return ExternalStoreClient(target)
-    if target.startswith("file://"):
-        target = "/" + target[len("file://"):].lstrip("/")
-    return FileStoreClient(target, fsync=fsync)
+    from ray_tpu.util import storage
+    scheme, path = storage._split(target)
+    if scheme:
+        client = ExternalStoreClient(target)
+        if not _WARNED_EXTERNAL_WAL.get(scheme):
+            _WARNED_EXTERNAL_WAL[scheme] = True
+            logger.warning(
+                "gcs persistence on %s:// disables the WAL: durability "
+                "is the snapshot interval, not per-mutation as with a "
+                "local path (gcs_wal_fsync ignored). The reference's "
+                "Redis store client persists every write.", scheme)
+        return client
+    return FileStoreClient(path, fsync=fsync)
+
+
+_WARNED_EXTERNAL_WAL: dict = {}

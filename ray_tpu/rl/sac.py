@@ -110,6 +110,10 @@ class SacEnvRunner:
             lambda p, o: self.policy.apply({"params": p}, o))
         self.rng = jax.random.PRNGKey(config.get("seed", 0)
                                       + config.get("runner_index", 0) * 997)
+        # warmup random actions share the config.seed reproducibility
+        # contract with the PRNGKeys above
+        self._np_rng = np.random.default_rng(
+            config.get("seed", 0) + config.get("runner_index", 0) * 997 + 1)
         self.obs, _ = self.envs.reset(
             seed=config.get("seed", 0) + config.get("runner_index", 0))
         self._cobs = self._apply_pipeline(
@@ -135,8 +139,7 @@ class SacEnvRunner:
         cobs = self._cobs
         for _ in range(T):
             if random_actions:
-                a = np.random.default_rng().uniform(-1, 1,
-                                                    (N,) + self.low.shape)
+                a = self._np_rng.uniform(-1, 1, (N,) + self.low.shape)
             else:
                 self.rng, key = jax.random.split(self.rng)
                 mean, log_std = self._fwd(self.params,

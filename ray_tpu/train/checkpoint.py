@@ -74,15 +74,22 @@ class Checkpoint:
         StorageContext uploads to pyarrow filesystems). Returns the new
         path/URI."""
         from ray_tpu.util import storage as _storage
+        _storage.validate_root(storage_dir, "checkpoint")
         name = name or f"checkpoint_{uuid.uuid4().hex[:8]}"
         if _storage.is_remote(storage_dir):
             uri = _storage.join(storage_dir, name)
             blob = self._blob
             if blob is None:
-                buf = BytesIO()
-                with tarfile.open(fileobj=buf, mode="w") as tar:
-                    tar.add(self.path, arcname=".")
-                blob = buf.getvalue()
+                tar_uri = _storage.join(self.path, "ckpt.tar")
+                if _storage.is_remote(self.path):
+                    # already tarred at the source URI: copy the bytes
+                    # (tar.add only reads local paths anyway)
+                    blob = _storage.read_bytes(tar_uri)
+                else:
+                    buf = BytesIO()
+                    with tarfile.open(fileobj=buf, mode="w") as tar:
+                        tar.add(self.path, arcname=".")
+                    blob = buf.getvalue()
             _storage.write_bytes(_storage.join(uri, "ckpt.tar"), blob)
             self.path = uri
             self._blob = None
@@ -104,6 +111,7 @@ class CheckpointManager:
     def __init__(self, storage_dir: str, num_to_keep: Optional[int] = None,
                  score_attribute: Optional[str] = None, order: str = "max"):
         from ray_tpu.util import storage as _storage
+        _storage.validate_root(storage_dir, "checkpoint")
         self.storage_dir = storage_dir
         self.num_to_keep = num_to_keep
         self.score_attribute = score_attribute
