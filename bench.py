@@ -354,70 +354,173 @@ def bench_train_step_mfu():
     return {"skipped": True, "reason": last}
 
 
-def main():
-    import ray_tpu
+_PHASE_A = [
+    ("single_client_put_calls_per_s", bench_puts),
+    ("single_client_get_calls_per_s", bench_gets),
+    ("single_client_put_gb_per_s", bench_put_bandwidth),
+    ("single_client_tasks_sync_per_s", bench_tasks_sync),
+    ("single_client_tasks_async_per_s", bench_tasks_async),
+    ("actor_calls_sync_1_1_per_s", bench_actor_sync),
+    ("actor_calls_async_1_1_per_s", bench_actor_async),
+    ("actor_calls_async_n_n_per_s", bench_actor_async_n_n),
+    ("wait_1k_refs_per_s", bench_wait_1k),
+]
+_PHASE_B = [
+    ("multi_client_tasks_async_per_s", bench_multi_client_tasks_async),
+    ("multi_client_put_gb_per_s", bench_multi_client_put_bandwidth),
+]
 
-    results = {}
-    # fake CPU count: the reference benches on a 64-core node; these are
-    # nop workloads measuring control-plane throughput, not compute
-    # auto-detected CPUs: on a many-core node the suite parallelizes like
-    # the reference's; on this 1-core bench box extra worker processes
-    # only thrash, so actors claim fractional CPUs instead
+
+def preflight_kill_strays():
+    """Round-4 lesson: leaked daemons from earlier runs contaminated the
+    official numbers (1.8x run-to-run spread on the headline). Reap
+    anything ray_tpu-shaped before measuring, and SAY so."""
+    import json as _json
+    import os
+    import signal
+    import subprocess
+    # spare a deliberately-detached cluster (ray_tpu start --head
+    # registers its session); everything else ray_tpu-shaped is a stray
+    keep_session = None
+    try:
+        with open("/tmp/raytpu/latest_head.json") as f:
+            keep_session = _json.load(f).get("session")
+    except (OSError, ValueError):
+        pass
+    out = subprocess.run(["ps", "-eo", "pid,args"], capture_output=True,
+                         text=True).stdout
+    strays = []
+    for line in out.splitlines():
+        parts = line.split(None, 1)
+        if len(parts) == 2 and "ray_tpu._private" in parts[1]:
+            if keep_session and keep_session in parts[1]:
+                continue
+            strays.append(int(parts[0]))
+    for pid in strays:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+    if strays:
+        log(f"preflight: killed {len(strays)} stray ray_tpu processes")
+        time.sleep(1.0)
+    return len(strays)
+
+
+def run_phase(phase: str):
+    """One repetition of one phase battery against a fresh cluster;
+    returns {key: raw_value}. Runs inside an isolated subprocess when
+    called via `bench.py --phase X` (each rep gets a clean interpreter,
+    clean shm arena, and its own daemon tree)."""
     import os
 
-    def _run(key, fn):
-        try:
-            v = fn(ray_tpu)
-            results[key] = {"value": round(v, 2),
-                            "vs_baseline": round(v / BASELINES[key], 3)}
-            log(f"{key}: {v:.1f} ({results[key]['vs_baseline']}x)")
-        except Exception as e:
-            log(f"{key} FAILED: {e}")
-            results[key] = {"value": 0.0, "vs_baseline": 0.0,
-                            "error": str(e)[:200]}
-
-    # phase A — single-client suite on a 1-logical-CPU head: extra
-    # worker processes only thrash the single physical core
-    ray_tpu.init(num_cpus=1, object_store_memory=512 * 1024 * 1024)
+    import ray_tpu
+    values = {}
+    if phase == "a":
+        # single-client suite on a 1-logical-CPU head: extra worker
+        # processes only thrash the single physical core
+        ray_tpu.init(num_cpus=1, object_store_memory=512 * 1024 * 1024)
+        battery = _PHASE_A
+    else:
+        # multi-client suite: logical CPUs >= 4 so the N driver processes
+        # run CONCURRENT workers like the reference's 64-core box. 1 GiB
+        # store: 4 putters x 4 kept 32 MiB refs is exactly 512 MiB, which
+        # would turn the put bench into a spill-thrash measurement
+        ray_tpu.init(num_cpus=max(4, os.cpu_count() or 1),
+                     object_store_memory=1024 * 1024 * 1024)
+        battery = _PHASE_B
     try:
-        for key, fn in [
-            ("single_client_put_calls_per_s", bench_puts),
-            ("single_client_get_calls_per_s", bench_gets),
-            ("single_client_put_gb_per_s", bench_put_bandwidth),
-            ("single_client_tasks_sync_per_s", bench_tasks_sync),
-            ("single_client_tasks_async_per_s", bench_tasks_async),
-            ("actor_calls_sync_1_1_per_s", bench_actor_sync),
-            ("actor_calls_async_1_1_per_s", bench_actor_async),
-            ("actor_calls_async_n_n_per_s", bench_actor_async_n_n),
-            ("wait_1k_refs_per_s", bench_wait_1k),
-        ]:
-            _run(key, fn)
+        for key, fn in battery:
+            try:
+                values[key] = fn(ray_tpu)
+                log(f"  {key}: {values[key]:.1f}")
+            except Exception as e:
+                log(f"  {key} FAILED: {e}")
+                values[key] = 0.0
     finally:
         ray_tpu.shutdown()
+    return values
 
-    # phase B — multi-client suite: logical CPUs >= 4 so the N driver
-    # processes run CONCURRENT workers like the reference's 64-core box.
-    # 1 GiB store: 4 putters x 4 kept 32 MiB refs is exactly 512 MiB,
-    # which turns the put bench into a spill-thrash measurement
-    ray_tpu.init(num_cpus=max(4, os.cpu_count() or 1),
-                 object_store_memory=1024 * 1024 * 1024)
+
+def _phase_in_subprocess(phase: str, reps: int = 3):
+    """reps isolated runs of a phase battery -> {key: [v, ...]}."""
+    import os
+    import subprocess
+    here = os.path.abspath(__file__)
+    series: dict = {}
+    for rep in range(reps):
+        log(f"phase {phase.upper()} rep {rep + 1}/{reps}")
+        try:
+            out = subprocess.run(
+                [sys.executable, here, "--phase", phase],
+                capture_output=True, text=True, timeout=1200)
+        except subprocess.TimeoutExpired:
+            log(f"phase {phase} rep {rep + 1} timed out (1200s); "
+                "reaping strays and continuing")
+            preflight_kill_strays()
+            continue
+        sys.stderr.write(out.stderr or "")
+        line = next((ln for ln in (out.stdout or "").splitlines()
+                     if ln.startswith("PHASE_RESULT ")), None)
+        if line is None:
+            log(f"phase {phase} rep {rep + 1} produced no result "
+                f"(rc={out.returncode})")
+            continue
+        for k, v in json.loads(line[len("PHASE_RESULT "):]).items():
+            series.setdefault(k, []).append(v)
+    # a phase whose every rep died must drag the headline down, not
+    # silently vanish from the artifact
+    expected = _PHASE_A if phase == "a" else _PHASE_B
+    for key, _fn in expected:
+        series.setdefault(key, [])
+    return series
+
+
+def _summarize(series: dict) -> dict:
+    """Per-metric median + relative spread ((max-min)/median) so the
+    artifact carries its own reproducibility evidence."""
+    results = {}
+    for key, vals in series.items():
+        vals = sorted(v for v in vals if v > 0)
+        if not vals:
+            results[key] = {"value": 0.0, "vs_baseline": 0.0,
+                            "error": "all reps failed"}
+            continue
+        med = vals[len(vals) // 2] if len(vals) % 2 \
+            else 0.5 * (vals[len(vals) // 2 - 1] + vals[len(vals) // 2])
+        spread = (vals[-1] - vals[0]) / med if med else 0.0
+        results[key] = {"value": round(med, 2),
+                        "spread": round(spread, 3),
+                        "runs": [round(v, 2) for v in vals]}
+        if key in BASELINES:
+            results[key]["vs_baseline"] = round(med / BASELINES[key], 3)
+        log(f"{key}: median {med:.1f} spread {spread:.1%} "
+            f"({results[key].get('vs_baseline', '-')}x)")
+    return results
+
+
+def main():
+    preflight_kill_strays()
+    results = {}
+    results.update(_summarize(_phase_in_subprocess("a")))
+    results.update(_summarize(_phase_in_subprocess("b")))
+
     try:
-        for key, fn in [
-            ("multi_client_tasks_async_per_s",
-             bench_multi_client_tasks_async),
-            ("multi_client_put_gb_per_s", bench_multi_client_put_bandwidth),
-        ]:
-            _run(key, fn)
+        import os as _os
+
+        import ray_tpu
+        ray_tpu.init(num_cpus=max(4, _os.cpu_count() or 1),
+                     object_store_memory=256 * 1024 * 1024)
         try:
             results["rl_ppo_env_steps_per_s"] = bench_rl_env_steps()
-            log(f"rl_ppo_env_steps_per_s: "
-                f"{results['rl_ppo_env_steps_per_s']['value']}")
-        except Exception as e:
-            log(f"rl_ppo_env_steps_per_s FAILED: {e}")
-            results["rl_ppo_env_steps_per_s"] = {"value": 0.0,
-                                                 "error": str(e)[:200]}
-    finally:
-        ray_tpu.shutdown()
+        finally:
+            ray_tpu.shutdown()
+        log(f"rl_ppo_env_steps_per_s: "
+            f"{results['rl_ppo_env_steps_per_s']['value']}")
+    except Exception as e:
+        log(f"rl_ppo_env_steps_per_s FAILED: {e}")
+        results["rl_ppo_env_steps_per_s"] = {"value": 0.0,
+                                             "error": str(e)[:200]}
 
     try:
         ceiling = bench_memcpy_ceiling()
@@ -429,6 +532,29 @@ def main():
             f"{results['memcpy_ceiling_gb_per_s']['put_efficiency']}")
     except Exception as e:
         log(f"memcpy ceiling probe failed: {e}")
+
+    # 1-core box-ceiling ratios (round-4 verdict #9): the reference's
+    # baseline ran on 64 cores; these ratios report each family against
+    # THIS box's own ceiling so the cross-box comparison stops hiding
+    # real signal. n:n async actors can at best match the box's 1:1
+    # async rate; puts can at best match warm memcpy.
+    try:
+        a11 = results["actor_calls_async_1_1_per_s"]["value"]
+        ann = results["actor_calls_async_n_n_per_s"]["value"]
+        if a11:
+            results["actor_calls_async_n_n_per_s"]["vs_box_ceiling"] = \
+                round(ann / a11, 3)
+        putv = results["single_client_put_gb_per_s"]["value"]
+        ceil = results.get("memcpy_ceiling_gb_per_s", {}).get("value")
+        if ceil:
+            results["single_client_put_gb_per_s"]["vs_box_ceiling"] = \
+                round(putv / ceil, 3)
+        log(f"box ceilings: n:n/1:1 async = "
+            f"{results['actor_calls_async_n_n_per_s'].get('vs_box_ceiling')}"
+            f", put/memcpy = "
+            f"{results['single_client_put_gb_per_s'].get('vs_box_ceiling')}")
+    except (KeyError, TypeError) as e:
+        log(f"box-ceiling ratios unavailable: {e}")
 
     try:
         mfu_res = bench_train_step_mfu()
@@ -463,4 +589,8 @@ def main():
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
+        print("PHASE_RESULT " + json.dumps(run_phase(sys.argv[2])),
+              flush=True)
+        sys.exit(0)
     sys.exit(main())

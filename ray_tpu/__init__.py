@@ -11,6 +11,7 @@ import atexit
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Union
 
+from ray_tpu._private.generator import ObjectRefGenerator
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.serialization import (ActorDiedError, ObjectLostError,
                                             TaskCancelledError, TaskError,
@@ -361,7 +362,8 @@ import ray_tpu.util as util  # noqa: E402  (public subpackage)
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "timeline", "get_actor", "nodes", "cluster_resources",
-    "available_resources", "ObjectRef", "ActorHandle", "ActorClass",
+    "available_resources", "ObjectRef", "ObjectRefGenerator",
+    "ActorHandle", "ActorClass",
     "RemoteFunction", "TaskError", "ActorDiedError", "ObjectLostError",
     "WorkerCrashedError", "TaskCancelledError", "util", "method",
     "get_runtime_context", "get_gcs_address",

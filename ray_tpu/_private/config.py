@@ -117,6 +117,16 @@ _flag("pull_inflight_bytes", int, 256 * 1024 * 1024,
 _flag("heartbeat_interval_s", float, 0.5,
       "Node manager -> GCS heartbeat period (also carries the resource "
       "view).")
+_flag("streaming_backpressure", int, 16,
+      "Max unconsumed items a streaming-generator task may have in "
+      "flight before the executor pauses the generator (reference: "
+      "_generator_backpressure_num_objects on ReportGeneratorItemReturns"
+      ", core_worker.proto:400).")
+_flag("gcs_reconnect_timeout_s", float, 60.0,
+      "How long a node manager keeps retrying an unreachable GCS before "
+      "giving up, reaping its workers, and exiting (reference: raylet "
+      "gcs_rpc_server_reconnect_timeout_s, src/ray/raylet/main.cc:123 "
+      "— round 4 leaked node managers retried forever).")
 _flag("view_refresh_s", float, 1.0,
       "Period for refreshing the cluster resource view used by spillback "
       "scheduling.")

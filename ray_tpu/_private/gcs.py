@@ -828,6 +828,8 @@ def _actor_public(row: Dict) -> Dict:
 def main():
     import argparse
     import sys
+    from ray_tpu._private.proc_util import set_pdeathsig_from_env
+    set_pdeathsig_from_env()
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--session-name", default="session")

@@ -29,15 +29,21 @@ class ProcessHandle:
 
 
 def _launch(cmd, keys, timeout=30.0, env=None,
-            log_path: Optional[str] = None) -> ProcessHandle:
+            log_path: Optional[str] = None,
+            detached: bool = False) -> ProcessHandle:
     """Start a daemon and read `KEY=value` announce lines from stdout.
     stderr goes to a session log file so daemons never hold the driver's
-    (or pytest's) pipes open."""
+    (or pytest's) pipes open. Unless detached, the child arms
+    PR_SET_PDEATHSIG so it dies with this process even on SIGKILL
+    (round-4 fix: daemons used to outlive crashed drivers forever)."""
     if log_path:
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
         errf = open(log_path, "ab")
     else:
         errf = subprocess.DEVNULL
+    if not detached:
+        from ray_tpu._private.proc_util import child_env
+        env = child_env(env)
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stdin=subprocess.DEVNULL, text=True, env=env,
                             stderr=errf, start_new_session=True)
@@ -107,16 +113,17 @@ def start_head(num_cpus: Optional[float] = None,
                object_store_memory: Optional[int] = None,
                labels: Optional[Dict[str, str]] = None,
                session_name: Optional[str] = None,
-               gcs_port: int = 0) -> LocalNode:
+               gcs_port: int = 0, detached: bool = False) -> LocalNode:
     session_name = session_name or f"s{uuid.uuid4().hex[:8]}"
     gcs = _launch([sys.executable, "-m", "ray_tpu._private.gcs",
                    "--port", str(gcs_port), "--session-name", session_name],
                   ["GCS_ADDRESS"],
-                  log_path=f"/tmp/raytpu/{session_name}/logs/gcs.err")
+                  log_path=f"/tmp/raytpu/{session_name}/logs/gcs.err",
+                  detached=detached)
     gcs_address = gcs.announced["GCS_ADDRESS"]
     node = start_node(gcs_address, num_cpus=num_cpus, resources=resources,
                       object_store_memory=object_store_memory, labels=labels,
-                      session_name=session_name)
+                      session_name=session_name, detached=detached)
     return LocalNode(gcs, node.nm_handle, gcs_address, session_name)
 
 
@@ -125,7 +132,8 @@ def start_node(gcs_address: str, num_cpus: Optional[float] = None,
                object_store_memory: Optional[int] = None,
                labels: Optional[Dict[str, str]] = None,
                session_name: str = "session",
-               gcs_address_source: Optional[str] = None) -> LocalNode:
+               gcs_address_source: Optional[str] = None,
+               detached: bool = False) -> LocalNode:
     res = dict(resources or {})
     if num_cpus is not None:
         res["CPU"] = float(num_cpus)
@@ -142,5 +150,6 @@ def start_node(gcs_address: str, num_cpus: Optional[float] = None,
     if object_store_memory:
         cmd += ["--store-bytes", str(int(object_store_memory))]
     nm = _launch(cmd, ["NODE_ADDRESS", "NODE_ID", "STORE_PATH"],
-                 log_path=f"/tmp/raytpu/{session_name}/logs/node_manager.err")
+                 log_path=f"/tmp/raytpu/{session_name}/logs/node_manager.err",
+                 detached=detached)
     return LocalNode(None, nm, gcs_address, session_name)

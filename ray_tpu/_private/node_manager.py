@@ -240,11 +240,11 @@ class NodeManager:
             pass
 
     def _kill_proc(self, w: WorkerProc):
+        # workers are session leaders (start_new_session): kill the whole
+        # group so user tasks' own subprocesses don't outlive the worker
         if w.proc is not None and w.proc.poll() is None:
-            try:
-                w.proc.kill()
-            except OSError:
-                pass
+            from ray_tpu._private.proc_util import kill_process_group
+            kill_process_group(w.proc)
 
     async def _heartbeat_loop(self):
         # the resource payload rides the heartbeat only when it CHANGED
@@ -252,18 +252,41 @@ class NodeManager:
         # pings (reference: versioned deltas over bidi streams instead of
         # full resource broadcast, ray_syncer.h:88)
         last_sent = None
+        down_since = None   # monotonic stamp of first failed contact
         while True:
             avail = self._reported_available()
             pending = list(self._pending_demand)
             payload = (avail, pending)
+            # explicit timeout: a silently-blackholed GCS connection
+            # (half-open TCP) must count toward the reconnect deadline
+            # the same as an erroring one
+            beat_timeout = max(10.0, cfg.heartbeat_interval_s * 10)
             try:
                 if payload == last_sent:
-                    await self.gcs.call("heartbeat", node_id=self.node_id)
+                    await self.gcs.call("heartbeat", node_id=self.node_id,
+                                        timeout=beat_timeout)
                 else:
                     await self.gcs.call("heartbeat", node_id=self.node_id,
-                                        available=avail, pending=pending)
+                                        available=avail, pending=pending,
+                                        timeout=beat_timeout)
                     last_sent = payload
-            except (rpc.RpcError, rpc.ConnectionLost):
+                down_since = None
+            except (rpc.RpcError, rpc.ConnectionLost, asyncio.TimeoutError):
+                now = time.monotonic()
+                if down_since is None:
+                    down_since = now
+                elif now - down_since > cfg.gcs_reconnect_timeout_s:
+                    # bounded retry, then die cleanly instead of spinning
+                    # forever as an orphan (reference: raylet exits after
+                    # gcs_rpc_server_reconnect_timeout_s, main.cc:123)
+                    logger.error(
+                        "GCS %s unreachable for %.0fs "
+                        "(> gcs_reconnect_timeout_s=%.0fs); shutting down",
+                        self.gcs_address, now - down_since,
+                        cfg.gcs_reconnect_timeout_s)
+                    for w in list(self.workers.values()):
+                        self._kill_proc(w)
+                    os._exit(1)
                 logger.warning("heartbeat failed; reconnecting to GCS")
                 last_sent = None
                 if self.gcs_address_source:
@@ -596,6 +619,10 @@ class NodeManager:
     def _spawn_worker(self) -> WorkerProc:
         env = dict(os.environ)
         env["RAY_TPU_NODE_ID"] = self.node_id
+        # a worker never outlives its node manager, detached cluster or
+        # not: arm parent-death SIGTERM regardless of how WE were started
+        from ray_tpu._private.proc_util import child_env
+        env = child_env(env)
         cmd = [sys.executable, "-m", "ray_tpu._private.worker_main",
                "--node-address", self.unix_address,
                "--gcs-address", self.gcs_address,
@@ -1453,10 +1480,15 @@ class NodeManager:
         return True
 
     def h_get_node_info(self, conn):
-        return {"node_id": self.node_id, "address": self.address,
+        info = {"node_id": self.node_id, "address": self.address,
                 "store_path": self.store_path, "total": self.total,
                 "available": self._reported_available(),
                 "num_workers": len(self.workers)}
+        if self.store is not None:
+            st = self.store.stats()
+            info["store"] = {"bytes_in_use": st["bytes_in_use"],
+                             "num_objects": st.get("num_objects")}
+        return info
 
 
 # thin aliases so the handler bodies read clearly
@@ -1482,6 +1514,8 @@ def scheduling_feasible_anywhere(view, resources, self_total):
 def main():
     import argparse
     import json
+    from ray_tpu._private.proc_util import set_pdeathsig_from_env
+    set_pdeathsig_from_env()
     parser = argparse.ArgumentParser()
     parser.add_argument("--gcs-address", required=True)
     parser.add_argument("--node-id", default=None)
@@ -1508,11 +1542,22 @@ def main():
         print(f"NODE_ADDRESS={addr}", flush=True)
         print(f"NODE_ID={nm.node_id}", flush=True)
         print(f"STORE_PATH={nm.store_path}", flush=True)
-        await asyncio.Event().wait()
+        # a terminated node manager must reap its workers (round-4 leak:
+        # default SIGTERM killed the nm mid-flight, orphaning the pool)
+        stop_evt = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        import signal as _signal
+        for s in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                loop.add_signal_handler(s, stop_evt.set)
+            except (NotImplementedError, OSError):
+                pass
+        await stop_evt.wait()
+        await asyncio.wait_for(nm.stop(), timeout=5)
 
     try:
         asyncio.run(run())
-    except KeyboardInterrupt:
+    except (KeyboardInterrupt, asyncio.TimeoutError):
         pass
 
 

@@ -175,6 +175,12 @@ class CoreWorker:
         # tasks
         self.pending_tasks: Dict[bytes, PendingTask] = {}
         self._task_counter = 0
+        # streaming generators: owner-side live generators by task id;
+        # executor-side flow-control windows by task id (+ tombstones for
+        # closes that raced ahead of execution)
+        self._generators: Dict[bytes, object] = {}
+        self._gen_flow: Dict[bytes, Dict] = {}
+        self._gen_tombstones: set = set()
         # LRU of live function objects (closures can capture large
         # arrays; evicted entries reload from _func_blobs / GCS KV)
         self._func_cache = __import__("collections").OrderedDict()
@@ -272,6 +278,9 @@ class CoreWorker:
         handlers = {
             "push_task": self.h_push_task,
             "push_tasks": self.h_push_tasks,
+            "push_task_streaming": self.h_push_task_streaming,
+            "generator_ack": self.h_generator_ack,
+            "generator_close": self.h_generator_close,
             "become_actor": self.h_become_actor,
             "wait_object": self.h_wait_object,
             "cancel_task": self.h_cancel_task,
@@ -1227,8 +1236,12 @@ class CoreWorker:
                                         cfg.task_push_batch)
                     my_grants = st["grants"]
                     batch = [st["queue"].popleft()]
-                    while st["queue"] and len(batch) < cur_batch:
-                        batch.append(st["queue"].popleft())
+                    # streaming tasks own their frame: the PARTIAL slots
+                    # of push_task_streaming carry items, not batch acks
+                    if not batch[0].spec.get("streaming"):
+                        while (st["queue"] and len(batch) < cur_batch
+                               and not st["queue"][0].spec.get("streaming")):
+                            batch.append(st["queue"].popleft())
                     st["busy"] += 1
                     # work remains behind us: make sure it isn't stuck
                     # waiting for this (possibly dependent) task
@@ -1302,7 +1315,27 @@ class CoreWorker:
                     pt.spec["accelerator_ids"] = lease.resource_ids
                 pt.current_worker = lease.worker_address
             conn = await self.pool.get(lease.worker_address)
-            if len(run) == 1:
+            if len(run) == 1 and run[0].spec.get("streaming"):
+                # streaming generator: PARTIALs are items; the lease is
+                # held (task running) until the final response
+                pt = run[0]
+                gen = self._generators.get(pt.spec["task_id"])
+                if gen is None:
+                    # closed before dispatch: don't run it at all
+                    self._fail_task(pt, TaskCancelledError(
+                        pt.spec.get("name", "stream")))
+                    self.pending_tasks.pop(pt.spec["task_id"], None)
+                    return True
+                gen._worker_address = lease.worker_address
+                resp = await conn.call_start_parts(
+                    "push_task_streaming", {"spec": pt.spec},
+                    functools.partial(self._on_gen_part, pt))
+                self._complete_task(pt, resp)
+                if gen is not None:
+                    gen._finish()
+                self._generators.pop(pt.spec["task_id"], None)
+                self.pending_tasks.pop(pt.spec["task_id"], None)
+            elif len(run) == 1:
                 resp = await conn.call("push_task", spec=run[0].spec)
                 self._complete_task(run[0], resp)
                 self.pending_tasks.pop(run[0].spec["task_id"], None)
@@ -1353,9 +1386,116 @@ class CoreWorker:
                 ev.set()
         self._unpin_args(pt)
 
+    # ------------------------------------------------ streaming generators
+    # (owner side: each PARTIAL from push_task_streaming materializes one
+    # brand-new owned object; consumption acks open the executor's window)
+
+    def _on_gen_part(self, pt: PendingTask, idx: int, ok: bool, payload):
+        gen = self._generators.get(pt.spec["task_id"])
+        if not ok:
+            if gen is not None:
+                gen._fail(RuntimeError(
+                    f"{payload[0]}: {payload[1]}"
+                    if isinstance(payload, list) else str(payload)))
+            return
+        if gen is None:
+            # stream closed while this item was in flight: registering
+            # it would leak an owned entry no ref can ever free
+            return
+        rid = ids.object_id_for_return(pt.spec["task_id"], 2 + idx)
+        self._register_owned(rid, complete=True)
+        entry = self.owned.get(rid)
+        if payload[0] == "wire":
+            self.memory_store[rid] = ("wire", payload[1], payload[2],
+                                      payload[3])
+        else:   # ["shm", node_id]
+            self.memory_store[rid] = ("loc", payload[1])
+            if entry is not None:
+                entry["location"] = payload[1]
+        if gen is not None:
+            gen._push(ObjectRef(rid, self.address))
+
+    def _gen_send_ack(self, gen) -> None:
+        """Consumption ack (loop side): opens the executor's in-flight
+        window. Fire-and-forget — a lost ack only delays the window until
+        the next one."""
+        if gen._worker_address is None or gen._done:
+            return
+        self._spawn(self._gen_ack_async(gen._worker_address,
+                                        gen._task_id, gen._consumed))
+
+    async def _gen_ack_async(self, address: str, task_id: bytes,
+                             consumed: int):
+        try:
+            conn = await self.pool.get(address)
+            conn.call_start_nowait("generator_ack",
+                                   {"task_id": task_id,
+                                    "consumed": consumed})
+        except Exception:
+            pass
+
+    async def _gen_close_async(self, gen):
+        """Consumer walked away: stop the producer, drop unconsumed
+        items (their owned entries free via normal refcounting once the
+        local refs die with the deque)."""
+        gen._finish()
+        gen._items.clear()
+        self._generators.pop(gen._task_id, None)
+        if gen._worker_address:
+            try:
+                conn = await self.pool.get(gen._worker_address)
+                conn.call_start_nowait("generator_close",
+                                       {"task_id": gen._task_id})
+            except Exception:
+                pass
+        else:
+            # not dispatched yet: cancel it in the queue (the dispatch
+            # paths also skip tasks whose generator is gone)
+            try:
+                await self.cancel_task_async(gen._completed_ref)
+            except Exception:
+                pass
+
+    def submit_streaming_task_threadsafe(
+            self, func, args, kwargs, resources=None, scheduling=None,
+            name=None, runtime_env=None, backpressure=None):
+        """num_returns='streaming' submission: returns an
+        ObjectRefGenerator instead of refs. Streaming tasks never retry
+        (stated divergence — see generator.py docstring)."""
+        from ray_tpu._private.generator import ObjectRefGenerator
+        spec, return_ids, arg_refs, refs = self._build_task_spec(
+            func, args, kwargs, 1, name)
+        spec["streaming"] = True
+        if backpressure:
+            spec["backpressure"] = int(backpressure)
+        gen = ObjectRefGenerator(self, spec["task_id"], refs[0])
+        self._generators[spec["task_id"]] = gen
+        self._enqueue_submit(
+            self._kickoff_task_submit, func, spec, return_ids, arg_refs,
+            resources, 0, scheduling, runtime_env)
+        return gen
+
+    def submit_streaming_actor_task_threadsafe(
+            self, actor_id: str, method: str, args, kwargs,
+            concurrency_group=None, backpressure=None):
+        from ray_tpu._private.generator import ObjectRefGenerator
+        spec, return_ids, arg_refs, refs = self._build_actor_task_spec(
+            actor_id, method, args, kwargs, 1, concurrency_group)
+        spec["streaming"] = True
+        if backpressure:
+            spec["backpressure"] = int(backpressure)
+        gen = ObjectRefGenerator(self, spec["task_id"], refs[0])
+        self._generators[spec["task_id"]] = gen
+        self._enqueue_submit(self._finish_actor_submit, spec, return_ids,
+                             arg_refs, 0)
+        return gen
+
     def _fail_task(self, pt: PendingTask, exc: BaseException):
         self._record_task_event(pt.spec["task_id"], "FAILED",
                                 error=f"{type(exc).__name__}: {exc}")
+        gen = self._generators.pop(pt.spec["task_id"], None)
+        if gen is not None:
+            gen._fail(exc)
         s = serialization.serialize_error(exc)
         kind, pkl, bufs = s.to_wire()
         for rid in pt.return_ids:
@@ -1735,11 +1875,25 @@ class CoreWorker:
                 # coarsens completion
                 batch = [pt]
                 while (not st.retry and st.pending
+                       and not pt.spec.get("streaming")
+                       and not st.pending[0].spec.get("streaming")
                        and len(batch) < cfg.actor_push_batch
                        and self._deps_ready(st.pending[0])):
                     batch.append(st.pending.popleft())
+                if pt.spec.get("streaming") \
+                        and pt.spec["task_id"] not in self._generators:
+                    # closed before dispatch: skip execution entirely
+                    self._fail_task(pt, TaskCancelledError(
+                        pt.spec.get("name", "stream")))
+                    break
                 try:
-                    if len(batch) == 1:
+                    if pt.spec.get("streaming"):
+                        gen = self._generators.get(pt.spec["task_id"])
+                        gen._worker_address = address
+                        fut = conn.call_start_parts(
+                            "push_task_streaming", {"spec": pt.spec},
+                            functools.partial(self._on_gen_part, pt))
+                    elif len(batch) == 1:
                         fut = conn.call_start_nowait("push_task",
                                                      {"spec": pt.spec})
                     else:
@@ -1822,6 +1976,9 @@ class CoreWorker:
         if exc is None:
             if len(batch) == 1 and not batch[0].done:
                 self._complete_task(batch[0], fut.result())
+                gen = self._generators.pop(batch[0].spec["task_id"], None)
+                if gen is not None:
+                    gen._finish()
             return   # batched calls completed via their PARTIALs
         pending = [pt for pt in batch if not pt.done]
         if not pending:
@@ -1914,6 +2071,139 @@ class CoreWorker:
             self._queue_for(spec).put_nowait((spec, fut))
 
     h_push_tasks.streaming = True
+
+    # ------------------------------------------------ streaming generators
+    # (executor side; reference: ReportGeneratorItemReturns,
+    # core_worker.proto:400 — here each yielded item is one PARTIAL frame
+    # on the push_task_streaming RPC itself)
+
+    def h_push_task_streaming(self, conn, seq, spec: Dict):
+        """Streaming task push: items flow back as PARTIALs as the
+        generator yields; the final RESPONSE carries the completion
+        sentinel for return_ids[0]."""
+        spec["_stream_out"] = (conn, seq)
+        fut = self.loop.create_future()
+
+        def done(f):
+            if f.cancelled():
+                conn._respond(seq, False, ("CancelledError", "cancelled", ""))
+            elif f.exception() is not None:
+                e = f.exception()
+                conn._respond(seq, False, (type(e).__name__, str(e), ""))
+            else:
+                conn.send_final(seq, f.result())
+        fut.add_done_callback(done)
+        self._queue_for(spec).put_nowait((spec, fut))
+
+    h_push_task_streaming.streaming = True
+
+    def h_generator_ack(self, conn, task_id: bytes, consumed: int):
+        st = self._gen_flow.get(task_id)
+        if st is not None:
+            st["acked"] = max(st["acked"], consumed)
+            st["event"].set()
+
+    def h_generator_close(self, conn, task_id: bytes):
+        st = self._gen_flow.get(task_id)
+        if st is not None:
+            st["closed"] = True
+            st["event"].set()
+        else:
+            # close raced ahead of execution (task still queued here):
+            # leave a tombstone so _execute_streaming exits immediately
+            # instead of producing into a window nobody will ever open
+            self._gen_tombstones.add(task_id)
+            while len(self._gen_tombstones) > 4096:
+                self._gen_tombstones.pop()
+        return True
+
+    async def _execute_streaming(self, spec: Dict, fn, args, kwargs) -> Dict:
+        """Drive a (sync or async) generator function, shipping each item
+        as its own owner-visible return object with bounded in-flight
+        items. Returns the final-response payload (the completion
+        sentinel: the item count)."""
+        conn, seq = spec.pop("_stream_out")
+        task_id = spec["task_id"]
+        limit = int(spec.get("backpressure")
+                    or cfg.streaming_backpressure)
+        closed_early = task_id in self._gen_tombstones
+        self._gen_tombstones.discard(task_id)
+        flow = {"acked": 0, "closed": closed_early,
+                "event": asyncio.Event()}
+        self._gen_flow[task_id] = flow
+        sent = 0
+        agen = sgen = None
+        try:
+            out = fn(*args, **kwargs)
+            if hasattr(out, "__anext__"):
+                agen = out
+            elif hasattr(out, "__next__"):
+                sgen = out
+            else:
+                raise TypeError(
+                    f"num_returns='streaming' task {spec.get('name')} "
+                    f"returned {type(out).__name__}, not a generator")
+            _SENTINEL = object()
+
+            def _next_sync():
+                try:
+                    return next(sgen)
+                except StopIteration:
+                    return _SENTINEL
+
+            while True:
+                # bounded in-flight window: wait for consumption acks
+                # (poll the connection so a dead consumer can't wedge
+                # this executor forever)
+                while (sent - flow["acked"] >= limit
+                       and not flow["closed"] and not conn.closed):
+                    flow["event"].clear()
+                    try:
+                        await asyncio.wait_for(flow["event"].wait(), 1.0)
+                    except asyncio.TimeoutError:
+                        pass
+                if flow["closed"] or conn.closed:
+                    break
+                if agen is not None:
+                    try:
+                        value = await agen.__anext__()
+                    except StopAsyncIteration:
+                        break
+                else:
+                    value = await self.loop.run_in_executor(
+                        self.executor, _next_sync)
+                    if value is _SENTINEL:
+                        break
+                rid = ids.object_id_for_return(task_id, 2 + sent)
+                conn.send_partial(seq, sent, True,
+                                  self._encode_return(rid, value))
+                sent += 1
+        except Exception as e:
+            # the error IS the next item: consumers hit it in stream
+            # order via get(ref) (reference: generator errors surface on
+            # the failing index's ref)
+            if not conn.closed:
+                s = serialization.serialize_error(e)
+                conn.send_partial(seq, sent, True,
+                                  ["wire"] + list(s.to_wire()))
+                sent += 1
+        finally:
+            self._gen_flow.pop(task_id, None)
+            for g in (agen, sgen):
+                if g is not None:
+                    try:
+                        closer = getattr(g, "aclose", None) \
+                            or getattr(g, "close", None)
+                        res = closer() if closer else None
+                        if asyncio.iscoroutine(res):
+                            await res
+                    except Exception:
+                        pass
+            self.current_task_name = None
+            self.current_task_id = None
+        return {"returns": [self._encode_return(spec["return_ids"][0],
+                                                sent)],
+                "n_items": sent}
 
     def h_cancel_task(self, conn, task_id: bytes, force: bool = False):
         """Cancel a queued (not yet started) task on this worker
@@ -2180,6 +2470,8 @@ class CoreWorker:
             fn = await self._load_function_any(spec)
         self.current_task_name = spec["name"]
         self.current_task_id = spec["task_id"]
+        if spec.get("streaming"):
+            return await self._execute_streaming(spec, fn, args, kwargs)
         if asyncio.iscoroutinefunction(getattr(fn, "__call__", fn)) or \
                 asyncio.iscoroutinefunction(fn):
             tok = _trace_ctx.set(trace_pair)
@@ -2491,6 +2783,15 @@ class Worker:
 
     def submit(self, func, args, kwargs, **opts) -> List[ObjectRef]:
         return self.core.submit_task_threadsafe(func, args, kwargs, **opts)
+
+    def submit_streaming(self, func, args, kwargs, **opts):
+        return self.core.submit_streaming_task_threadsafe(
+            func, args, kwargs, **opts)
+
+    def submit_actor_streaming(self, actor_id, method, args, kwargs,
+                               **opts):
+        return self.core.submit_streaming_actor_task_threadsafe(
+            actor_id, method, args, kwargs, **opts)
 
     def create_actor(self, cls, args, kwargs, **opts) -> str:
         return self._run(self.core.create_actor_async(cls, args, kwargs, **opts))

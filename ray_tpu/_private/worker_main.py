@@ -14,6 +14,8 @@ import sys
 
 
 def main():
+    from ray_tpu._private.proc_util import set_pdeathsig_from_env
+    set_pdeathsig_from_env()
     parser = argparse.ArgumentParser()
     parser.add_argument("--node-address", required=True)
     parser.add_argument("--gcs-address", required=True)

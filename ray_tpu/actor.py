@@ -30,15 +30,24 @@ class ActorMethod:
         return ClassMethodNode(self._handle, self._name, args, kwargs)
 
     def options(self, **opts):
-        method = ActorMethod(self._handle, self._name)
-        method._call_options = opts
-        parent = self
+        # a plain instance, NOT a class defined in this closure: the
+        # closure-class pattern forms a reference cycle (class -> method
+        # -> cell -> handle) that defers the owner handle's
+        # refcount-driven __del__ (= actor termination) to a gc pass
+        return _BoundActorMethod(self._handle, self._name, opts)
 
-        class _Bound:
-            def remote(self, *args, **kwargs):
-                merged = {**parent._handle._options, **opts}
-                return parent._handle._invoke(parent._name, args, kwargs, merged)
-        return _Bound()
+
+class _BoundActorMethod:
+    __slots__ = ("_handle", "_name", "_opts")
+
+    def __init__(self, handle, name, opts):
+        self._handle = handle
+        self._name = name
+        self._opts = opts
+
+    def remote(self, *args, **kwargs):
+        merged = {**self._handle._options, **self._opts}
+        return self._handle._invoke(self._name, args, kwargs, merged)
 
 
 class ActorHandle:
@@ -73,6 +82,11 @@ class ActorHandle:
         w = _get_worker()
         num_returns = opts.get("num_returns") \
             or opts.get("method_num_returns", {}).get(method, 1)
+        if num_returns == "streaming":
+            return w.submit_actor_streaming(
+                self._actor_id, method, args, kwargs,
+                concurrency_group=opts.get("concurrency_group"),
+                backpressure=opts.get("_generator_backpressure"))
         refs = w.submit_actor_task(
             self._actor_id, method, args, kwargs,
             num_returns=num_returns,

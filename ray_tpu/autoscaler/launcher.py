@@ -71,11 +71,12 @@ def load_config(path: str) -> Dict[str, Any]:
     return cfg
 
 
-def _make_provider(cfg: Dict, gcs_address: str):
+def _make_provider(cfg: Dict, gcs_address: str, detached: bool = False):
     prov = cfg["provider"]
     if prov["type"] == "fake":
         return FakeMultiNodeProvider(gcs_address,
-                                     session_name=cfg["cluster_name"])
+                                     session_name=cfg["cluster_name"],
+                                     detached=detached)
     kw = {}
     types = cfg["available_node_types"]
     slice_types = [nt.get("tpu_accelerator_type")
@@ -121,16 +122,22 @@ class ClusterHandle:
             pass
 
 
-def up(config_path: str, start_autoscaler: bool = True) -> ClusterHandle:
-    """Bring the cluster up: head + min_workers + reconciler."""
+def up(config_path: str, start_autoscaler: bool = True,
+       detached: bool = False) -> ClusterHandle:
+    """Bring the cluster up: head + min_workers + reconciler.
+
+    detached=True (the `ray_tpu up` CLI) lets the cluster outlive this
+    process; the default ties daemon lifetimes to the caller via
+    PDEATHSIG, which is what tests want."""
     from ray_tpu._private import node as node_mod
 
     cfg = load_config(config_path)
     head_cfg = cfg["head"]
     head = node_mod.start_head(
         num_cpus=head_cfg.get("num_cpus", 1),
-        resources=dict(head_cfg.get("resources") or {}))
-    provider = _make_provider(cfg, head.gcs_address)
+        resources=dict(head_cfg.get("resources") or {}),
+        detached=detached)
+    provider = _make_provider(cfg, head.gcs_address, detached=detached)
     for name, nt in cfg["available_node_types"].items():
         for _ in range(int(nt["min_workers"])):
             provider.create_node(name, dict(nt["resources"]),

@@ -24,9 +24,11 @@ class NodeProvider:
 class FakeMultiNodeProvider(NodeProvider):
     """Launches local node managers against the current GCS."""
 
-    def __init__(self, gcs_address: str, session_name: str = "fake"):
+    def __init__(self, gcs_address: str, session_name: str = "fake",
+                 detached: bool = False):
         self.gcs_address = gcs_address
         self.session_name = session_name
+        self.detached = detached
         self.nodes: Dict[str, object] = {}
 
     def create_node(self, node_type: str, resources: Dict[str, float],
@@ -38,7 +40,8 @@ class FakeMultiNodeProvider(NodeProvider):
             self.gcs_address, num_cpus=num_cpus, resources=res,
             labels={**labels, "node_type": node_type},
             session_name=self.session_name,
-            object_store_memory=64 * 1024 * 1024)
+            object_store_memory=64 * 1024 * 1024,
+            detached=self.detached)
         self.nodes[ln.node_id] = ln
         return ln.node_id
 

@@ -72,6 +72,17 @@ class RemoteFunction:
         w = _get_worker()
         opts = self._options
         num_returns = opts.get("num_returns", 1)
+        if num_returns == "streaming":
+            # -> ObjectRefGenerator (reference: _raylet.pyx:281)
+            return w.submit_streaming(
+                self._function, args, kwargs,
+                resources=_resources_from_options(opts),
+                scheduling=_scheduling_from_options(opts),
+                name=opts.get("name") or getattr(
+                    self._function, "__name__",
+                    type(self._function).__name__),
+                runtime_env=opts.get("runtime_env"),
+                backpressure=opts.get("_generator_backpressure"))
         refs = w.submit(
             self._function, args, kwargs,
             num_returns=num_returns,

@@ -37,7 +37,8 @@ def cmd_start(args):
         head = node_mod.start_head(
             num_cpus=args.num_cpus,
             resources=json.loads(args.resources),
-            object_store_memory=args.object_store_memory or None)
+            object_store_memory=args.object_store_memory or None,
+            detached=True)
         _save_head({"gcs_address": head.gcs_address,
                     "node_id": head.node_id,
                     "session": head.session_name})
@@ -55,7 +56,8 @@ def cmd_start(args):
             addr, num_cpus=args.num_cpus,
             resources=json.loads(args.resources),
             labels=json.loads(args.labels),
-            object_store_memory=args.object_store_memory or None)
+            object_store_memory=args.object_store_memory or None,
+            detached=True)
         print(f"node {node.node_id[:12]} joined {addr}")
 
 
@@ -104,7 +106,9 @@ def cmd_status(args):
 
 def cmd_up(args):
     from ray_tpu.autoscaler import launcher
-    handle = launcher.up(args.config)
+    # --block keeps this CLI alive as the cluster's supervisor; without
+    # it the cluster must outlive the CLI (no PDEATHSIG)
+    handle = launcher.up(args.config, detached=not args.block)
     print(f"cluster {handle.config['cluster_name']} up; "
           f"GCS at {handle.gcs_address}")
     print(f"connect with: ray_tpu.init(address={handle.gcs_address!r})")

@@ -26,6 +26,15 @@ try:
 except Exception:   # backend already initialized (env vars took effect)
     pass
 
+import uuid  # noqa: E402
+
+# Every daemon spawned during this pytest session inherits this marker in
+# its environment; the suite-final hygiene check (test_zz_process_hygiene)
+# scans /proc for survivors carrying it and fails the run if any daemon
+# outlived its test (round-4 audit: 131 leaked processes after a green
+# suite).
+os.environ.setdefault("RAY_TPU_TEST_SESSION", uuid.uuid4().hex)
+
 import pytest  # noqa: E402
 
 # Two tiers (suite wall-clock grows ~6 min/round; the full matrix is for

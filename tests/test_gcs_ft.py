@@ -24,10 +24,15 @@ def _free_port():
 
 
 def _spawn_gcs(port, persist, session):
+    # child_env arms PDEATHSIG: a restarted GCS dies with this pytest
+    # process even if the test aborts before its finally/fixture teardown
+    # (round-4 leak: test_gcs_ft GCS processes survived for hours)
+    from ray_tpu._private.proc_util import child_env
     proc = subprocess.Popen(
         [sys.executable, "-m", "ray_tpu._private.gcs", "--port", str(port),
          "--session-name", session, "--persist-path", persist],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=child_env())
     deadline = time.time() + 30
     while time.time() < deadline:
         line = proc.stdout.readline()

@@ -1,0 +1,138 @@
+"""Daemon lifetime invariants (round-4 fix: 131 processes survived a
+green suite).
+
+Three layers under test:
+1. PR_SET_PDEATHSIG — a SIGKILLed driver reaps its GCS + node manager +
+   workers (reference: worker processes die with the raylet via the
+   socket + the raylet dies with the GCS via
+   gcs_rpc_server_reconnect_timeout_s).
+2. SIGTERM on a node manager reaps its worker pool before exiting
+   (reference: NodeManager::Stop kills registered workers).
+3. A node manager whose GCS stays unreachable past
+   cfg.gcs_reconnect_timeout_s exits instead of retrying forever
+   (reference: src/ray/raylet/main.cc:123 shutdown path).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+
+def _pids_alive(pids):
+    """Live (non-zombie) pids. A daemon our own process spawned shows up
+    as a zombie until wait()ed — that's 'exited' for lifetime purposes."""
+    out = []
+    for p in pids:
+        try:
+            with open(f"/proc/{p}/stat") as f:
+                state = f.read().rsplit(")", 1)[1].split()[0]
+            if state != "Z":
+                out.append(p)
+        except OSError:
+            pass
+    return out
+
+
+def _wait_gone(pids, timeout=20):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        alive = _pids_alive(pids)
+        if not alive:
+            return []
+        time.sleep(0.25)
+    return alive
+
+
+DRIVER = textwrap.dedent("""
+    import os, sys, time
+    import ray_tpu
+    ray_tpu.init(num_cpus=1, object_store_memory=32*1024*1024)
+
+    @ray_tpu.remote
+    def pid():
+        return os.getpid()
+
+    wpid = ray_tpu.get(pid.remote(), timeout=60)
+    node = ray_tpu._context.node            # LocalNode handle
+    print("GCS_PID", node.gcs_handle.proc.pid, flush=True)
+    print("NM_PID", node.nm_handle.proc.pid, flush=True)
+    print("W_PID", wpid, flush=True)
+    print("READY", flush=True)
+    time.sleep(600)
+""")
+
+
+def test_sigkilled_driver_reaps_whole_tree():
+    proc = subprocess.Popen([sys.executable, "-c", DRIVER],
+                            stdout=subprocess.PIPE, text=True)
+    pids = {}
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        parts = line.split()
+        if len(parts) == 2 and parts[0].endswith("_PID"):
+            pids[parts[0]] = int(parts[1])
+        if line.startswith("READY"):
+            break
+    assert len(pids) == 3, f"driver never announced: {pids}"
+    assert set(_pids_alive(pids.values())) == set(pids.values())
+    proc.kill()                      # SIGKILL: no cleanup code runs
+    proc.wait()
+    leftovers = _wait_gone(list(pids.values()))
+    assert not leftovers, \
+        f"daemons outlived a SIGKILLed driver: {leftovers} of {pids}"
+
+
+def test_sigterm_node_manager_reaps_workers():
+    import ray_tpu
+    ray_tpu.init(num_cpus=1, object_store_memory=32 * 1024 * 1024)
+    try:
+        @ray_tpu.remote
+        def pid():
+            return os.getpid()
+
+        wpid = ray_tpu.get(pid.remote(), timeout=60)
+        nm_pid = ray_tpu._context.node.nm_handle.proc.pid
+        os.kill(nm_pid, signal.SIGTERM)
+        leftovers = _wait_gone([nm_pid, wpid])
+        assert not leftovers, f"SIGTERMed nm left {leftovers} alive"
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.slow
+def test_node_manager_exits_when_gcs_stays_dead(tmp_path):
+    """With gcs_reconnect_timeout_s=3, a node manager whose GCS was
+    SIGKILLed must exit on its own within the timeout + slack, taking
+    its workers along."""
+    env = dict(os.environ)
+    env["RAY_TPU_GCS_RECONNECT_TIMEOUT_S"] = "3"
+    proc = subprocess.Popen([sys.executable, "-c", DRIVER],
+                            stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        pids = {}
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            parts = line.split()
+            if len(parts) == 2 and parts[0].endswith("_PID"):
+                pids[parts[0]] = int(parts[1])
+            if line.startswith("READY"):
+                break
+        assert len(pids) == 3
+        os.kill(pids["GCS_PID"], signal.SIGKILL)
+        leftovers = _wait_gone([pids["NM_PID"], pids["W_PID"]], timeout=30)
+        assert not leftovers, \
+            f"nm/worker kept running with a dead GCS: {leftovers}"
+    finally:
+        proc.kill()
+        proc.wait()
