@@ -91,6 +91,13 @@ def _apply_one(fn_kind: str, fn, block, batch_format: str,
     if fn_kind == "map_batches":
         batch = B.block_to_batch(block, batch_format)
         out = fn(batch, *fn_args, **(fn_kwargs or {}))
+        if hasattr(out, "__next__"):
+            raise TypeError(
+                "map_batches UDF returned a generator from a "
+                "non-generator callable; declare it as a generator "
+                "FUNCTION (def f(batch): yield ...) so the stage streams "
+                "its chunks — wrapping one in a lambda hides it from "
+                "streaming detection")
         return B.block_from_batch(out)
     if fn_kind == "map":
         return B.block_from_rows(
@@ -120,6 +127,73 @@ def _map_block_remote(ops, block):
         block = _apply_one(fn_kind, fn, block, batch_format,
                            fn_args, fn_kwargs)
     return block, B.block_metadata(block)
+
+
+def _iter_chain_blocks(ops, block, i=0):
+    """Apply ops[i:] to one block, yielding OUTPUT blocks: a map_batches
+    UDF that returns a generator fans one input block out into many
+    output blocks, each flowing through the remaining fused ops
+    independently (reference: generator-UDF map tasks stream blocks via
+    streaming generators instead of buffering the whole expansion,
+    _internal/execution/operators/map_transformer.py)."""
+    from ray_tpu.data import block as B
+    if i == len(ops):
+        yield block
+        return
+    fn_kind, fn, batch_format, fn_args, fn_kwargs = ops[i]
+    if fn_kind == "map_batches":
+        batch = B.block_to_batch(block, batch_format)
+        out = fn(batch, *fn_args, **(fn_kwargs or {}))
+        if hasattr(out, "__next__"):    # generator UDF: stream chunks
+            for chunk in out:
+                yield from _iter_chain_blocks(
+                    ops, B.block_from_batch(chunk), i + 1)
+            return
+        yield from _iter_chain_blocks(ops, B.block_from_batch(out), i + 1)
+        return
+    yield from _iter_chain_blocks(
+        ops, _apply_one(fn_kind, fn, block, batch_format,
+                        fn_args, fn_kwargs), i + 1)
+
+
+def _map_block_stream_remote(ops, block):
+    """Streaming-generator map task: yields (block, metadata) as
+    alternating items so the driver can read the small metadata without
+    ever pulling block bytes (block item stays in the executor node's
+    store; the consumer holds only its ref)."""
+    from ray_tpu.data import block as B
+    for out in _iter_chain_blocks(ops, block):
+        yield out
+        yield B.block_metadata(out)
+
+
+def _read_blocks_stream(fn):
+    """Streaming read task: a datasource fn marked yields_blocks
+    produces blocks incrementally (e.g. one parquet row group at a
+    time); backpressure keeps at most K unconsumed blocks alive instead
+    of buffering the whole file."""
+    from ray_tpu.data import block as B
+    for blk in fn():
+        yield blk
+        yield B.block_metadata(blk)
+
+
+def _drain_pair_stream(gen):
+    """Consume a (block, meta, block, meta, ...) item stream into
+    (block_ref, meta) bundles, fetching only the metadata items. A
+    mid-stream task error arrives as a lone trailing item: resolving it
+    re-raises the executor's exception."""
+    while True:
+        try:
+            block_ref = next(gen)
+        except StopIteration:
+            return
+        try:
+            meta_ref = next(gen)
+        except StopIteration:
+            ray_tpu.get(block_ref)   # lone item == the error; raises
+            return
+        yield (block_ref, ray_tpu.get(meta_ref))
 
 
 class Stage:
@@ -154,9 +228,14 @@ class ReadStage(Stage):
 
     def execute(self, upstream, budget=None):
         # two returns: the block ref is yielded WITHOUT fetching its bytes
-        # to the driver; only the small metadata ref is materialized
+        # to the driver; only the small metadata ref is materialized.
+        # Datasource fns marked yields_blocks run as streaming-generator
+        # tasks instead: one task emits many blocks with bounded
+        # buffering (reference: streaming reads over file fragments)
         remote_read = ray_tpu.remote(num_returns=2)(
             lambda fn: _with_meta(fn()))
+        remote_read_stream = ray_tpu.remote(
+            num_returns="streaming")(_read_blocks_stream)
         window = collections.deque()
         fns = iter(self.read_fns)
         exhausted = False
@@ -171,13 +250,21 @@ class ReadStage(Stage):
                         budget.release(self.EST_READ_BYTES)
                     exhausted = True
                     break
-                window.append(remote_read.remote(fn))
+                if getattr(fn, "yields_blocks", False):
+                    window.append(("stream",
+                                   remote_read_stream.remote(fn)))
+                else:
+                    window.append(("task", remote_read.remote(fn)))
             if not window:
                 return
-            block_ref, meta_ref = window.popleft()
+            kind, handle = window.popleft()
             if budget is not None:
                 budget.release(self.EST_READ_BYTES)
-            yield (block_ref, ray_tpu.get(meta_ref))
+            if kind == "stream":
+                yield from _drain_pair_stream(handle)
+            else:
+                block_ref, meta_ref = handle
+                yield (block_ref, ray_tpu.get(meta_ref))
 
 
 def _with_meta(block):
@@ -192,11 +279,16 @@ class MapStage(Stage):
                  fn_args=(), fn_kwargs=None, max_in_flight: int = None,
                  concurrency: Optional[int] = None,
                  num_cpus: Optional[float] = None):
+        import inspect
         self.ops = [(fn_kind, fn, batch_format, fn_args, fn_kwargs)]
         self.concurrency = concurrency
         self.num_cpus = num_cpus
         self.max_in_flight = (concurrency or max_in_flight
                               or DEFAULT_MAX_IN_FLIGHT)
+        # generator UDF (yields output batches): run the block task as a
+        # streaming generator so chunks flow out with bounded buffering
+        self.streaming = (fn_kind == "map_batches"
+                          and inspect.isgeneratorfunction(fn))
 
     @property
     def name(self) -> str:
@@ -215,13 +307,18 @@ class MapStage(Stage):
                         if a.num_cpus and b.num_cpus
                         else a.num_cpus or b.num_cpus)
         out.max_in_flight = min(a.max_in_flight, b.max_in_flight)
+        out.streaming = a.streaming or b.streaming
         return out
 
     def execute(self, upstream, budget=None):
         opts = {"num_returns": 2}
         if self.num_cpus is not None:
             opts["num_cpus"] = self.num_cpus
-        remote_map = ray_tpu.remote(**opts)(_map_block_remote)
+        if self.streaming:
+            s_opts = dict(opts, num_returns="streaming")
+            remote_map = ray_tpu.remote(**s_opts)(_map_block_stream_remote)
+        else:
+            remote_map = ray_tpu.remote(**opts)(_map_block_remote)
         window = collections.deque()
         upstream = iter(upstream)
         exhausted = False
@@ -247,12 +344,18 @@ class MapStage(Stage):
                 window.append((remote_map.remote(self.ops, ref), est))
             if not window:
                 return
-            (block_ref, meta_ref), est = window.popleft()
+            handle, est = window.popleft()
             if budget is not None:
                 budget.release(est)
-            # block until this output's metadata is ready (keeps order;
-            # later tasks keep running in the window); bytes stay put
-            yield (block_ref, ray_tpu.get(meta_ref))
+            if self.streaming:
+                # one input block -> a stream of output bundles
+                yield from _drain_pair_stream(handle)
+            else:
+                block_ref, meta_ref = handle
+                # block until this output's metadata is ready (keeps
+                # order; later tasks keep running in the window); bytes
+                # stay put
+                yield (block_ref, ray_tpu.get(meta_ref))
 
 
 class ActorPoolMapStage(Stage):

@@ -93,8 +93,22 @@ def read_parquet(paths, **kwargs) -> Dataset:
 
     def make(f):
         def read():
+            # one block per row group, streamed: a multi-row-group file
+            # never buffers whole in the read worker (the streaming
+            # generator's backpressure caps unconsumed blocks; reference:
+            # fragment-level parquet reads,
+            # _internal/datasource/parquet_datasource.py)
             import pyarrow.parquet as pq
-            return pq.read_table(f)
+            pf = pq.ParquetFile(f)
+            if pf.metadata.num_row_groups <= 1:
+                yield pf.read()
+            else:
+                # NB: builtins.range — this module defines its own
+                # Dataset-returning `range`
+                import builtins
+                for g in builtins.range(pf.metadata.num_row_groups):
+                    yield pf.read_row_group(g)
+        read.yields_blocks = True
         return read
 
     return Dataset([exe.ReadStage([make(f) for f in files])])

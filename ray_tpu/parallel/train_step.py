@@ -132,6 +132,11 @@ def chunked_cross_entropy(h, unembed, targets, mask=None, chunk=256):
     exists in HBM.
 
     h: [B,L,d] final hidden states; unembed: [d,V]."""
+    # pin h to the canonical activation layout at this boundary: the
+    # unembed einsum's preferred layout (d over tensor) otherwise
+    # propagates backward into the layer-scan while-loop carry and GSPMD
+    # bridges the mismatch with an involuntary full rematerialization
+    h = sharding_lib.constrain(h, ("batch", "seq", None))
     B, L, d = h.shape
     chunk = min(chunk, L)
     pad = (-L) % chunk
@@ -248,6 +253,14 @@ def make_train_fns(model: nn.Module, optimizer,
         with use_mesh(mesh):
             (loss, (denom, ce, aux)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state.params, tokens, mask)
+            # pin gradient shardings to the parameter shardings: without
+            # this, GSPMD picks its own layout for the scanned-layer grad
+            # accumulator inside the backward while-loop and then bridges
+            # to the optimizer's layout via an involuntary full
+            # rematerialization (a per-step all-gather of the stacked
+            # grads — round-4 verdict weak #5)
+            grads = jax.lax.with_sharding_constraint(
+                grads, shardings.params)
         updates, new_opt = optimizer.update(grads, state.opt_state,
                                             params=state.params)
         new_params = optax.apply_updates(state.params, updates)

@@ -70,39 +70,43 @@ class DeploymentResponse:
 
 
 class DeploymentResponseGenerator:
-    """Iterator over a streaming deployment call (reference:
-    DeploymentResponseGenerator, serve/handle.py)."""
+    """Iterator over a streaming deployment call: a thin value-fetching
+    wrapper around the core ObjectRefGenerator — chunks arrive as the
+    replica's generator yields, with the core protocol's backpressure
+    (round-5; reference: DeploymentResponseGenerator, serve/handle.py)."""
 
-    def __init__(self, replica, stream_id: str, router, replica_idx):
-        self._replica = replica
-        self._sid = stream_id
+    def __init__(self, ref_gen, router, replica_idx):
+        self._gen = ref_gen
         self._router = router
         self._idx = replica_idx
-        self._buf: List = []
-        self._done = False
-        self._error: Optional[str] = None
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        while not self._buf:
-            if self._done:
-                self._settle()
-                if self._error:
-                    raise RuntimeError(f"stream failed: {self._error}")
-                raise StopIteration
-            chunks, done, error = ray_tpu.get(
-                self._replica.stream_next.remote(self._sid), timeout=60)
-            self._buf.extend(chunks)
-            self._done = done
-            self._error = error
-        return self._buf.pop(0)
+        try:
+            # 60s liveness bound: a replica generator wedged in user
+            # code surfaces a TimeoutError instead of hanging the caller
+            ref = self._gen.next(timeout=60)
+        except StopIteration:
+            self._settle()
+            raise
+        except Exception:
+            self._settle()
+            raise
+        try:
+            return ray_tpu.get(ref, timeout=60)
+        except Exception:
+            self._settle()
+            raise
 
     def _settle(self):
         if self._router is not None:
             self._router._dec(self._idx)
             self._router = None
+
+    def __del__(self):
+        self._settle()
 
 
 class _LongPollClient:
@@ -275,10 +279,11 @@ class DeploymentHandle:
             idx, replica = self._router.pick(model_id)
             try:
                 if stream:
-                    sid = ray_tpu.get(replica.start_stream.remote(
-                        method, args, kwargs), timeout=60)
+                    ref_gen = replica.handle_stream.options(
+                        num_returns="streaming").remote(
+                            method, args, kwargs)
                     return DeploymentResponseGenerator(
-                        replica, sid, self._router, idx)
+                        ref_gen, self._router, idx)
                 ref = replica.handle_request.remote(method, args, kwargs)
                 # one resubmit only: the retried response carries NO
                 # further resubmit, so a crash loop surfaces instead of

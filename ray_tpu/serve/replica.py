@@ -68,66 +68,42 @@ class Replica:
                 self._ongoing -= 1
 
     # ------------------------------------------------------------ streaming
-    def start_stream(self, method: str, args: Tuple, kwargs: Dict) -> str:
-        """Run a generator method; chunks buffer server-side and drain via
-        stream_next (reference: streaming DeploymentResponseGenerator,
-        serve/handle.py — there gRPC streaming, here chunked polls)."""
-        import queue
-        import threading
-        import uuid
+    def handle_stream(self, method: str, args: Tuple, kwargs: Dict):
+        """Generator method invoked with num_returns='streaming': each
+        yielded chunk becomes one item on the caller's
+        ObjectRefGenerator, riding the core streaming-generator protocol
+        (round-5; replaces the round-4 bespoke start_stream/stream_next
+        polling. Reference: streaming DeploymentResponseGenerator over
+        ObjectRefGenerator, serve/handle.py)."""
+        from ray_tpu.serve import multiplex
         model_id = kwargs.pop("__serve_model_id", "")
-        sid = uuid.uuid4().hex
-        q: "queue.Queue" = queue.Queue()
-        if not hasattr(self, "_streams"):
-            self._streams = {}
-        self._streams[sid] = q
-
-        def run():
-            from ray_tpu.serve import multiplex
+        with self._lock:
+            self._ongoing += 1
+        try:
+            fn = self._callable if self._is_function \
+                else getattr(self._callable, method)
+            # the streaming executor resumes each next() on whatever
+            # pool thread is free: set/reset the multiplex contextvar
+            # WITHIN each resumption (a token created on one thread
+            # cannot be reset on another, and a cross-thread reset in a
+            # finally would leak the _ongoing decrement below)
             tok = multiplex._set_model_id(model_id)
             try:
-                fn = self._callable if self._is_function \
-                    else getattr(self._callable, method)
-                out = fn(*args, **kwargs)
-                for chunk in out:
-                    q.put(("chunk", chunk))
-                q.put(("done", None))
-            except BaseException as e:
-                q.put(("error", f"{type(e).__name__}: {e}"))
+                it = iter(fn(*args, **kwargs))
             finally:
                 multiplex._current_model_id.reset(tok)
-
-        threading.Thread(target=run, daemon=True).start()
-        return sid
-
-    def stream_next(self, stream_id: str, max_n: int = 64,
-                    timeout: float = 10.0):
-        """Returns (chunks, done, error)."""
-        import queue
-        q = self._streams.get(stream_id)
-        if q is None:
-            return [], True, "unknown stream"
-        chunks = []
-        done = False
-        error = None
-        try:
-            kind, payload = q.get(timeout=timeout)
             while True:
-                if kind == "chunk":
-                    chunks.append(payload)
-                elif kind == "done":
-                    done = True
-                else:
-                    error = payload
-                    done = True
-                if done or len(chunks) >= max_n:
+                tok = multiplex._set_model_id(model_id)
+                try:
+                    chunk = next(it)
+                except StopIteration:
                     break
-                kind, payload = q.get_nowait()
-        except queue.Empty:
-            pass
-        if done:
-            self._streams.pop(stream_id, None)
-        return chunks, done, error
+                finally:
+                    multiplex._current_model_id.reset(tok)
+                yield chunk
+        finally:
+            with self._lock:
+                self._ongoing -= 1
 
     def get_queue_len(self) -> int:
         return self._ongoing

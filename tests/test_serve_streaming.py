@@ -1,6 +1,8 @@
 """Streaming deployment responses (reference: serve streaming handles —
-DeploymentResponseGenerator): generator methods stream chunks through
-chunked polls; errors mid-stream surface to the consumer."""
+DeploymentResponseGenerator): generator methods stream chunks over the
+core streaming-generator protocol (ObjectRefGenerator items with
+backpressure); errors mid-stream surface to the consumer with their
+original type."""
 
 import pytest
 
@@ -36,7 +38,7 @@ def test_streaming_handle(ray_start):
 
     gen = h.fail_midway.remote(10)
     got = []
-    with pytest.raises(RuntimeError, match="midstream boom"):
+    with pytest.raises(ValueError, match="midstream boom"):
         for c in gen:
             got.append(c)
     assert got == [0, 1, 2]
