@@ -288,3 +288,62 @@ def make_train_fns(model: nn.Module, optimizer,
             return init_fn(rng)
 
     return init_with_mesh, step_with_mesh, shardings
+
+
+def make_infer_fns(model: nn.Module, mesh: Mesh, rules=None,
+                   batch_shape: Tuple[int, int] = (8, 128),
+                   ) -> Tuple[Callable, Callable, Any]:
+    """Serving-side counterpart of make_train_fns: (init_fn(rng) ->
+    params, infer_fn(params, tokens) -> last-position logits,
+    param_sharding_tree), both jitted with explicit shardings over
+    `mesh`. Params shard per the megatron rule table (tensor/fsdp axes),
+    the batch over the data axes, and logits come back replicated —
+    the shape a sharded serve replica group runs per request
+    (serve/sharded_replica.py; reference has no TPU counterpart).
+    Logits are computed at the LAST position only: that is the decode
+    shape, and it keeps the unembed matmul at [B, d]·[d, V] instead of
+    materializing [B, L, V]."""
+    rules = rules or sharding_lib.DEFAULT_RULES
+    tokens0 = jnp.zeros(batch_shape, jnp.int32)
+
+    def init_params(rng):
+        return model.init(rng, tokens0)["params"]
+
+    abstract = jax.eval_shape(init_params, jax.random.PRNGKey(0))
+    shardings = state_shardings(abstract, mesh, rules)
+    batch_sharding = NamedSharding(
+        mesh, _prune_indivisible(
+            logical_pspec_to_mesh(P("batch", "seq"), rules),
+            batch_shape, mesh))
+    init_fn = jax.jit(init_params, out_shardings=shardings)
+
+    model_cfg = getattr(model, "cfg", None)
+    tied = bool(getattr(model_cfg, "tie_embeddings", False))
+
+    def _unembed_of(params):
+        raw = params["embed"] if tied else params["unembed"]
+        v = raw.unbox() if hasattr(raw, "unbox") else raw
+        v = v.astype(getattr(model_cfg, "dtype", v.dtype))
+        return v.T if tied else v
+
+    def forward(params, tokens):
+        is_moe = bool(getattr(model_cfg, "n_experts", 0))
+        kw = {"mutable": ["losses"]} if is_moe else {}
+        out = model.apply({"params": params}, tokens,
+                          return_hidden=True, **kw)
+        h = out[0] if is_moe else out
+        return h[:, -1, :] @ _unembed_of(params)
+
+    jit_fwd = jax.jit(forward,
+                      in_shardings=(shardings, batch_sharding),
+                      out_shardings=NamedSharding(mesh, P()))
+
+    def infer_with_mesh(params, tokens):
+        with use_mesh(mesh):
+            return jit_fwd(params, tokens)
+
+    def init_with_mesh(rng):
+        with use_mesh(mesh):
+            return init_fn(rng)
+
+    return init_with_mesh, infer_with_mesh, shardings

@@ -188,6 +188,74 @@ def cmd_submit(args):
         sys.exit(0 if status == "SUCCEEDED" else 1)
 
 
+def cmd_stack(args):
+    """Live Python stacks of every cluster process (reference:
+    `ray stack` — py-spy over local PIDs; here each daemon serves its
+    own frames over RPC, so it works cluster-wide without ptrace)."""
+    import ray_tpu
+    from ray_tpu.util.tracing import cluster_stacks, format_cluster_stacks
+    ray_tpu.init(address=_load_address(args), ignore_reinit_error=True)
+    print(format_cluster_stacks(cluster_stacks()))
+
+
+def cmd_export_traces(args):
+    """Export spans as OTLP JSON (file and/or OTLP/HTTP collector)."""
+    import ray_tpu
+    from ray_tpu.util.tracing import export_otlp
+    ray_tpu.init(address=_load_address(args), ignore_reinit_error=True)
+    payload = export_otlp(filename=args.output, endpoint=args.endpoint)
+    n = sum(len(ss["spans"]) for rs in payload["resourceSpans"]
+            for ss in rs["scopeSpans"])
+    where = args.output or args.endpoint or "stdout"
+    if not args.output and not args.endpoint:
+        print(json.dumps(payload))
+    print(f"exported {n} spans to {where}", file=sys.stderr)
+
+
+def cmd_serve_deploy(args):
+    """Deploy applications from a declarative YAML config (reference:
+    python/ray/serve/scripts.py `serve deploy`)."""
+    import ray_tpu
+    from ray_tpu.serve.schema import deploy_from_config
+    ray_tpu.init(address=_load_address(args), ignore_reinit_error=True)
+    handles = deploy_from_config(args.config)
+    print(f"deployed {len(handles)} application(s)")
+    from ray_tpu import serve
+    print(json.dumps(serve.status(), indent=2))
+
+
+def cmd_serve_status(args):
+    import ray_tpu
+    from ray_tpu import serve
+    ray_tpu.init(address=_load_address(args), ignore_reinit_error=True)
+    out = {"applications": serve.status(), "proxies": serve.proxies()}
+    print(json.dumps(out, indent=2, default=str))
+
+
+def cmd_serve_delete(args):
+    import ray_tpu
+    from ray_tpu import serve
+    ray_tpu.init(address=_load_address(args), ignore_reinit_error=True)
+    if getattr(args, "all", False):
+        serve.shutdown()
+        print("serve shut down")
+        return
+    if not args.name:
+        print("serve delete: provide an application name or --all",
+              file=sys.stderr)
+        sys.exit(2)
+    serve.delete(args.name)
+    print(f"deleted application {args.name!r}")
+
+
+def cmd_serve_shutdown(args):
+    import ray_tpu
+    from ray_tpu import serve
+    ray_tpu.init(address=_load_address(args), ignore_reinit_error=True)
+    serve.shutdown()
+    print("serve shut down")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -242,6 +310,39 @@ def main(argv=None):
     pj.add_argument("--timeout", type=float, default=600)
     pj.add_argument("entrypoint", nargs=argparse.REMAINDER)
     pj.set_defaults(fn=cmd_submit)
+
+    pstack = sub.add_parser("stack",
+                            help="dump live Python stacks cluster-wide")
+    pstack.add_argument("--address", default=None)
+    pstack.set_defaults(fn=cmd_stack)
+
+    ptr = sub.add_parser("export-traces",
+                         help="export spans as OTLP JSON")
+    ptr.add_argument("--address", default=None)
+    ptr.add_argument("--output", "-o", default=None)
+    ptr.add_argument("--endpoint", default=None,
+                     help="OTLP/HTTP collector base URL")
+    ptr.set_defaults(fn=cmd_export_traces)
+
+    psrv = sub.add_parser("serve", help="serve control plane")
+    srv_sub = psrv.add_subparsers(dest="serve_cmd", required=True)
+    sd = srv_sub.add_parser("deploy",
+                            help="deploy apps from a YAML config")
+    sd.add_argument("config")
+    sd.add_argument("--address", default=None)
+    sd.set_defaults(fn=cmd_serve_deploy)
+    ss = srv_sub.add_parser("status")
+    ss.add_argument("--address", default=None)
+    ss.set_defaults(fn=cmd_serve_status)
+    sdel = srv_sub.add_parser("delete")
+    sdel.add_argument("name", nargs="?", default=None)
+    sdel.add_argument("--all", action="store_true",
+                      help="delete every application (serve shutdown)")
+    sdel.add_argument("--address", default=None)
+    sdel.set_defaults(fn=cmd_serve_delete)
+    ssh = srv_sub.add_parser("shutdown")
+    ssh.add_argument("--address", default=None)
+    ssh.set_defaults(fn=cmd_serve_shutdown)
 
     args = p.parse_args(argv)
     args.fn(args)

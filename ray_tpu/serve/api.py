@@ -21,12 +21,14 @@ _controller_handle = None
 def deployment(_func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 16,
                ray_actor_options: Optional[Dict] = None,
-               autoscaling_config=None, **_ignored):
+               autoscaling_config=None, num_hosts: int = 1,
+               topology: Optional[str] = None, **_ignored):
     def wrap(target):
         cfg = DeploymentConfig(
             num_replicas=num_replicas,
             max_ongoing_requests=max_ongoing_requests,
-            ray_actor_options=ray_actor_options)
+            ray_actor_options=ray_actor_options,
+            num_hosts=num_hosts, topology=topology)
         if autoscaling_config is not None:
             cfg.autoscaling_config = (
                 AutoscalingConfig(**autoscaling_config)
@@ -115,15 +117,20 @@ def run(app: Application, *, name: str = "default",
 
 
 def start(http_port: Optional[int] = None, grpc_port: Optional[int] = None,
+          grpc_servicer_functions: Optional[List[str]] = None,
           wait: bool = True, timeout: float = 120.0):
     """Enable ingress: the controller keeps one HTTP (and optionally
     gRPC) proxy on every alive node (reference: proxy-per-node,
     controller ProxyState + gRPCProxy proxy.py:558). Blocks until every
-    alive node has its proxies unless wait=False."""
+    alive node has its proxies unless wait=False.
+    grpc_servicer_functions: import paths of protoc-generated
+    add_X_to_server functions — registers the typed protobuf services on
+    every gRPC proxy (reference: gRPCOptions.grpc_servicer_functions)."""
     if http_port is None and grpc_port is None:
         http_port = 8000    # reference default: serve.start() serves HTTP
     ctrl = _get_controller()
-    ray_tpu.get(ctrl.set_http.remote(http_port, grpc_port), timeout=120)
+    ray_tpu.get(ctrl.set_http.remote(http_port, grpc_port,
+                                     grpc_servicer_functions), timeout=120)
     if not wait:
         return
     want_http = http_port is not None

@@ -134,15 +134,36 @@ class ServeController:
         return True
 
     def set_http(self, port: Optional[int] = None,
-                 grpc_port: Optional[int] = None):
+                 grpc_port: Optional[int] = None,
+                 grpc_servicer_functions: Optional[List[str]] = None):
         """Enable ingress: the reconcile loop keeps one HTTP (and
         optionally gRPC) proxy on every alive node (reference: proxy per
-        node, controller ProxyState)."""
+        node, controller ProxyState). grpc_servicer_functions: import
+        paths of generated add_X_to_server functions registered on every
+        gRPC proxy (reference: gRPCOptions.grpc_servicer_functions)."""
+        stale = []
         with self._lock:
             if port is not None:
                 self.http_port = port
             if grpc_port is not None:
                 self.grpc_port = grpc_port
+            if grpc_servicer_functions is not None:
+                new = list(grpc_servicer_functions)
+                if new != getattr(self, "_grpc_servicers", None):
+                    self._grpc_servicers = new
+                    # existing proxies were built with the old servicer
+                    # list: recycle them so the reconcile below brings
+                    # them back with the typed services registered
+                    stale = list(self._grpc_proxies.values())
+                    self._grpc_proxies.clear()
+                    for addrs in self._proxy_addrs.values():
+                        addrs.pop("grpc", None)
+        import ray_tpu
+        for p in stale:
+            try:
+                ray_tpu.kill(p)
+            except Exception:
+                pass
         self._reconcile_proxies()
         return True
 
@@ -226,7 +247,9 @@ class ServeController:
                         name=f"SERVE_GRPC:{nid[:12]}", namespace="serve",
                         max_concurrency=64, num_cpus=0.1,
                         scheduling_strategy=NodeAffinitySchedulingStrategy(
-                            nid)).remote(grpc_port, me)
+                            nid)).remote(grpc_port, me,
+                                         getattr(self, "_grpc_servicers",
+                                                 None))
                     addr = ray_tpu.get(proxy.ready.remote(), timeout=60)
                     with self._lock:
                         self._grpc_proxies[nid] = proxy
@@ -237,6 +260,7 @@ class ServeController:
 
     def deploy_application(self, app_name: str, specs: List[Dict]):
         """specs: dependencies-first list of deployment specs."""
+        builds = []
         with self._lock:
             app = self.apps.setdefault(app_name, {})
             for spec in specs:
@@ -261,46 +285,125 @@ class ServeController:
                     dep["target"] = max(auto["min_replicas"],
                                         min(dep["target"],
                                             auto["max_replicas"]))
-                self._reconcile_deployment(dep)
+                builds.append((dep, self._reconcile_deployment(dep)))
+        # replica CONSTRUCTION runs outside the lock: a sharded gang can
+        # take minutes to come up, and holding the lock would freeze the
+        # whole control plane (deploys, long-poll, health) meanwhile
+        for dep, n in builds:
+            self._create_replicas(dep, n)
         return True
 
-    def _make_replica(self, spec: Dict):
+    def _build_replica(self, spec: Dict):
+        """Construct one replica (possibly slow — sharded gangs do a
+        placement-group wait + jax.distributed init + model load). MUST
+        be called without self._lock held. Returns (handle, group) where
+        group is the gang record for sharded replicas, else None."""
         import ray_tpu
+        if int(spec["config"].get("num_hosts") or 1) > 1:
+            # sharded replica = a gang of ReplicaShard actors; routers see
+            # only the rank-0 facade, the controller keeps the group
+            # record so retire/kill tears down the whole gang
+            from ray_tpu.serve.sharded_replica import create_sharded_group
+            return create_sharded_group(spec)
         from ray_tpu.serve.replica import Replica
         opts = dict(spec["config"].get("ray_actor_options") or {})
         max_ongoing = spec["config"].get("max_ongoing_requests", 16)
         actor_cls = ray_tpu.remote(Replica)
-        return actor_cls.options(
+        a_opts = dict(
             max_concurrency=max_ongoing + 2,
             num_cpus=opts.get("num_cpus", 0.25),
             num_tpus=opts.get("num_tpus"),
-            resources=opts.get("resources"),
-        ).remote(spec["callable"], tuple(spec["init_args"]),
-                 spec["init_kwargs"], spec["is_function"])
+            resources=opts.get("resources"))
+        if opts.get("runtime_env"):
+            a_opts["runtime_env"] = opts["runtime_env"]
+        return actor_cls.options(**a_opts).remote(
+            spec["callable"], tuple(spec["init_args"]),
+            spec["init_kwargs"], spec["is_function"]), None
 
-    def _reconcile_deployment(self, dep: Dict):
+    def _create_replicas(self, dep: Dict, n: int):
+        """Build `n` replicas WITHOUT holding the lock, then attach each
+        under the lock — discarding it if the deployment rolled or was
+        deleted while it was building."""
+        if n <= 0:
+            return
+        from ray_tpu.serve.sharded_replica import kill_group
         import ray_tpu
+        try:
+            for _ in range(n):
+                with self._lock:
+                    spec = dep["spec"]
+                    gen = dep.get("gen", 0)
+                try:
+                    handle, group = self._build_replica(spec)
+                except Exception:
+                    logger.exception("replica build failed for %s/%s "
+                                     "(retried next reconcile tick)",
+                                     spec.get("app_name"), spec["name"])
+                    break
+                with self._lock:
+                    app = self.apps.get(spec.get("app_name") or "", {})
+                    alive = app.get(spec["name"]) is dep
+                    stale = dep.get("gen", 0) != gen
+                    if alive and not stale:
+                        dep["replicas"].append(handle)
+                        dep.setdefault("replica_gens", []).append(gen)
+                        if group is not None:
+                            dep.setdefault("groups", {})[
+                                handle._actor_id] = group
+                        dep["version"] += 1
+                        self._bump_dep(dep)
+                        continue
+                # rolled/deleted mid-build: the fresh replica is already
+                # obsolete — tear it down instead of leaking it
+                if group is not None:
+                    kill_group(group)
+                else:
+                    try:
+                        ray_tpu.kill(handle)
+                    except Exception:
+                        pass
+        finally:
+            with self._lock:
+                dep["_creating"] = False
+
+    def _kill_replica(self, dep: Dict, handle):
+        """Kill a replica; for sharded replicas this retires the whole
+        gang (every rank + the placement group)."""
+        import ray_tpu
+        group = (dep.get("groups") or {}).pop(
+            getattr(handle, "_actor_id", None), None)
+        if group is not None:
+            from ray_tpu.serve.sharded_replica import kill_group
+            kill_group(group)
+            return
+        try:
+            ray_tpu.kill(handle)
+        except Exception:
+            pass
+
+    def _reconcile_deployment(self, dep: Dict) -> int:
+        """Caller holds self._lock. Quick mutations only (retire/drain
+        bookkeeping); returns how many replicas the caller must build
+        via _create_replicas OUTSIDE the lock."""
         gen = dep.get("gen", 0)
         gens = dep.setdefault("replica_gens", [])
         while len(gens) < len(dep["replicas"]):
             gens.append(gen)        # legacy/pre-roll replicas
         del gens[len(dep["replicas"]):]
         changed = False
+        n_create = 0
         new_count = sum(1 for g in gens if g == gen)
         old_idx = [i for i, g in enumerate(gens) if g != gen]
-        if new_count < dep["target"]:
+        if dep.get("_creating"):
+            pass        # a build is already in flight; let it land first
+        elif new_count < dep["target"]:
             if old_idx:
                 # mid-roll: surge ONE new-generation replica per
                 # reconcile tick — gradual replacement
-                dep["replicas"].append(self._make_replica(dep["spec"]))
-                gens.append(gen)
+                n_create = 1
             else:
                 # fresh deploy / plain scale-up: fill to target now
-                while new_count < dep["target"]:
-                    dep["replicas"].append(self._make_replica(dep["spec"]))
-                    gens.append(gen)
-                    new_count += 1
-            changed = True
+                n_create = dep["target"] - new_count
         elif old_idx:
             # current generation is at target: retire ONE old replica —
             # gracefully: routers stop picking it (version bump below),
@@ -318,6 +421,9 @@ class ServeController:
         if changed:
             dep["version"] += 1
             self._bump_dep(dep)
+        if n_create:
+            dep["_creating"] = True
+        return n_create
 
     def _dep_key(self, dep: Dict) -> str:
         spec = dep["spec"]
@@ -335,37 +441,64 @@ class ServeController:
                     items = [(a, n, dep) for a, app in self.apps.items()
                              for n, dep in app.items()]
                 for app_name, name, dep in items:
-                    alive = []
-                    for r in dep["replicas"]:
-                        try:
-                            # generous timeout: a slow box must not read as
-                            # death (kills would cascade); real deaths also
-                            # surface as ActorDiedError immediately
-                            ray_tpu.get(r.check_health.remote(), timeout=30)
-                            alive.append(r)
-                        except ray_tpu.ActorDiedError:
-                            logger.warning("replica of %s/%s died; "
-                                           "replacing", app_name, name)
-                        except Exception:
-                            alive.append(r)   # slow ≠ dead
-                    lens = self._probe_loads(dep)
-                    self._reap_draining(dep)
-                    with self._lock:
-                        if len(alive) != len(dep["replicas"]):
-                            alive_set = {id(r) for r in alive}
-                            gens = dep.get("replica_gens") or []
-                            dep["replica_gens"] = [
-                                g for r, g in zip(dep["replicas"], gens)
-                                if id(r) in alive_set]
-                            dep["replicas"] = alive
-                            dep["version"] += 1
-                            self._bump_dep(dep)
-                        self._autoscale(app_name, name, dep, lens)
-                        self._reconcile_deployment(dep)
-                    self._publish_loads(dep, lens)
+                    try:
+                        self._reconcile_one(app_name, name, dep)
+                    except Exception:
+                        # one broken deployment must not starve the
+                        # others' health checks / autoscaling / proxies
+                        logger.exception("reconcile failed for %s/%s",
+                                         app_name, name)
                 self._reconcile_proxies()
             except Exception:
                 logger.exception("reconcile loop iteration failed")
+
+    def _reconcile_one(self, app_name: str, name: str, dep: Dict):
+        import ray_tpu
+        alive = []
+        for r in dep["replicas"]:
+            try:
+                # generous timeout: a slow box must not read as
+                # death (kills would cascade); real deaths also
+                # surface as ActorDiedError immediately
+                ray_tpu.get(r.check_health.remote(), timeout=30)
+                alive.append(r)
+            except ray_tpu.ActorDiedError:
+                logger.warning("replica of %s/%s died; replacing",
+                               app_name, name)
+            except Exception:
+                alive.append(r)   # slow ≠ dead
+        lens = self._probe_loads(dep)
+        self._reap_draining(dep)
+        dead = []
+        with self._lock:
+            if len(alive) != len(dep["replicas"]):
+                alive_set = {id(r) for r in alive}
+                dead = [r for r in dep["replicas"]
+                        if id(r) not in alive_set]
+                gens = dep.get("replica_gens") or []
+                dep["replica_gens"] = [
+                    g for r, g in zip(dep["replicas"], gens)
+                    if id(r) in alive_set]
+                dep["replicas"] = alive
+                dep["version"] += 1
+                self._bump_dep(dep)
+            self._autoscale(app_name, name, dep, lens)
+            n_create = self._reconcile_deployment(dep)
+        # a dead sharded rank-0 leaves peers + a PG behind: tear the
+        # gang down — OUTSIDE the lock, kill RPCs can block on slow
+        # nodes (_kill_replica's groups-dict pop is GIL-atomic, same as
+        # the lock-free _reap_draining / delete_application callers)
+        for r in dead:
+            self._kill_replica(dep, r)
+        self._publish_loads(dep, lens)
+        # slow construction (sharded gangs: pg wait + jax.distributed
+        # init + model load) runs on its own thread so ONE rebuilding
+        # deployment never stalls the others' health checks — the
+        # _creating flag keeps builds single-flight per deployment
+        if n_create:
+            threading.Thread(
+                target=self._create_replicas, args=(dep, n_create),
+                name=f"serve-build-{name}", daemon=True).start()
 
     def _autoscale(self, app_name, name, dep, lens=None):
         """Reference-shaped policy (serve/autoscaling_policy.py): average
@@ -424,10 +557,7 @@ class ServeController:
             else:
                 keep.append((h, deadline))   # busy or merely slow
         for h in victims:
-            try:
-                ray_tpu.kill(h)
-            except Exception:
-                pass
+            self._kill_replica(dep, h)
         with self._lock:
             current = dep.get("draining") or []
             # keep anything enrolled since the snapshot + the keepers
@@ -496,8 +626,5 @@ class ServeController:
         for dep in app.values():
             draining = [h for h, _ in dep.get("draining") or []]
             for r in list(dep["replicas"]) + draining:
-                try:
-                    ray_tpu.kill(r)
-                except Exception:
-                    pass
+                self._kill_replica(dep, r)
         return True

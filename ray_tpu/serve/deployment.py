@@ -30,6 +30,13 @@ class DeploymentConfig:
     ray_actor_options: Optional[Dict[str, Any]] = None
     autoscaling_config: Optional[AutoscalingConfig] = None
     health_check_period_s: float = 5.0
+    # multi-host (slice-sharded) replicas: num_hosts > 1 makes each
+    # replica a gang of ReplicaShard actors joined into one
+    # jax.distributed world; topology (e.g. "v4-32") pins the gang onto
+    # one healthy TPU slice, STRICT_SPREAD over its hosts
+    # (serve/sharded_replica.py; SURVEY §7.2-10)
+    num_hosts: int = 1
+    topology: Optional[str] = None
 
 
 class Deployment:
@@ -42,10 +49,16 @@ class Deployment:
                 num_replicas: Optional[int] = None,
                 max_ongoing_requests: Optional[int] = None,
                 ray_actor_options: Optional[Dict] = None,
-                autoscaling_config=None) -> "Deployment":
+                autoscaling_config=None,
+                num_hosts: Optional[int] = None,
+                topology: Optional[str] = None) -> "Deployment":
         cfg = dataclasses.replace(self.config)
         if num_replicas is not None:
             cfg.num_replicas = num_replicas
+        if num_hosts is not None:
+            cfg.num_hosts = num_hosts
+        if topology is not None:
+            cfg.topology = topology
         if max_ongoing_requests is not None:
             cfg.max_ongoing_requests = max_ongoing_requests
         if ray_actor_options is not None:

@@ -39,8 +39,31 @@ def _decode(data: bytes):
         return data
 
 
+class _DynamicServicer:
+    """Servicer stand-in handed to user `add_X_to_server` functions
+    (reference: proxy.py:558 gRPCProxy — the generated registration code
+    reads one attribute per proto method; every method routes into serve
+    with the DESERIALIZED protobuf request as the payload, and the
+    deployment returns the protobuf response message)."""
+
+    def __init__(self, proxy: "GrpcProxy"):
+        self._proxy = proxy
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+        proxy = self._proxy
+
+        def handler(request, context):
+            return proxy._typed_call(method, request, context)
+
+        return handler
+
+
 class GrpcProxy:
-    def __init__(self, port: int, controller):
+    def __init__(self, port: int, controller, servicer_functions=None):
+        import importlib
+
         import grpc
 
         self.controller = controller
@@ -65,6 +88,16 @@ class GrpcProxy:
             # REUSEPORT off: several per-node proxies share a host in
             # tests; each must get its own distinct listener
             options=(("grpc.so_reuseport", 0),))
+        # typed protobuf services (reference: grpc_servicer_functions):
+        # each entry is the import path of a generated add_X_to_server;
+        # protobuf (de)serialization stays in grpc's layer, the routed
+        # payload is the real request message
+        for path in servicer_functions or []:
+            mod, _, attr = path.partition(":")
+            if not attr:
+                mod, attr = path.rsplit(".", 1)
+            add_fn = getattr(importlib.import_module(mod), attr)
+            add_fn(_DynamicServicer(self), self._server)
         try:
             bound = self._server.add_insecure_port(f"0.0.0.0:{port}")
         except RuntimeError:
@@ -97,7 +130,7 @@ class GrpcProxy:
     def ready(self) -> str:
         return self._addr
 
-    def _call(self, request: bytes, context) -> bytes:
+    def _handle_for(self, context):
         import grpc
         meta = dict(context.invocation_metadata())
         app_name = meta.get("application", "default")
@@ -110,6 +143,11 @@ class GrpcProxy:
             from ray_tpu.serve.handle import DeploymentHandle
             h = DeploymentHandle(dep, app_name)
             self._handles[app_name] = h
+        return h, meta
+
+    def _call(self, request: bytes, context) -> bytes:
+        import grpc
+        h, meta = self._handle_for(context)
         method = meta.get("method")
         payload = _decode(request)
         try:
@@ -119,6 +157,18 @@ class GrpcProxy:
             context.abort(grpc.StatusCode.INTERNAL,
                           f"{type(e).__name__}: {e}")
         return _encode(result)
+
+    def _typed_call(self, method: str, request, context):
+        """Typed-service path: the deployment method named after the
+        proto rpc receives the protobuf request message and returns the
+        protobuf response message."""
+        import grpc
+        h, _ = self._handle_for(context)
+        try:
+            return getattr(h, method).remote(request).result(timeout=60)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
 
 
 def grpc_call(address: str, payload, application: str = "default",

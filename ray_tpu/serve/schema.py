@@ -51,7 +51,9 @@ def deploy_from_config(config: Union[str, Dict]) -> List:
     http = conf.get("http_options") or {}
     grpc = conf.get("grpc_options") or {}
     if http.get("port") is not None or grpc.get("port") is not None:
-        api.start(http_port=http.get("port"), grpc_port=grpc.get("port"))
+        api.start(http_port=http.get("port"), grpc_port=grpc.get("port"),
+                  grpc_servicer_functions=grpc.get(
+                      "grpc_servicer_functions"))
 
     handles = []
     for app_conf in conf.get("applications", []):
@@ -80,4 +82,5 @@ def _apply_overrides(app, overrides: Dict[str, Dict]) -> None:
                 **{k: ov[k] for k in ("num_replicas",
                                       "max_ongoing_requests",
                                       "ray_actor_options",
-                                      "autoscaling_config") if k in ov})
+                                      "autoscaling_config",
+                                      "num_hosts", "topology") if k in ov})

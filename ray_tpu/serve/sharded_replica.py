@@ -1,0 +1,248 @@
+"""Multi-host (slice-sharded) serve replicas: one replica = one worker
+group spanning a TPU slice, serving a model sharded over the group's
+global device mesh (SURVEY §7.2 step 10; reference replica lifecycle:
+python/ray/serve/_private/deployment_state.py:1232 — the reference has no
+multi-host replica, this is the TPU-native extension of it).
+
+Shape: a replica group is `num_hosts` ReplicaShard actors gang-placed by
+a placement group (STRICT_SPREAD across the hosts of one slice when a
+topology is given, PACK otherwise), joined into one jax.distributed world
+through the GCS-KV coordinator rendezvous (the NCCL/TCP-store
+replacement). Every rank constructs the user callable — its __init__
+builds the model sharded over the *global* mesh — and rank 0 is the
+ingress: routers hold only the rank-0 handle, which fans each request out
+to the peer ranks so every process enters the same SPMD computation, and
+returns its own (rank-0) result.
+
+SPMD discipline: multi-host XLA programs deadlock if two requests
+interleave across ranks in different orders, so the rank-0 facade admits
+one request into the compute at a time (queue depth still reported for
+autoscaling). Batching therefore belongs *inside* the callable
+(@serve.batch) where it rides one SPMD entry.
+
+Failure semantics match training slices: one dead rank invalidates the
+whole group (ICI collectives span every host), so health checks probe all
+ranks and the controller replaces the entire group, never a single rank.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class ReplicaShard:
+    """One rank of a sharded replica group (actor; max_concurrency must
+    leave room for health/queue probes while a request runs)."""
+
+    def __init__(self, rank: int, world_size: int):
+        self._rank = rank
+        self._world = world_size
+        self._callable = None
+        self._is_function = False
+        self._peers: List = []
+        self._ongoing = 0
+        self._lock = threading.Lock()
+        # serializes SPMD entry on rank 0 (see module docstring)
+        self._spmd_lock = threading.Lock()
+
+    def setup_distributed(self, group_name: str) -> bool:
+        """Join the group's jax.distributed world (KV rendezvous). Must
+        run before any jax use in this process."""
+        from ray_tpu.util.collective import _init_jax_distributed
+        _init_jax_distributed(self._world, self._rank, group_name)
+        return True
+
+    def init_callable(self, serialized_callable: bytes, init_args: Tuple,
+                      init_kwargs: Dict, is_function: bool) -> bool:
+        """Construct the user callable on THIS rank. All ranks run the
+        same __init__, so a model sharded with jax.device_put /
+        make_array_from_process_local_data lands distributed across the
+        group."""
+        import cloudpickle
+        target = cloudpickle.loads(serialized_callable)
+        self._is_function = is_function
+        if is_function:
+            self._callable = target
+        else:
+            self._callable = target(*init_args, **init_kwargs)
+        return True
+
+    def set_peers(self, peers: List) -> bool:
+        """Rank 0 only: handles to ranks 1..world-1, fan-out targets."""
+        self._peers = list(peers)
+        return True
+
+    # ------------------------------------------------------------ data plane
+    def handle_request(self, method: str, args: Tuple, kwargs: Dict):
+        """Rank-0 ingress: admit one SPMD request, fan out to peers, run
+        the local shard, surface the first failure (peer errors included
+        — a hung peer would otherwise deadlock the *next* request)."""
+        import ray_tpu
+        with self._lock:
+            self._ongoing += 1
+        try:
+            with self._spmd_lock:
+                refs = [p.run_shard.remote(method, args, kwargs)
+                        for p in self._peers]
+                try:
+                    result = self.run_shard(method, args, kwargs)
+                finally:
+                    # peers must finish their shard of this request before
+                    # the next one may enter (SPMD ordering)
+                    ray_tpu.get(refs, timeout=300)
+            return result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def run_shard(self, method: str, args: Tuple, kwargs: Dict):
+        """Execute the user method on this rank's shard of the world."""
+        kwargs = dict(kwargs)
+        kwargs.pop("__serve_model_id", None)
+        if self._is_function:
+            fn = self._callable
+        else:
+            fn = getattr(self._callable, method)
+        import asyncio
+        import inspect
+        if inspect.iscoroutinefunction(fn):
+            from ray_tpu._private.worker import global_worker
+            return asyncio.run_coroutine_threadsafe(
+                fn(*args, **kwargs), global_worker.core.loop).result()
+        return fn(*args, **kwargs)
+
+    # --------------------------------------------------------- control plane
+    def get_queue_len(self) -> int:
+        return self._ongoing
+
+    def check_health(self) -> bool:
+        """Rank 0 probes every peer: one dead rank = unhealthy group, so
+        the controller replaces the gang as a unit (slice semantics)."""
+        import ray_tpu
+        fn = getattr(self._callable, "check_health", None)
+        if fn is not None:
+            fn()
+        if self._peers:
+            ray_tpu.get([p.check_peer_health.remote() for p in self._peers],
+                        timeout=25)
+        return True
+
+    def check_peer_health(self) -> bool:
+        fn = getattr(self._callable, "check_health", None)
+        if fn is not None:
+            fn()
+        return True
+
+    def reconfigure(self, user_config) -> bool:
+        import ray_tpu
+        refs = [p.reconfigure_shard.remote(user_config)
+                for p in self._peers]
+        self.reconfigure_shard(user_config)
+        ray_tpu.get(refs, timeout=60)
+        return True
+
+    def reconfigure_shard(self, user_config) -> bool:
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+        return True
+
+
+def create_sharded_group(spec: Dict) -> Tuple[object, Dict]:
+    """Gang-create one sharded replica group for `spec` (controller
+    helper). Returns (rank0_handle, group_record) where group_record =
+    {"members": [handles], "pg": placement_group} — the controller keeps
+    it so kill/drain retires the whole gang and releases the bundle.
+
+    Placement: with config["topology"] (e.g. "v4-32") the bundles come
+    from train/slice.py — pinned to ONE healthy slice, STRICT_SPREAD over
+    its hosts. Without a topology, `num_hosts` plain bundles placed PACK
+    (multi-process on commodity nodes — the CPU CI shape)."""
+    import uuid
+
+    import ray_tpu
+    from ray_tpu.util import (PlacementGroupSchedulingStrategy,
+                              placement_group, remove_placement_group)
+
+    cfg = spec["config"]
+    n = int(cfg.get("num_hosts") or 1)
+    topology = cfg.get("topology")
+    opts = dict(cfg.get("ray_actor_options") or {})
+    res = {"CPU": opts.get("num_cpus", 0.25)}
+    if opts.get("num_tpus"):
+        res["TPU"] = opts["num_tpus"]
+    for k, v in (opts.get("resources") or {}).items():
+        res[k] = v
+    strategy = "PACK"
+    bundles = [dict(res) for _ in range(n)]
+    if topology:
+        from ray_tpu.train import slice as slice_lib
+        n_hosts, chips = slice_lib.slice_shape(topology)
+        if n_hosts != n:
+            raise ValueError(f"topology {topology} has {n_hosts} hosts; "
+                             f"num_hosts={n} must match")
+        pod = slice_lib.pick_slice(ray_tpu.nodes(), topology)
+        if pod is None:
+            raise RuntimeError(f"no healthy {topology} slice available")
+        bundles = slice_lib.slice_bundles(pod, topology, res)
+        strategy = "STRICT_SPREAD"
+    pg = placement_group(bundles, strategy=strategy)
+    if not pg.wait(timeout=120):
+        remove_placement_group(pg)
+        raise RuntimeError(
+            f"placement group for sharded replica ({n} hosts) "
+            f"not schedulable: {bundles}")
+    max_ongoing = cfg.get("max_ongoing_requests", 16)
+    actor_cls = ray_tpu.remote(ReplicaShard)
+    members = []
+    try:
+        for rank in range(n):
+            a_opts = dict(
+                max_concurrency=max_ongoing + 4,
+                resources=dict(bundles[rank]),
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    pg, placement_group_bundle_index=rank))
+            if opts.get("runtime_env"):
+                a_opts["runtime_env"] = opts["runtime_env"]
+            members.append(actor_cls.options(**a_opts).remote(rank, n))
+        group_name = f"serve-shard-{uuid.uuid4().hex[:8]}"
+        ray_tpu.get([m.setup_distributed.remote(group_name)
+                     for m in members], timeout=300)
+        ray_tpu.get([m.init_callable.remote(
+            spec["callable"], tuple(spec["init_args"]),
+            spec["init_kwargs"], spec["is_function"])
+            for m in members], timeout=600)
+        ray_tpu.get(members[0].set_peers.remote(members[1:]), timeout=60)
+    except Exception:
+        for m in members:
+            try:
+                ray_tpu.kill(m)
+            except Exception:
+                pass
+        try:
+            remove_placement_group(pg)
+        except Exception:
+            pass
+        raise
+    return members[0], {"members": members, "pg": pg}
+
+
+def kill_group(group: Dict) -> None:
+    """Tear down every rank + release the gang's placement group."""
+    import ray_tpu
+    from ray_tpu.util import remove_placement_group
+    for m in group.get("members", []):
+        try:
+            ray_tpu.kill(m)
+        except Exception:
+            pass
+    pg = group.get("pg")
+    if pg is not None:
+        try:
+            remove_placement_group(pg)
+        except Exception:
+            pass
