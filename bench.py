@@ -97,10 +97,14 @@ V5E_PEAK_FLOPS = 197e12     # bf16
 MFU_BASELINE = 0.40         # BASELINE.json north star: >=40% MFU
 
 
+RL_ENV_STEPS_R4 = 2031.0    # BENCH_r04 — the round-over-round ratchet
+
+
 def bench_rl_env_steps(iters: int = 3):
     """PPO CartPole sampling throughput (BASELINE.json names RLlib PPO
-    env-steps/s as a north star with no in-repo reference number — the
-    value is recorded for round-over-round tracking)."""
+    env-steps/s as a north star with no in-repo reference number — so
+    the ratchet is our own round-4 record: vs_r4_ratchet must hold
+    >=1.0x round over round)."""
     from ray_tpu.rl import AlgorithmConfig
     config = (AlgorithmConfig()
               .environment("CartPole-v1")
@@ -114,8 +118,9 @@ def bench_rl_env_steps(iters: int = 3):
         rates = [algo.train()["env_steps_per_s"] for _ in range(iters)]
     finally:
         algo.stop()
-    return {"value": round(float(sum(rates) / len(rates)), 1),
-            "unit": "env_steps_per_s"}
+    value = round(float(sum(rates) / len(rates)), 1)
+    return {"value": value, "unit": "env_steps_per_s",
+            "vs_r4_ratchet": round(value / RL_ENV_STEPS_R4, 3)}
 
 
 def log(msg):

@@ -1,15 +1,18 @@
 from ray_tpu.rl.algorithm import PPO, Algorithm
+from ray_tpu.rl.appo import APPO
 from ray_tpu.rl.config import AlgorithmConfig
 from ray_tpu.rl.dqn import DQN
 from ray_tpu.rl.impala import IMPALA
 from ray_tpu.rl.multi_agent import (MultiAgentConfig, MultiAgentEnv,
                                     MultiAgentEnvRunner, MultiAgentPPO)
 from ray_tpu.rl.offline import BC, BCConfig, record_experiences
-from ray_tpu.rl.replay_buffer import ReplayBuffer
+from ray_tpu.rl.replay_buffer import (PrioritizedReplayBuffer, ReplayBuffer,
+                                      make_replay_buffer)
 from ray_tpu.rl.sac import SAC
 from ray_tpu.rl.vtrace import vtrace
 
-__all__ = ["Algorithm", "PPO", "IMPALA", "DQN", "SAC", "AlgorithmConfig",
-           "ReplayBuffer", "vtrace", "MultiAgentEnv", "MultiAgentConfig",
-           "MultiAgentEnvRunner", "MultiAgentPPO", "BC", "BCConfig",
-           "record_experiences"]
+__all__ = ["Algorithm", "PPO", "APPO", "IMPALA", "DQN", "SAC",
+           "AlgorithmConfig", "ReplayBuffer", "PrioritizedReplayBuffer",
+           "make_replay_buffer", "vtrace", "MultiAgentEnv",
+           "MultiAgentConfig", "MultiAgentEnvRunner", "MultiAgentPPO",
+           "BC", "BCConfig", "record_experiences"]

@@ -15,6 +15,10 @@ from ray_tpu.rl.config import AlgorithmConfig
 
 
 class Algorithm:
+    # recurrent (use_lstm) policies need time-major trajectory learning;
+    # only the V-trace family implements it (IMPALA/APPO set this True)
+    supports_recurrence = False
+
     def __init__(self, config: AlgorithmConfig):
         import gymnasium as gym
         import ray_tpu
@@ -24,6 +28,12 @@ class Algorithm:
         from ray_tpu.rl import envs as _envs
         from ray_tpu.rl.rl_module import action_spec_of
         _envs.register_envs()
+        if getattr(config, "use_lstm", False) \
+                and not self.supports_recurrence:
+            raise ValueError(
+                f"use_lstm is not supported by "
+                f"{type(self).__name__}; use IMPALA or APPO (their "
+                f"time-major V-trace losses carry the LSTM state)")
         self.config = config
         probe = gym.make(config.env, **config.env_config)
         from ray_tpu.rl.connectors import pipeline_output_shape

@@ -37,6 +37,14 @@ class AlgorithmConfig:
     initial_alpha: float = 0.2      # SAC entropy temperature (auto-tuned)
     target_entropy: Optional[float] = None   # default: -action_dim
     updates_per_step: float = 1.0   # grad updates per env step (SAC)
+    # replay buffer selection (reference: replay_buffer_config) —
+    # {"type": "uniform"} or {"type": "prioritized", "alpha": .., "beta": ..}
+    replay_buffer_config: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"type": "uniform"})
+    # recurrent policy (reference: model_config use_lstm) — IMPALA/APPO
+    use_lstm: bool = False
+    # APPO: learner steps between hard target-network syncs
+    target_update_freq: int = 2
 
     # fluent builder API (reference: AlgorithmConfig chaining)
     def environment(self, env: str, env_config: Optional[Dict] = None):

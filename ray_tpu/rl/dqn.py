@@ -12,7 +12,8 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 from ray_tpu.rl.config import AlgorithmConfig
-from ray_tpu.rl.replay_buffer import ReplayBuffer
+from ray_tpu.rl.replay_buffer import (PrioritizedReplayBuffer,
+                                      make_replay_buffer)
 
 
 class QEnvRunner:
@@ -149,8 +150,9 @@ class DQN:
         runner_cls = ray_tpu.remote(QEnvRunner)
         self.env_runners = [runner_cls.remote({**cfg, "runner_index": i})
                             for i in range(config.num_env_runners)]
-        self.buffer = ReplayBuffer(cfg.get("replay_capacity", 50_000),
-                                   seed=config.seed)
+        self.buffer = make_replay_buffer(
+            config.replay_buffer_config, cfg.get("replay_capacity", 50_000),
+            seed=config.seed)
         self.net = QNet(action_dim, tuple(config.hidden_sizes))
         self.params = self.net.init(jax.random.PRNGKey(config.seed),
                                     jnp.zeros((1, obs_dim)))["params"]
@@ -160,7 +162,7 @@ class DQN:
         gamma = config.gamma
         net = self.net
 
-        def loss_fn(params, target_params, batch):
+        def loss_fn(params, target_params, batch, weights):
             q = net.apply({"params": params}, batch["obs"])
             q_a = jnp.take_along_axis(
                 q, batch["actions"][:, None], 1)[:, 0]
@@ -172,15 +174,18 @@ class DQN:
             target = batch["rewards"] + gamma * (1 - batch["dones"]) \
                 * jax.lax.stop_gradient(q_best)
             td = q_a - target
-            return (td ** 2).mean()
+            # per-sample importance weights (prioritized replay IS
+            # correction; all-ones under the uniform buffer)
+            return (weights * td ** 2).mean(), td
 
         @jax.jit
-        def update(params, target_params, opt_state, batch):
-            loss, grads = jax.value_and_grad(loss_fn)(
-                params, target_params, batch)
+        def update(params, target_params, opt_state, batch, weights):
+            (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, batch, weights)
             updates, opt_state = self.optimizer.update(grads, opt_state,
                                                        params)
-            return optax.apply_updates(params, updates), opt_state, loss
+            return (optax.apply_updates(params, updates), opt_state, loss,
+                    td)
 
         self._update = update
         self.iteration = 0
@@ -211,11 +216,18 @@ class DQN:
 
         loss = float("nan")
         if len(self.buffer) >= cfg.minibatch_size:
+            prioritized = isinstance(self.buffer, PrioritizedReplayBuffer)
             for _ in range(cfg.num_epochs * 4):
                 mb = self.buffer.sample(cfg.minibatch_size)
+                indices = mb.pop("indices", None)
+                weights = mb.pop("weights", None)
+                w = (jnp.asarray(weights) if weights is not None
+                     else jnp.ones(cfg.minibatch_size, jnp.float32))
                 mb = {k: jnp.asarray(v) for k, v in mb.items()}
-                self.params, self.opt_state, loss = self._update(
-                    self.params, self.target_params, self.opt_state, mb)
+                self.params, self.opt_state, loss, td = self._update(
+                    self.params, self.target_params, self.opt_state, mb, w)
+                if prioritized:
+                    self.buffer.update_priorities(indices, np.asarray(td))
                 self._grad_steps += 1
                 if self._grad_steps % 100 == 0:
                     self.target_params = self.params

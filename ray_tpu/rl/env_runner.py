@@ -52,7 +52,13 @@ class EnvRunner:
         self.module = make_rl_module(
             obs_shape, self.action_spec,
             config.get("hidden_sizes", (64, 64)),
-            seed=config.get("seed", 0))
+            seed=config.get("seed", 0),
+            use_lstm=config.get("use_lstm", False))
+        # recurrent modules: per-env LSTM carry, zeroed on episode reset
+        # (the connector state discipline — rl_module docstring)
+        self._state = (self.module.initial_state(self.n_envs)
+                       if getattr(self.module, "is_recurrent", False)
+                       else None)
         self.rng = jax.random.PRNGKey(config.get("seed", 0)
                                       + config.get("runner_index", 0) * 1000)
         self.obs, _ = self.envs.reset(seed=config.get("seed", 0)
@@ -149,12 +155,21 @@ class EnvRunner:
         rew_buf = np.zeros((T, N), np.float32)
         done_buf = np.zeros((T, N), np.float32)
 
+        recurrent = self._state is not None
+        initial_state = (tuple(np.asarray(s) for s in self._state)
+                         if recurrent else None)
         obs = self.obs
         cobs = self._cobs
         for t in range(T):
             self.rng, key = jax.random.split(self.rng)
-            action, logp, _value = self.module.sample_actions(
-                self.module.params, cobs.astype(np.float32), key)
+            if recurrent:
+                action, logp, _value, self._state = \
+                    self.module.sample_actions(
+                        self.module.params, cobs.astype(np.float32), key,
+                        self._state)
+            else:
+                action, logp, _value = self.module.sample_actions(
+                    self.module.params, cobs.astype(np.float32), key)
             nxt, rew, term, trunc, _ = self.envs.step(action)
             done = np.logical_or(term, trunc)
             obs_buf[t] = cobs
@@ -167,19 +182,34 @@ class EnvRunner:
                 if d:
                     self._episode_returns.append(self._running_returns[i])
                     self._running_returns[i] = 0.0
+            if recurrent and done.any():
+                # fresh episodes must not see the dead episode's memory
+                mask = 1.0 - done.astype(np.float32)[:, None]
+                self._state = tuple(np.asarray(s) * mask
+                                    for s in self._state)
             obs = nxt
             cobs = self._apply_pipeline(self._pipeline,
                                         nxt.astype(np.float32),
                                         reset_mask=done)
         self.obs = obs
         self._cobs = cobs
-        _, last_val = self.module.forward(self.module.params,
-                                          cobs.astype(np.float32))
-        return {"obs": obs_buf, "actions": act_buf,
-                "behavior_logp": logp_buf, "rewards": rew_buf,
-                "dones": done_buf,
-                "bootstrap_obs": np.asarray(cobs, np.float32),
-                "bootstrap_value": np.asarray(last_val, np.float32)}
+        if recurrent:
+            _, last_val = self.module.forward(
+                self.module.params, cobs.astype(np.float32), self._state)
+        else:
+            _, last_val = self.module.forward(self.module.params,
+                                              cobs.astype(np.float32))
+        out = {"obs": obs_buf, "actions": act_buf,
+               "behavior_logp": logp_buf, "rewards": rew_buf,
+               "dones": done_buf,
+               "bootstrap_obs": np.asarray(cobs, np.float32),
+               "bootstrap_value": np.asarray(last_val, np.float32)}
+        if recurrent:
+            # fragment-start carry: the learner re-derives every
+            # intermediate state from this + the done flags
+            out["initial_state_c"] = initial_state[0]
+            out["initial_state_h"] = initial_state[1]
+        return out
 
     def get_metrics(self) -> Dict:
         out = {"episode_return_mean":

@@ -67,6 +67,79 @@ class GridTargetEnv(_BASE):
         return self._obs(), reward, terminated, truncated, {}
 
 
+class StatelessCartPole(_BASE):
+    """CartPole with the velocity components masked out — the classic
+    partially-observable recurrence gate (reference:
+    rllib/examples/envs/classes/stateless_cartpole.py): a feedforward
+    policy plateaus near random because [position, angle] alone don't
+    determine the optimal action; an LSTM recovers the velocities from
+    its memory."""
+
+    def __init__(self, render_mode: Optional[str] = None):
+        self._env = gym.make("CartPole-v1")
+        self.observation_space = gym.spaces.Box(
+            -np.inf, np.inf, (2,), np.float32)
+        self.action_space = self._env.action_space
+        self.render_mode = render_mode
+
+    @staticmethod
+    def _mask(obs):
+        return np.asarray([obs[0], obs[2]], np.float32)
+
+    def reset(self, *, seed=None, options=None):
+        obs, info = self._env.reset(seed=seed, options=options)
+        return self._mask(obs), info
+
+    def step(self, action):
+        obs, rew, term, trunc, info = self._env.step(action)
+        return self._mask(obs), rew, term, trunc, info
+
+
+class RepeatAfterMeEnv(_BASE):
+    """Memory probe (reference:
+    rllib/examples/envs/classes/repeat_after_me_env.py): each step shows
+    a random one-hot token; the reward pays +1 for echoing the PREVIOUS
+    step's token. A memoryless policy can't beat chance (~half of
+    MAX_STEPS); an LSTM solves it almost perfectly — a crisp, fast
+    recurrence gate."""
+
+    MAX_STEPS = 32
+
+    def __init__(self, render_mode: Optional[str] = None):
+        self.observation_space = gym.spaces.Box(0.0, 1.0, (2,), np.float32)
+        self.action_space = gym.spaces.Discrete(2)
+        self.render_mode = render_mode
+        self._rng = np.random.default_rng(0)
+        self._prev = 0
+        self._t = 0
+
+    def _obs(self, tok: int):
+        o = np.zeros(2, np.float32)
+        o[tok] = 1.0
+        return o
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        # prev = token shown one obs ago (what the action must echo);
+        # cur = token in the obs the agent is looking at right now
+        self._prev = None
+        self._cur = int(self._rng.integers(0, 2))
+        return self._obs(self._cur), {}
+
+    def step(self, action):
+        # the current obs shows a NEW token, so echoing what the agent
+        # sees scores chance — only memory of the previous obs pays
+        reward = float(self._prev is not None
+                       and int(action) == self._prev)
+        self._t += 1
+        self._prev = self._cur
+        self._cur = int(self._rng.integers(0, 2))
+        return (self._obs(self._cur), reward, False,
+                self._t >= self.MAX_STEPS, {})
+
+
 def register_envs():
     """Idempotently register the built-in envs with gymnasium."""
     if gym is None:
@@ -76,6 +149,16 @@ def register_envs():
     except Exception:
         gym.register(id="ray_tpu/GridTarget-v0",
                      entry_point="ray_tpu.rl.envs:GridTargetEnv")
+    try:
+        gym.spec("ray_tpu/StatelessCartPole-v0")
+    except Exception:
+        gym.register(id="ray_tpu/StatelessCartPole-v0",
+                     entry_point="ray_tpu.rl.envs:StatelessCartPole")
+    try:
+        gym.spec("ray_tpu/RepeatAfterMe-v0")
+    except Exception:
+        gym.register(id="ray_tpu/RepeatAfterMe-v0",
+                     entry_point="ray_tpu.rl.envs:RepeatAfterMeEnv")
 
 
 register_envs()

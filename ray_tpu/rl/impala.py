@@ -16,35 +16,76 @@ from ray_tpu.rl.algorithm import Algorithm
 from ray_tpu.rl.config import AlgorithmConfig
 
 
+def _seq_forward(module, params, batch):
+    """(logits [T,B,A], values [T,B]) for a time-major trajectory batch,
+    recurrent- and conv-aware: feedforward modules flatten time into the
+    batch; recurrent modules re-derive every LSTM state with a scanned
+    unroll from the fragment's initial carry, resetting exactly where
+    the runner's episodes did (connector state discipline)."""
+    import jax
+    import jax.numpy as jnp
+    T, B = batch["actions"].shape
+    if getattr(module, "is_recurrent", False):
+        resets = jnp.concatenate(
+            [jnp.zeros((1, B), jnp.float32), batch["dones"][:-1]], axis=0)
+        carry0 = (batch["initial_state_c"], batch["initial_state_h"])
+        logits, values, _ = module.forward_seq(params, batch["obs"],
+                                               resets, carry0)
+        return logits, values
+    obs = batch["obs"].reshape((T * B,) + batch["obs"].shape[2:])
+    logits, values = module.net.apply({"params": params}, obs)
+    return logits.reshape(T, B, -1), values.reshape(T, B)
+
+
 class ImpalaLearner:
-    """Policy-gradient learner with a V-trace-corrected baseline."""
+    """Policy-gradient learner with a V-trace-corrected baseline.
+    Modules come from the catalog factory, so IMPALA trains MLP, CNN
+    (pixel envs) and LSTM (use_lstm) policies with one loss."""
 
     def __init__(self, config: Dict, obs_dim: int, action_dim: int):
         import jax
-        import jax.numpy as jnp
         import optax
-        from ray_tpu.rl.rl_module import DiscreteRLModule
-        from ray_tpu.rl.vtrace import vtrace
+        from ray_tpu.rl.rl_module import make_rl_module
 
         self.cfg = config
-        self.module = DiscreteRLModule(obs_dim, action_dim,
-                                       config.get("hidden_sizes", (64, 64)),
-                                       seed=config.get("seed", 0))
+        obs_shape = tuple(config.get("obs_shape") or (obs_dim,))
+        action_spec = (config.get("action_spec")
+                       or {"type": "discrete", "n": action_dim})
+        self.module = make_rl_module(
+            obs_shape, action_spec, config.get("hidden_sizes", (64, 64)),
+            seed=config.get("seed", 0),
+            use_lstm=config.get("use_lstm", False))
+        # adam rather than the paper's rmsprop(eps=0.1): at small-batch
+        # scale the 0.1 epsilon floors the preconditioner and crushes the
+        # effective step (no learning on CartPole-size nets); adam's 1e-8
+        # epsilon keeps step sizes honest at every scale
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(config.get("grad_clip", 40.0)),
-            optax.rmsprop(config["lr"], decay=0.99, eps=0.1))
+            optax.adam(config["lr"]))
         self.opt_state = self.optimizer.init(self.module.params)
-        gamma = config["gamma"]
-        vf_coeff = config["vf_loss_coeff"]
-        ent_coeff = config["entropy_coeff"]
-        net = self.module.net
+        loss_fn = self._make_loss()
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, new_opt = self.optimizer.update(grads, opt_state,
+                                                     params)
+            return optax.apply_updates(params, updates), new_opt, loss, aux
+
+        self._update = update
+
+    def _make_loss(self):
+        import jax
+        import jax.numpy as jnp
+        from ray_tpu.rl.vtrace import vtrace
+        gamma = self.cfg["gamma"]
+        vf_coeff = self.cfg["vf_loss_coeff"]
+        ent_coeff = self.cfg["entropy_coeff"]
+        module = self.module
 
         def loss_fn(params, batch):
-            T, B = batch["actions"].shape
-            obs = batch["obs"].reshape((T * B,) + batch["obs"].shape[2:])
-            logits, values = net.apply({"params": params}, obs)
-            logits = logits.reshape(T, B, -1)
-            values = values.reshape(T, B)
+            logits, values = _seq_forward(module, params, batch)
             logp_all = jax.nn.log_softmax(logits)
             tgt_logp = jnp.take_along_axis(
                 logp_all, batch["actions"][..., None], axis=-1)[..., 0]
@@ -59,22 +100,20 @@ class ImpalaLearner:
             return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
                            "entropy": entropy}
 
-        @jax.jit
-        def update(params, opt_state, batch):
-            (loss, aux), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, batch)
-            updates, new_opt = self.optimizer.update(grads, opt_state,
-                                                     params)
-            return optax.apply_updates(params, updates), new_opt, loss, aux
-
-        self._update = update
+        return loss_fn
 
     def update_from_trajectory(self, traj: Dict[str, np.ndarray]) -> Dict:
         import jax.numpy as jnp
         batch = {k: jnp.asarray(v) for k, v in traj.items()
                  if k != "bootstrap_obs"}
-        self.module.params, self.opt_state, loss, aux = self._update(
-            self.module.params, self.opt_state, batch)
+        # num_epochs passes per fragment: V-trace recomputes the
+        # target-policy term each pass, so the rho/c clips absorb the
+        # growing off-policyness — sample efficiency without aggregator
+        # replay (reference IMPALA replays fragments via its aggregator
+        # buffer for the same reason)
+        for _ in range(max(1, int(self.cfg.get("num_epochs", 1)))):
+            self.module.params, self.opt_state, loss, aux = self._update(
+                self.module.params, self.opt_state, batch)
         out = {k: float(v) for k, v in aux.items()}
         out["total_loss"] = float(loss)
         return out
@@ -86,6 +125,8 @@ class ImpalaLearner:
 class IMPALA(Algorithm):
     """Async training_step: learn on fragments as they complete, re-issue
     sampling immediately, sync weights after every learner step."""
+
+    supports_recurrence = True
 
     def __init__(self, config: AlgorithmConfig):
         self._inflight: Dict = {}

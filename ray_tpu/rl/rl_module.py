@@ -191,6 +191,102 @@ class ContinuousRLModule(_ModuleBase):
         return np.clip(actions, self.low, self.high)
 
 
+class LSTMPolicyValueNet(nn.Module):
+    """Single-step recurrent policy/value core (reference:
+    rllib/models/torch/recurrent_net.py LSTMWrapper — encoder → LSTM →
+    categorical/value heads). __call__ is ONE step: (carry, obs[B,D]) ->
+    (carry', (logits, value)); sequence unrolls live OUTSIDE the module
+    as a lax.scan over apply (rl_module.RecurrentDiscreteRLModule), so
+    flax never sees impure scan bodies."""
+    action_dim: int
+    hidden: int = 64
+    embed: int = 64
+
+    @nn.compact
+    def __call__(self, carry, obs):
+        x = nn.tanh(nn.Dense(self.embed)(obs))
+        carry, h = nn.OptimizedLSTMCell(self.hidden)(carry, x)
+        logits = nn.Dense(self.action_dim)(h)
+        value = nn.Dense(1)(h)[..., 0]
+        return carry, (logits, value)
+
+
+class RecurrentDiscreteRLModule(_ModuleBase):
+    """Recurrent (LSTM) module for discrete actions. State contract
+    (reference: rllib connector-managed STATE_IN/STATE_OUT):
+    - env runner: carries (c, h) across steps, zeroing env i's slot when
+      its episode resets (the connector-reset discipline);
+    - learner: receives the fragment's initial carry + per-step done
+      flags and re-derives every intermediate state with a scanned
+      unroll, resetting the carry inside the scan exactly where the
+      runner did.
+    Time-major [T, B, ...] throughout — the IMPALA/APPO batch shape."""
+
+    is_recurrent = True
+    action_np_dtype = np.int64
+    action_event_shape: Tuple[int, ...] = ()
+
+    def __init__(self, obs_dim: int, action_dim: int,
+                 hidden_sizes: Sequence[int] = (64, 64), seed: int = 0):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.hidden = int(hidden_sizes[0]) if hidden_sizes else 64
+        self.net = LSTMPolicyValueNet(action_dim, hidden=self.hidden,
+                                      embed=self.hidden)
+        carry0 = self.initial_state(1)
+        self.params = self.net.init(jax.random.PRNGKey(seed), carry0,
+                                    jnp.zeros((1, obs_dim)))["params"]
+        self._step = jax.jit(
+            lambda p, c, o: self.net.apply({"params": p}, c, o))
+
+        def unroll(params, carry0, obs_seq, resets):
+            """obs_seq [T,B,D], resets [T,B] (1.0 where the episode
+            restarted BEFORE step t) -> (logits [T,B,A], values [T,B],
+            final carry)."""
+            def body(carry, xs):
+                obs, reset = xs
+                carry = jax.tree.map(
+                    lambda c: c * (1.0 - reset)[:, None], carry)
+                carry, out = self.net.apply({"params": params}, carry, obs)
+                return carry, out
+            carry, (logits, values) = jax.lax.scan(
+                body, carry0, (obs_seq, resets))
+            return logits, values, carry
+
+        self._unroll = jax.jit(unroll)
+
+    def initial_state(self, batch_size: int):
+        z = jnp.zeros((batch_size, self.hidden), jnp.float32)
+        return (z, z)
+
+    def sample_actions(self, params, obs, rng, state=None):
+        """One env step: (actions, logp, value, new_state)."""
+        if state is None:
+            state = self.initial_state(len(obs))
+        state, (logits, value) = self._step(params, state, obs)
+        action = jax.random.categorical(rng, logits)
+        logp = jax.nn.log_softmax(logits)
+        logp_a = jnp.take_along_axis(logp, action[:, None], axis=1)[:, 0]
+        return (np.asarray(action), np.asarray(logp_a), np.asarray(value),
+                state)
+
+    def forward_seq(self, params, obs_seq, resets, carry0):
+        """Traceable sequence forward for the learner loss."""
+        return self._unroll(params, carry0, obs_seq, resets)
+
+    def forward(self, params, obs, state=None):
+        if state is None:
+            state = self.initial_state(len(obs))
+        state, (logits, value) = self._step(params, state, obs)
+        return logits, value
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.device_put(weights)
+
+
 def action_spec_of(space) -> Dict:
     """gymnasium space -> serializable action spec."""
     import gymnasium as gym
@@ -204,10 +300,24 @@ def action_spec_of(space) -> Dict:
 
 
 def make_rl_module(obs_shape: Tuple[int, ...], action_spec: Dict,
-                   hidden_sizes: Sequence[int] = (64, 64), seed: int = 0):
+                   hidden_sizes: Sequence[int] = (64, 64), seed: int = 0,
+                   use_lstm: bool = False):
     """Module factory keyed by obs rank + action spec (reference:
-    rllib/core/rl_module/default catalog selection)."""
+    rllib/core/rl_module/default catalog selection; use_lstm mirrors
+    rllib's model_config use_lstm switch)."""
     obs_shape = tuple(obs_shape)
+    if use_lstm:
+        if action_spec["type"] != "discrete":
+            raise ValueError("use_lstm currently supports discrete "
+                             "action spaces")
+        if len(obs_shape) > 1:
+            raise ValueError(
+                f"use_lstm requires flat observations, got shape "
+                f"{obs_shape}; stack a flattening connector or use the "
+                f"CNN module (conv+LSTM is not implemented)")
+        return RecurrentDiscreteRLModule(
+            int(np.prod(obs_shape)), action_spec["n"], hidden_sizes,
+            seed=seed)
     if action_spec["type"] == "discrete":
         if len(obs_shape) == 3:
             return ConvDiscreteRLModule(obs_shape, action_spec["n"],

@@ -17,7 +17,8 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 from ray_tpu.rl.config import AlgorithmConfig
-from ray_tpu.rl.replay_buffer import ReplayBuffer
+from ray_tpu.rl.replay_buffer import (PrioritizedReplayBuffer,
+                                      make_replay_buffer)
 
 LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
 
@@ -209,7 +210,9 @@ class SAC:
         runner_cls = ray_tpu.remote(SacEnvRunner)
         self.env_runners = [runner_cls.remote({**cfg, "runner_index": i})
                             for i in range(config.num_env_runners)]
-        self.buffer = ReplayBuffer(config.replay_capacity, seed=config.seed)
+        self.buffer = make_replay_buffer(config.replay_buffer_config,
+                                         config.replay_capacity,
+                                         seed=config.seed)
         self.policy, self.qnet = make_nets(action_dim,
                                            tuple(config.hidden_sizes))
         k0, k1 = jax.random.split(jax.random.PRNGKey(config.seed))
@@ -238,7 +241,7 @@ class SAC:
         policy, qnet = self.policy, self.qnet
         opt = self.opt
 
-        def q_loss(q_params, state, batch, key):
+        def q_loss(q_params, state, batch, key, weights):
             mean, log_std = policy.apply({"params": state["pi"]},
                                          batch["next_obs"])
             a2, logp2 = squashed_sample(mean, log_std, key)
@@ -250,7 +253,11 @@ class SAC:
             target = jax.lax.stop_gradient(target)
             q1, q2 = qnet.apply({"params": q_params},
                                 batch["obs"], batch["actions"])
-            return ((q1 - target) ** 2 + (q2 - target) ** 2).mean()
+            # per-sample IS weights (prioritized replay; ones = uniform)
+            td = 0.5 * (jnp.abs(q1 - target) + jnp.abs(q2 - target))
+            loss = (weights * ((q1 - target) ** 2
+                               + (q2 - target) ** 2)).mean()
+            return loss, td
 
         def pi_loss(pi_params, state, batch, key):
             mean, log_std = policy.apply({"params": pi_params},
@@ -265,10 +272,10 @@ class SAC:
                     * jax.lax.stop_gradient(logp + target_entropy)).mean()
 
         @jax.jit
-        def update(state, opt_state, batch, key):
+        def update(state, opt_state, batch, key, weights):
             k1, k2 = jax.random.split(key)
-            ql, q_grads = jax.value_and_grad(q_loss)(
-                state["q"], state, batch, k1)
+            (ql, td), q_grads = jax.value_and_grad(q_loss, has_aux=True)(
+                state["q"], state, batch, k1, weights)
             qu, new_q_opt = opt["q"].update(q_grads, opt_state["q"],
                                             state["q"])
             new_q = optax.apply_updates(state["q"], qu)
@@ -291,7 +298,7 @@ class SAC:
             new_opt = {"pi": new_pi_opt, "q": new_q_opt,
                        "alpha": new_a_opt}
             return new_state, new_opt, {"q_loss": ql, "pi_loss": pl,
-                                        "alpha": jnp.exp(new_log_alpha)}
+                                        "alpha": jnp.exp(new_log_alpha)}, td
 
         self._update = update
         self._key = jax.random.PRNGKey(config.seed + 7)
@@ -322,13 +329,20 @@ class SAC:
             steps += len(b["obs"])
         metrics = {}
         if len(self.buffer) >= cfg.minibatch_size:
+            prioritized = isinstance(self.buffer, PrioritizedReplayBuffer)
             n_updates = max(1, int(steps * cfg.updates_per_step))
             for _ in range(n_updates):
                 mb = self.buffer.sample(cfg.minibatch_size)
+                indices = mb.pop("indices", None)
+                weights = mb.pop("weights", None)
+                w = (jnp.asarray(weights) if weights is not None
+                     else jnp.ones(cfg.minibatch_size, jnp.float32))
                 mb = {k: jnp.asarray(v) for k, v in mb.items()}
                 self._key, sub = jax.random.split(self._key)
-                self.state, self.opt_state, metrics = self._update(
-                    self.state, self.opt_state, mb, sub)
+                self.state, self.opt_state, metrics, td = self._update(
+                    self.state, self.opt_state, mb, sub, w)
+                if prioritized:
+                    self.buffer.update_priorities(indices, np.asarray(td))
             metrics = {k: float(v) for k, v in metrics.items()}
         self._sync_runner_weights()
         wall = time.perf_counter() - t0
