@@ -169,6 +169,7 @@ class NodeManager:
             "channel_push": self.h_channel_push,
             "channel_publish": self.h_channel_publish,
             "channel_close": self.h_channel_close,
+            "dump_stacks": self.h_dump_stacks,
             "ping": lambda conn: "pong",
         }
         self.server = rpc.Server(handlers, name=f"nm-{self.node_id[:8]}")
@@ -616,7 +617,8 @@ class NodeManager:
                         pass
 
     # ------------------------------------------------------------ worker pool
-    def _spawn_worker(self) -> WorkerProc:
+    def _spawn_worker(self, proc_env: Optional[Dict] = None,
+                      env_hash: Optional[str] = None) -> WorkerProc:
         env = dict(os.environ)
         env["RAY_TPU_NODE_ID"] = self.node_id
         # a worker never outlives its node manager, detached cluster or
@@ -629,6 +631,14 @@ class NodeManager:
                "--store-path", self.store_path,
                "--node-id", self.node_id,
                "--session-name", self.session_name]
+        if proc_env and proc_env.get("container"):
+            # process-scope runtime env: the worker itself runs inside
+            # the container image (reference: runtime_env/image_uri.py —
+            # worker command under podman run; /tmp/raytpu bind-mount +
+            # host network keep it on the node's data plane)
+            from ray_tpu._private.runtime_env_plugins import \
+                container_command
+            cmd = container_command(proc_env, cmd, env)
         # detach stdio so workers never hold a driver/pytest pipe open;
         # per-worker log files under the session dir are tailed by
         # _log_monitor_loop and published to the driver (reference:
@@ -648,6 +658,10 @@ class NodeManager:
         self._log_files[proc.pid] = [(base + ".out", "stdout", 0),
                                      (base + ".err", "stderr", 0)]
         w = WorkerProc(proc)
+        # tag at SPAWN, not grant: a container worker that registers
+        # into the idle pool before its requester resumes must never be
+        # adoptable as a plain "untagged" worker (and vice versa)
+        w.env_hash = env_hash
         self._spawning += 1
         return w
 
@@ -730,10 +744,13 @@ class NodeManager:
                 pass
 
     async def _obtain_worker(self, timeout: float = 60.0,
-                             env_hash: Optional[str] = None) -> WorkerProc:
+                             env_hash: Optional[str] = None,
+                             proc_env: Optional[Dict] = None) -> WorkerProc:
         """Pop an idle worker compatible with the requested runtime env
         (matching env, or a fresh untagged worker that becomes tagged),
-        spawning a new process if none fits."""
+        spawning a new process if none fits. Process-scope envs
+        (container) can never adopt an untagged worker — the process was
+        not started inside the image — so they match exactly or spawn."""
         while True:
             picked = fallback = None
             for w in list(self._idle):
@@ -743,14 +760,15 @@ class NodeManager:
                 if w.env_hash == env_hash:
                     picked = w          # exact env match wins
                     break
-                if w.env_hash is None and fallback is None:
+                if w.env_hash is None and fallback is None \
+                        and proc_env is None:
                     fallback = w        # untagged: taggable if no match
             picked = picked or fallback
             if picked is not None:
                 self._idle.remove(picked)
                 picked.env_hash = env_hash or picked.env_hash
                 return picked
-            w = self._spawn_worker()
+            w = self._spawn_worker(proc_env, env_hash)
             # temporary key until registration rebinds by worker_id
             self.workers[f"spawn-{w.proc.pid}"] = w
             try:
@@ -782,6 +800,7 @@ class NodeManager:
     async def h_request_lease(self, conn, resources: Dict[str, float],
                               scheduling: Dict, worker_id: str,
                               env_hash: Optional[str] = None,
+                              proc_env: Optional[Dict] = None,
                               spilled: bool = False):
         """Grant a worker lease, queue, or redirect (spillback). A request
         that has already been redirected once is grant-or-queue here — never
@@ -828,7 +847,8 @@ class NodeManager:
                 scheduling_sub(pool_avail, resources)
                 chips = self._allocate_chips(resources)
                 try:
-                    w = await self._obtain_worker(env_hash=env_hash)
+                    w = await self._obtain_worker(env_hash=env_hash,
+                                                  proc_env=proc_env)
                 except RuntimeError as e:
                     self._free_chips.extend(chips)
                     scheduling_addback(pool_avail, resources)
@@ -1037,8 +1057,18 @@ class NodeManager:
         # claim chips atomically with the float accounting (see h_lease)
         scheduling_sub(pool_avail, resources)
         chips = self._allocate_chips(resources)
+        # process-scope env (container): the actor's worker process must
+        # be spawned inside the image — never adopt a plain pooled worker
+        from ray_tpu._private.runtime_env_plugins import (proc_env_of,
+                                                          runtime_env_hash)
+        proc_env = proc_env_of(spec.get("runtime_env"))
+        # same hash scheme as the task-lease path: a pip-only actor can
+        # still adopt (and tag) an untagged worker, a containered one
+        # matches exactly or spawns inside the image
+        env_hash = runtime_env_hash(spec.get("runtime_env"))
         try:
-            w = await self._obtain_worker()
+            w = await self._obtain_worker(env_hash=env_hash,
+                                          proc_env=proc_env)
         except BaseException:
             self._free_chips.extend(chips)
             scheduling_addback(pool_avail, resources)
@@ -1059,6 +1089,25 @@ class NodeManager:
             await self._on_worker_death(w, f"actor init failed: {e}")
             raise RuntimeError(f"actor __init__ failed: {e}")
         return {"worker_address": w.address, "worker_id": w.worker_id}
+
+    async def h_dump_stacks(self, conn):
+        """This node's live Python stacks: the node manager's own
+        threads plus every connected worker's (the `ray_tpu stack` fan-
+        out point; reference: `ray stack` py-spy over local PIDs)."""
+        from ray_tpu._private.proc_util import format_thread_stacks
+        out = {"node_manager": {"pid": os.getpid(),
+                                "stacks": format_thread_stacks()},
+               "workers": {}}
+        for wid, w in list(self.workers.items()):
+            if w.conn is None or w.conn.closed or w.state == "dead":
+                continue
+            try:
+                out["workers"][wid] = await asyncio.wait_for(
+                    w.conn.call("dump_stacks"), 5.0)
+            except Exception as e:
+                out["workers"][wid] = {"error":
+                                       f"{type(e).__name__}: {e}"}
+        return out
 
     async def h_kill_worker(self, conn, worker_id: str, reason: str = ""):
         w = self.workers.get(worker_id)

@@ -102,3 +102,19 @@ def find_session_processes(marker: str) -> Iterable[int]:
                 yield int(pid_s)
         except OSError:
             continue
+
+
+def format_thread_stacks() -> dict:
+    """{thread_name: formatted stack} for every live thread in THIS
+    process — the in-process substrate of `ray_tpu stack` (reference:
+    `ray stack` shells out to py-spy, ray/scripts/scripts.py; here every
+    daemon serves its own frames over RPC, no ptrace needed)."""
+    import sys
+    import threading
+    import traceback
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for ident, frame in sys._current_frames().items():
+        name = names.get(ident, f"thread-{ident}")
+        out[f"{name} ({ident})"] = "".join(traceback.format_stack(frame))
+    return out

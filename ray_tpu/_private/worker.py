@@ -289,6 +289,7 @@ class CoreWorker:
             "remove_borrow": self.h_remove_borrow,
             "object_located": self.h_object_located,
             "exit": self.h_exit,
+            "dump_stacks": self.h_dump_stacks,
             "ping": lambda conn: "pong",
         }
         self.loop = asyncio.get_event_loop()
@@ -309,6 +310,7 @@ class CoreWorker:
                     "free_object": self.h_free_object,
                     "become_actor": self.h_become_actor,
                     "exit": self.h_exit,
+                    "dump_stacks": self.h_dump_stacks,
                 }, name="->node", retries=10)
             await self.node_conn.call(
                 "register_worker", worker_id=self.worker_id,
@@ -1173,14 +1175,17 @@ class CoreWorker:
                     pass   # the executor surfaces the fetch error
 
     def _enqueue_task(self, pt: PendingTask, resources, scheduling):
-        env_hash = self._runtime_env_hash(pt.spec.get("runtime_env"))
+        from ray_tpu._private.runtime_env_plugins import proc_env_of
+        renv = pt.spec.get("runtime_env")
+        env_hash = self._runtime_env_hash(renv)
         sig = self._lease_sig(resources, scheduling, env_hash)
         st = self._sig_queues.get(sig)
         if st is None:
             st = {"queue": __import__("collections").deque(),
                   "dispatchers": 0, "busy": 0, "grants": 0,
                   "resources": resources,
-                  "scheduling": scheduling, "env_hash": env_hash}
+                  "scheduling": scheduling, "env_hash": env_hash,
+                  "proc_env": proc_env_of(renv)}
             self._sig_queues[sig] = st
         st["queue"].append(pt)
         self._maybe_spawn_dispatcher(sig, st)
@@ -1208,7 +1213,7 @@ class CoreWorker:
                 try:
                     lease = await self._acquire_lease(
                         st["resources"], st["scheduling"],
-                        st.get("env_hash"))
+                        st.get("env_hash"), st.get("proc_env"))
                     st["grants"] += 1
                 except Exception as e:
                     if st["queue"]:
@@ -1569,21 +1574,14 @@ class CoreWorker:
 
     @staticmethod
     def _runtime_env_hash(renv) -> Optional[str]:
-        """Workers are pooled per runtime env (reference: WorkerPool keyed
-        by runtime-env hash, worker_pool.h:174): a pip env permanently
-        shapes a worker's sys.path, so such workers are never handed to
-        tasks of other envs."""
-        if not renv or not renv.get("pip"):
-            return None
-        import hashlib
-        pip = renv.get("pip")
-        if isinstance(pip, dict):
-            pip = pip.get("packages") or []
-        return hashlib.sha1("\n".join(sorted(map(str, pip)))
-                            .encode()).hexdigest()[:16]
+        """Worker-pool key (shared scheme with the actor path — see
+        runtime_env_plugins.runtime_env_hash)."""
+        from ray_tpu._private.runtime_env_plugins import runtime_env_hash
+        return runtime_env_hash(renv)
 
     async def _acquire_lease(self, resources: Dict, scheduling: Dict,
-                             env_hash: Optional[str] = None) -> Lease:
+                             env_hash: Optional[str] = None,
+                             proc_env: Optional[Dict] = None) -> Lease:
         sig = self._lease_sig(resources, scheduling, env_hash)
         pool = self._idle_leases.get(sig)
         while pool:
@@ -1597,7 +1595,8 @@ class CoreWorker:
                 resp = await target_conn.call(
                     "request_lease", resources=resources,
                     scheduling=scheduling, worker_id=self.worker_id,
-                    env_hash=env_hash, spilled=addr_chain > 0)
+                    env_hash=env_hash, proc_env=proc_env,
+                    spilled=addr_chain > 0)
             except (rpc.RpcError, rpc.ConnectionLost) as e:
                 # transient control-plane failure (or injected chaos):
                 # back off and retry (reference: retryable lease clients,
@@ -2384,45 +2383,40 @@ class CoreWorker:
         return site
 
     def _apply_runtime_env(self, spec: Dict):
-        """env_vars / working_dir / py_modules / pip for this execution
-        (reference: python/ray/runtime_env/runtime_env.py:152; conda and
-        containers are out of scope for a TPU-host runtime). Runs on the
-        executor thread, so blocking KV fetches and pip installs are
-        safe."""
+        """Worker-scope runtime env for this execution, dispatched
+        through the plugin protocol (reference:
+        python/ray/_private/runtime_env/plugin.py — env_vars /
+        working_dir / py_modules / pip are built-in plugins; user
+        plugins register via RAY_TPU_RUNTIME_ENV_PLUGINS; container is
+        process-scope and was applied by the node manager at spawn).
+        Runs on the executor thread, so blocking KV fetches and pip
+        installs are safe."""
         import sys
+
+        from ray_tpu._private.runtime_env_plugins import \
+            apply_worker_plugins
         renv = spec.get("runtime_env")
         if not renv:
             return None
+        ctx = apply_worker_plugins(renv, self)
         saved: Dict[str, Optional[str]] = {}
-        for k, v in (renv.get("env_vars") or {}).items():
+        for k, v in ctx.env_vars.items():
             saved[k] = os.environ.get(k)
-            os.environ[k] = str(v)
+            os.environ[k] = v
         saved_cwd = None
-        added_paths: List[str] = []
-        wd = renv.get("working_dir")
-        if not wd and renv.get("working_dir_uri"):
-            wd = self._materialize_uri(renv["working_dir_uri"],
-                                       renv.get("working_dir_base", ""))
-        if wd:
+        if ctx.cwd:
             saved_cwd = os.getcwd()
-            os.chdir(wd)
-            sys.path.insert(0, wd)
-            added_paths.append(wd)
-        for uri, base in renv.get("py_modules_uris") or []:
-            root = self._materialize_uri(uri, base)
-            parent = os.path.dirname(root)
-            sys.path.insert(0, parent)
-            added_paths.append(parent)
-        pip_spec = renv.get("pip")
-        if pip_spec:
-            if isinstance(pip_spec, dict):
-                pip_spec = pip_spec.get("packages") or []
-            site = self._ensure_pip_env([str(x) for x in pip_spec])
-            if site not in sys.path:
-                sys.path.insert(0, site)
-            # NOT added_paths: the pip env is permanent for this worker's
-            # life — the node manager only ever reuses it for the same
-            # env hash (reference: per-env worker pools)
+            os.chdir(ctx.cwd)
+        added_paths: List[str] = []
+        for p in ctx.py_paths:
+            sys.path.insert(0, p)
+            added_paths.append(p)
+        for p in ctx.permanent_py_paths:
+            # pip site: permanent for this worker's life — the node
+            # manager only ever reuses it for the same env hash
+            # (reference: per-env worker pools)
+            if p not in sys.path:
+                sys.path.insert(0, p)
         return (saved, saved_cwd, added_paths)
 
     def _restore_runtime_env(self, token):
@@ -2647,6 +2641,29 @@ class CoreWorker:
     async def h_exit(self, conn, reason: str = ""):
         asyncio.get_event_loop().call_later(0.05, os._exit, 0)
         return True
+
+    def h_dump_stacks(self, conn):
+        """Live Python stacks of every thread in this worker (the
+        `ray_tpu stack` data plane; reference: `ray stack` via py-spy —
+        here each process serves its own frames, no ptrace)."""
+        from ray_tpu._private.proc_util import format_thread_stacks
+        return {"pid": os.getpid(), "mode": self.mode,
+                "stacks": format_thread_stacks()}
+
+    async def dump_cluster_stacks_async(self) -> Dict[str, Any]:
+        """node_id -> {node_manager: ..., workers: {worker_id: ...}} for
+        every alive node (fans out through each node manager)."""
+        out: Dict[str, Any] = {}
+        nodes = await self.gcs_call_async("get_all_nodes")
+        for n in nodes:
+            if not n.get("alive"):
+                continue
+            try:
+                out[n["node_id"]] = await asyncio.wait_for(
+                    self.pool.call(n["address"], "dump_stacks"), 15.0)
+            except Exception as e:
+                out[n["node_id"]] = {"error": f"{type(e).__name__}: {e}"}
+        return out
 
     # ------------------------------------------------------------- utilities
     def as_future(self, ref: ObjectRef) -> concurrent.futures.Future:

@@ -1,0 +1,20 @@
+"""Test-support runtime-env plugin (the xlang_demo pattern: a tiny
+importable module so worker processes can load cross-process test
+targets). Exercised by tests/test_runtime_env_plugins.py via
+RAY_TPU_RUNTIME_ENV_PLUGINS=ray_tpu.util.testing_plugins:TokenPlugin."""
+
+from __future__ import annotations
+
+from ray_tpu._private.runtime_env_plugins import RuntimeEnvPlugin
+
+
+class TokenPlugin(RuntimeEnvPlugin):
+    """Owns the runtime_env key "token": exports its value (plus proof
+    it saw the full env dict) into the task's environment."""
+
+    name = "token"
+    priority = 5     # before env_vars: explicit env_vars must win
+
+    def setup(self, value, renv, ctx, worker):
+        ctx.env_vars["TOKEN_PLUGIN_VALUE"] = str(value)
+        ctx.env_vars["TOKEN_PLUGIN_SAW_KEYS"] = ",".join(sorted(renv))

@@ -1,0 +1,181 @@
+"""Runtime-env plugin protocol + container env kind (reference:
+python/ray/_private/runtime_env/plugin.py — plugin-dispatched setup;
+runtime_env/image_uri.py — worker under `podman run`).
+
+The container e2e runs against a stub container runtime (a script that
+parses `podman run` flags, applies --env, and execs the worker command)
+injected via RAY_TPU_CONTAINER_RUNTIME — the standard way to test
+container integration without a container daemon: every line of OUR
+plumbing (lease proc_env, worker-pool isolation, spawn wrapper, env
+forwarding) runs for real; only the containerization syscall layer is
+simulated."""
+
+import os
+import stat
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.runtime_env_plugins import (RuntimeEnvContext,
+                                                  apply_worker_plugins,
+                                                  container_command,
+                                                  proc_env_of,
+                                                  register_plugin,
+                                                  unregister_plugin)
+
+pytestmark = pytest.mark.slow
+
+
+# ------------------------------------------------------------ unit tests
+def test_proc_env_extraction():
+    assert proc_env_of(None) is None
+    assert proc_env_of({"pip": ["x"]}) is None
+    assert proc_env_of({"container": "img:1"}) == \
+        {"container": {"image": "img:1"}}
+    assert proc_env_of({"image_uri": "img:2"}) == \
+        {"container": {"image": "img:2"}}
+    assert proc_env_of({"container": {"image": "img:3",
+                                      "run_options": ["--gpus all"]}}) \
+        == {"container": {"image": "img:3", "run_options": ["--gpus all"]}}
+
+
+def test_container_command_shape():
+    cmd = container_command(
+        {"container": {"image": "img:1", "run_options": ["--shm-size 1g"]}},
+        ["python", "-m", "w"],
+        {"RAY_TPU_NODE_ID": "n1", "HOME": "/root", "PATH": "/usr/bin"})
+    runtime = os.environ.get("RAY_TPU_CONTAINER_RUNTIME", "podman")
+    assert cmd[0] == runtime and cmd[1] == "run"
+    assert "--network=host" in cmd and "--rm" in cmd
+    assert "-v" in cmd
+    assert "--env" in cmd
+    envs = [cmd[i + 1] for i, a in enumerate(cmd) if a == "--env"]
+    assert "RAY_TPU_NODE_ID=n1" in envs
+    assert not any(e.startswith("HOME=") for e in envs)   # no host leakage
+    img = cmd.index("img:1")
+    assert cmd[img - 2:img] == ["--shm-size", "1g"]
+    assert cmd[img + 1:] == ["python", "-m", "w"]
+
+
+def test_plugin_priority_and_dispatch():
+    calls = []
+
+    class A:
+        name, priority = "aaa", 60
+
+        def setup(self, value, renv, ctx, worker):
+            calls.append(("aaa", value))
+
+    class B:
+        name, priority = "bbb", 1
+
+        def setup(self, value, renv, ctx, worker):
+            calls.append(("bbb", value))
+            ctx.env_vars["BBB"] = str(value)
+
+    register_plugin(A())
+    register_plugin(B())
+    try:
+        ctx = apply_worker_plugins({"aaa": 1, "bbb": 2, "unknown": 3},
+                                   worker=None)
+        assert calls == [("bbb", 2), ("aaa", 1)]   # priority order
+        assert ctx.env_vars["BBB"] == "2"
+        assert isinstance(ctx, RuntimeEnvContext)
+    finally:
+        unregister_plugin("aaa")
+        unregister_plugin("bbb")
+
+
+# ------------------------------------------------------------- e2e tests
+@pytest.fixture()
+def plugin_cluster(tmp_path, monkeypatch):
+    """Cluster whose workers load the TokenPlugin and whose node manager
+    spawns container workers through the stub runtime."""
+    stub = tmp_path / "fake-podman"
+    stub.write_text(textwrap.dedent(f"""\
+        #!{sys.executable}
+        import os, sys
+        args = sys.argv[1:]
+        assert args[0] == "run", args
+        i, envs, mounts = 1, [], []
+        while i < len(args):
+            a = args[i]
+            if a in ("--rm", "--network=host"):
+                i += 1
+            elif a == "-v":
+                mounts.append(args[i + 1]); i += 2
+            elif a == "--env":
+                envs.append(args[i + 1]); i += 2
+            else:
+                break
+        image, cmd = args[i], args[i + 1:]
+        for e in envs:
+            k, _, v = e.partition("=")
+            os.environ[k] = v
+        os.environ["IN_FAKE_CONTAINER"] = image
+        os.environ["FAKE_MOUNTS"] = ";".join(mounts)
+        os.execvp(cmd[0], cmd)
+        """))
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("RAY_TPU_CONTAINER_RUNTIME", str(stub))
+    monkeypatch.setenv("RAY_TPU_RUNTIME_ENV_PLUGINS",
+                       "ray_tpu.util.testing_plugins:TokenPlugin")
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_user_plugin_dispatched_in_worker(plugin_cluster):
+    @ray_tpu.remote
+    def probe():
+        return (os.environ.get("TOKEN_PLUGIN_VALUE"),
+                os.environ.get("TOKEN_PLUGIN_SAW_KEYS"),
+                os.environ.get("EXPLICIT"))
+
+    got = ray_tpu.get(probe.options(runtime_env={
+        "token": "t-42", "env_vars": {"EXPLICIT": "yes"}}).remote(),
+        timeout=60)
+    assert got == ("t-42", "env_vars,token", "yes")
+    # restored after the task: a plain task on the same pool sees nothing
+    got2 = ray_tpu.get(probe.remote(), timeout=60)
+    assert got2 == (None, None, None)
+
+
+def test_container_worker_e2e(plugin_cluster):
+    @ray_tpu.remote
+    def where():
+        return (os.environ.get("IN_FAKE_CONTAINER"), os.getpid(),
+                os.environ.get("FAKE_MOUNTS"))
+
+    image, pid_c, mounts = ray_tpu.get(
+        where.options(runtime_env={"container": {"image": "tpu/img:9"}})
+        .remote(), timeout=120)
+    assert image == "tpu/img:9"
+    assert "/tmp/raytpu:/tmp/raytpu" in (mounts or "")
+    # plain tasks stay on uncontained workers (pool isolation both ways)
+    image2, pid_p, _ = ray_tpu.get(where.remote(), timeout=60)
+    assert image2 is None and pid_p != pid_c
+    # same container env reuses the pooled containered worker
+    image3, pid_c2, _ = ray_tpu.get(
+        where.options(runtime_env={"container": {"image": "tpu/img:9"}})
+        .remote(), timeout=120)
+    assert image3 == "tpu/img:9" and pid_c2 == pid_c
+    # a different image is a different process
+    image4, pid_c3, _ = ray_tpu.get(
+        where.options(runtime_env={"container": {"image": "tpu/img:10"}})
+        .remote(), timeout=120)
+    assert image4 == "tpu/img:10" and pid_c3 not in (pid_c, pid_p)
+
+
+def test_container_actor_e2e(plugin_cluster):
+    @ray_tpu.remote
+    class Boxed:
+        def image(self):
+            return os.environ.get("IN_FAKE_CONTAINER")
+
+    a = Boxed.options(
+        runtime_env={"container": {"image": "tpu/actor-img:1"}}).remote()
+    assert ray_tpu.get(a.image.remote(), timeout=120) == "tpu/actor-img:1"
+    ray_tpu.kill(a)
