@@ -86,8 +86,9 @@ class Dataset:
                                 batch_format="pandas")
 
     def select_columns(self, cols: List[str]) -> "Dataset":
-        return self.map_batches(lambda df: df[list(cols)],
-                                batch_format="pandas")
+        # first-class ProjectStage: the optimizer pushes it into
+        # column-prunable reads (execution._pushdown_projection)
+        return self._extend(exe.ProjectStage(cols))
 
     def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
         return self.map_batches(lambda df: df.rename(columns=dict(mapping)),

@@ -112,6 +112,10 @@ def _apply_one(fn_kind: str, fn, block, batch_format: str,
         for r in B.block_to_rows(block):
             rows.extend(fn(r, *fn_args, **(fn_kwargs or {})))
         return B.block_from_rows(rows)
+    if fn_kind == "select_columns":
+        # arrow-native projection: no row/pandas materialization
+        # (fn carries the column list — ProjectStage)
+        return block.select(fn_args[0])
     raise ValueError(fn_kind)
 
 
@@ -356,6 +360,44 @@ class MapStage(Stage):
                 # order; later tasks keep running in the window); bytes
                 # stay put
                 yield (block_ref, ray_tpu.get(meta_ref))
+
+
+class ProjectStage(MapStage):
+    """Column projection (`select_columns`) as a first-class stage so
+    the optimizer can SEE it: _pushdown_projection rebinds
+    column-prunable read fns (parquet) to fetch only these columns
+    (reference: logical/rules — projection pushdown into the
+    datasource). The stage itself still runs as an ordinary fused map:
+    it is the exact cut when the source can't prune."""
+
+    def __init__(self, columns):
+        self.columns = list(columns)
+        super().__init__("select_columns", None, fn_args=(self.columns,))
+
+
+def _pushdown_projection(stages: List[Stage]) -> List[Stage]:
+    """Rebind a ReadStage's column-prunable read fns when a
+    ProjectStage follows it with only limits in between — the read then
+    never materializes the dropped columns. Sound only for that shape:
+    an arbitrary UDF between read and project may consume columns the
+    projection drops. Only the FIRST projection of a chain pushes down
+    (it names the widest set that chain may reference; narrower chained
+    selects still prune their subset downstream — pushing a later,
+    narrower one would starve the earlier select of its columns)."""
+    out = list(stages)
+    for i, s in enumerate(out):
+        if not isinstance(s, ReadStage):
+            continue
+        if not all(hasattr(fn, "with_columns") for fn in s.read_fns):
+            continue
+        j = i + 1
+        while j < len(out) and isinstance(out[j], LimitStage):
+            j += 1
+        if j < len(out) and isinstance(out[j], ProjectStage):
+            out[i] = ReadStage([fn.with_columns(out[j].columns)
+                                for fn in s.read_fns],
+                               max_in_flight=s.max_in_flight)
+    return out
 
 
 class ActorPoolMapStage(Stage):
@@ -632,8 +674,9 @@ def _pushdown_limits(stages: List[Stage]) -> List[Stage]:
         s = out[i]
         if isinstance(s, LimitStage):
             j = i
-            while j > 0 and type(out[j - 1]) is MapStage \
-                    and all(k == "map" for k, *_ in out[j - 1].ops):
+            while j > 0 and isinstance(out[j - 1], MapStage) \
+                    and all(k in ("map", "select_columns")
+                            for k, *_ in out[j - 1].ops):
                 j -= 1
             if j < i:
                 out.insert(j, LimitStage(s.limit))
@@ -648,10 +691,12 @@ def optimize_plan(stages: List[Stage]) -> List[Stage]:
     2. fuse adjacent task-pool map-family stages so a .map().filter()
        chain pays ONE object-store round trip per block
        (operator_fusion.py). Actor-pool/all-to-all stages are barriers."""
+    stages = _pushdown_projection(stages)
     stages = _pushdown_limits(stages)
     out: List[Stage] = []
     for s in stages:
-        if (out and type(s) is MapStage and type(out[-1]) is MapStage):
+        if (out and isinstance(s, MapStage) and isinstance(out[-1],
+                                                           MapStage)):
             out[-1] = MapStage.fused(out[-1], s)
         else:
             out.append(s)
