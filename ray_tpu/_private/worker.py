@@ -2642,6 +2642,23 @@ class CoreWorker:
         asyncio.get_event_loop().call_later(0.05, os._exit, 0)
         return True
 
+    def object_locations(self, refs) -> List[Optional[str]]:
+        """Best-effort node ids for locally-known objects: owned refs
+        carry the executor-reported primary location; store-resident
+        objects are here. None = unknown (no cluster query — this is the
+        cheap path locality-aware dealing needs, reference:
+        RefBundle.get_cached_location)."""
+        out: List[Optional[str]] = []
+        for r in refs:
+            entry = self.owned.get(r.id)
+            if entry is not None and entry.get("location"):
+                out.append(entry["location"])
+            elif self.store is not None and self.store.contains(r.id):
+                out.append(self.node_id)
+            else:
+                out.append(None)
+        return out
+
     def h_dump_stacks(self, conn):
         """Live Python stacks of every thread in this worker (the
         `ray_tpu stack` data plane; reference: `ray stack` via py-spy —

@@ -31,11 +31,16 @@ _QUEUE_CAP = 16             # bundles per consumer before backpressure
 
 
 class _SplitCoordinator:
-    """Actor: executes the plan lazily and deals bundles row-balanced."""
+    """Actor: executes the plan lazily and deals bundles row-balanced,
+    preferring the consumer on the block's node when locality hints are
+    given (reference: OutputSplitter.locality_hints — locality wins only
+    within a bounded row-imbalance slack, so it can never starve a
+    remote consumer)."""
 
     RETAIN = 4   # handed-out bundles pinned until the consumer's next pull
 
-    def __init__(self, stages, n: int, equal: bool):
+    def __init__(self, stages, n: int, equal: bool,
+                 locality_hints: Optional[List[Optional[str]]] = None):
         from ray_tpu.data import execution as exe
         self._n = n
         self._equal = equal
@@ -47,23 +52,63 @@ class _SplitCoordinator:
         # borrow with us (the owner) only after deserializing the reply,
         # so dropping our copy at hand-off would free the block under it
         self._handed = [collections.deque() for _ in range(n)]
+        self._hints = list(locality_hints or [])
+        self._locality_hits = 0
+        self._locality_total = 0
         self._done = False
         self._trimmed = False
 
+    def _locate(self, ref) -> Optional[str]:
+        """Node id of a block this coordinator owns (cheap local read —
+        experimental.object_locations plane)."""
+        try:
+            from ray_tpu._private.worker import global_worker
+            return global_worker.core.object_locations([ref])[0]
+        except Exception:
+            return None
+
+    def _pick_dest(self, bundle) -> int:
+        balanced = min(range(self._n), key=lambda i: self._rows_dealt[i])
+        if not self._hints:
+            return balanced
+        self._locality_total += 1
+        loc = self._locate(bundle[0])
+        if loc is None:
+            return balanced
+        local = [i for i in range(self._n) if self._hints[i] == loc]
+        if not local:
+            return balanced
+        # locality wins within a slack of a few bundles' worth of rows;
+        # beyond that, row balance takes over (a hot node must not
+        # accumulate the whole stream)
+        slack = 4 * max(1, bundle[1].num_rows)
+        cand = min(local, key=lambda i: self._rows_dealt[i])
+        if self._rows_dealt[cand] - self._rows_dealt[balanced] <= slack:
+            self._locality_hits += 1
+            return cand
+        return balanced
+
     # ------------------------------------------------------------ dealing
+    _pending = None      # (bundle, dest) parked on a full queue
+
     def _advance(self):
         """Pull one bundle from the stream and deal it. Returns True on
         progress, False at end of stream, None when blocked on a full
-        queue (backpressure: the caller returns a wait sentinel)."""
+        queue (backpressure: the chosen consumer's full queue stalls the
+        whole stream — bundles are never re-routed around a laggard,
+        which would break row balance)."""
         if self._done:
             return False
-        dest = min(range(self._n), key=lambda i: self._rows_dealt[i])
+        if self._pending is None:
+            bundle = next(self._stream, None)
+            if bundle is None:
+                self._done = True
+                return False
+            self._pending = (bundle, self._pick_dest(bundle))
+        bundle, dest = self._pending
         if len(self._queues[dest]) >= _QUEUE_CAP:
             return None
-        bundle = next(self._stream, None)
-        if bundle is None:
-            self._done = True
-            return False
+        self._pending = None
         self._queues[dest].append(bundle)
         self._rows_dealt[dest] += bundle[1].num_rows
         return True
@@ -173,6 +218,11 @@ class _SplitCoordinator:
     def rows_delivered(self) -> List[int]:
         return list(self._rows_handed)
 
+    def locality_stats(self):
+        """(locality_hits, bundles_dealt_with_hints) — observability for
+        the locality-aware dealing path."""
+        return (self._locality_hits, self._locality_total)
+
     def ping(self):
         return True
 
@@ -216,9 +266,16 @@ class DataIterator:
 def streaming_split(dataset, n: int, *, equal: bool = False,
                     locality_hints=None) -> List[DataIterator]:
     """Split `dataset`'s output stream across n consumers.
-    ``locality_hints`` is accepted for API parity and currently unused
-    (single-coordinator dealing has no per-node placement)."""
+    ``locality_hints``: optional node id per consumer — bundles whose
+    block already lives on a hinted node deal to that consumer (within a
+    bounded row-imbalance slack), so train workers read their shards
+    from local shm instead of pulling cross-node (reference:
+    OutputSplitter locality_hints via actor node ids)."""
+    if locality_hints is not None and len(locality_hints) != n:
+        raise ValueError(
+            f"locality_hints must have one entry per consumer: got "
+            f"{len(locality_hints)} hints for n={n}")
     coord_cls = ray_tpu.remote(num_cpus=0.1)(_SplitCoordinator)
-    coord = coord_cls.remote(dataset._stages, n, equal)
+    coord = coord_cls.remote(dataset._stages, n, equal, locality_hints)
     ray_tpu.get(coord.ping.remote(), timeout=120)
     return [DataIterator(coord, i) for i in range(n)]

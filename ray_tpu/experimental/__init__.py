@@ -11,4 +11,16 @@ def broadcast_object(ref, node_ids):
     return _w.global_worker.broadcast(ref, node_ids)
 
 
-__all__ = ["Channel", "ReaderView", "broadcast_object"]
+def object_locations(refs):
+    """Best-effort node ids for locally-known objects (owned refs carry
+    their executor-reported location; store-resident objects are local).
+    None entries = unknown. Reference: the cached-location plane
+    RefBundle/OutputSplitter locality dealing reads."""
+    import ray_tpu._private.worker as _w
+    if _w.global_worker is None:
+        raise RuntimeError("ray_tpu.init() first")
+    return _w.global_worker.core.object_locations(refs)
+
+
+__all__ = ["Channel", "ReaderView", "broadcast_object",
+           "object_locations"]

@@ -1,5 +1,5 @@
 from ray_tpu.models.transformer import (TransformerConfig, TransformerLM,
-                                        count_params)
+                                        count_params, init_cache)
 
 MODEL_REGISTRY = {
     "llama-debug": TransformerConfig(
@@ -51,5 +51,7 @@ MODEL_REGISTRY = {
         d_ff=14336, max_seq_len=4096, n_experts=8, expert_top_k=2),
 }
 
+from ray_tpu.models.generate import make_generate_fn
+
 __all__ = ["TransformerConfig", "TransformerLM", "MODEL_REGISTRY",
-           "count_params"]
+           "count_params", "init_cache", "make_generate_fn"]

@@ -117,11 +117,41 @@ def rope(x, positions, theta: float):
     return out.astype(x.dtype)
 
 
+def _cached_attention(q, k_cache, v_cache, q_pos0):
+    """Decode-path attention against a padded KV cache.
+
+    q [B,S,H,D] are the S newest positions (absolute start q_pos0);
+    caches [B,M,Hkv,D] already contain the new keys/values written at
+    [q_pos0, q_pos0+S). Mask: query i attends cache slots j <= q_pos0+i
+    (causal over absolute positions; padded tail masked out). Plain dot-
+    product in fp32 — decode is bandwidth-bound on the cache read, not
+    MXU-bound, so there is nothing for the flash kernel to win here."""
+    B, S, H, D = q.shape
+    M, Hkv = k_cache.shape[1], k_cache.shape[2]
+    # GQA via grouped einsum against the UNEXPANDED cache: a repeat of
+    # k/v would multiply exactly the HBM read this path is bound by
+    G = H // Hkv
+    qg = q.reshape(B, S, Hkv, G, D).astype(jnp.float32)
+    scores = jnp.einsum("bshgd,bmhd->bhgsm", qg,
+                        k_cache.astype(jnp.float32)) / jnp.sqrt(float(D))
+    qpos = q_pos0 + jnp.arange(S)
+    mask = jnp.arange(M)[None, :] <= qpos[:, None]          # [S, M]
+    scores = jnp.where(mask[None, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgsm,bmhd->bshgd", probs,
+                     v_cache.astype(jnp.float32))
+    return out.reshape(B, S, H, D).astype(q.dtype)
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, cache=None):
+        """cache=None: training/prefill forward (flash/ring dispatch),
+        returns out. cache=(k_cache, v_cache, idx): serving decode —
+        writes this call's K/V at [idx, idx+L), attends against the
+        cache, returns (out, (k_cache', v_cache'))."""
         cfg = self.cfg
         B, L, E = x.shape
         H, Hkv, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -134,14 +164,22 @@ class Attention(nn.Module):
         v = dense((Hkv, D), ("embed", "kv_heads", "head_dim"), "v")(x)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        out = attention_dispatch(q, k, v, causal=True,
-                                 impl=cfg.attention_impl)
         proj = nn.DenseGeneral(
             E, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="o",
             kernel_init=_p(nn.initializers.lecun_normal(),
                            "heads", "head_dim", "embed"))
-        return proj(out)
+        if cache is None:
+            out = attention_dispatch(q, k, v, causal=True,
+                                     impl=cfg.attention_impl)
+            return proj(out)
+        k_cache, v_cache, idx = cache
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, idx, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, idx, 0, 0))
+        out = _cached_attention(q, k_cache, v_cache, idx)
+        return proj(out), (k_cache, v_cache)
 
 
 class MLP(nn.Module):
@@ -164,16 +202,23 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, cache=None):
         cfg = self.cfg
-        h = x + Attention(cfg, name="attn")(
-            RMSNorm(cfg.norm_eps, cfg.dtype, name="attn_norm")(x), positions)
+        att = Attention(cfg, name="attn")(
+            RMSNorm(cfg.norm_eps, cfg.dtype, name="attn_norm")(x),
+            positions, cache)
+        new_cache = None
+        if cache is not None:
+            att, new_cache = att
+        h = x + att
         normed = RMSNorm(cfg.norm_eps, cfg.dtype, name="mlp_norm")(h)
         if cfg.n_experts > 0:
             from ray_tpu.models.moe import MoEMLP
             y, aux = MoEMLP(cfg, name="moe")(normed)
         else:
             y, aux = MLP(cfg, name="mlp")(normed), jnp.zeros((), jnp.float32)
+        if cache is not None:
+            return h + y, aux, new_cache
         return h + y, aux
 
 
@@ -196,11 +241,37 @@ class ScanBlock(nn.Module):
         return out, aux
 
 
+class DecodeScanBlock(nn.Module):
+    """Scan body for the serving decode path: the layer's KV cache
+    rides as a scanned input (axis 0 = layers) and the updated cache
+    comes back in the ys. Param names mirror ScanBlock ('block' under
+    the scan) so the SAME trained/stacked params apply."""
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, carry, cache_kv):
+        x, positions, idx = carry
+        out, _aux, new_cache = Block(self.cfg, name="block")(
+            x, positions, (cache_kv[0], cache_kv[1], idx))
+        return (out, positions, idx), new_cache
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
+               dtype=None):
+    """Fresh KV cache pytree: {'k','v': [n_layers,B,max_len,Hkv,D],
+    'idx': next write position (scalar int32)}."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+            "idx": jnp.zeros((), jnp.int32)}
+
+
 class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None, return_hidden=False):
+    def __call__(self, tokens, positions=None, return_hidden=False,
+                 cache=None):
         """return_hidden=True skips the unembed projection and returns the
         final-norm hidden states [B,L,d] — callers (train_step's chunked
         cross-entropy) then compute logits a block at a time so the
@@ -208,7 +279,13 @@ class TransformerLM(nn.Module):
         cfg = self.cfg
         B, L = tokens.shape
         if positions is None:
-            positions = jnp.broadcast_to(jnp.arange(L)[None, :], (B, L))
+            if cache is not None:
+                # decode: tokens continue at the cache's write position
+                positions = cache["idx"] + jnp.broadcast_to(
+                    jnp.arange(L)[None, :], (B, L))
+            else:
+                positions = jnp.broadcast_to(jnp.arange(L)[None, :],
+                                             (B, L))
         embed = self.param(
             "embed",
             _p(nn.initializers.normal(0.02), "vocab", "embed_lookup"),
@@ -220,6 +297,10 @@ class TransformerLM(nn.Module):
         # conflict with an involuntary full rematerialization
         from ray_tpu.parallel.sharding import constrain
         x = constrain(x, ("batch", "seq", None))
+        if cache is not None:
+            return self._decode(x, positions, cache, embed, return_hidden)
+
+        # (training/prefill path continues below)
 
         policies = {
             "nothing": jax.checkpoint_policies.nothing_saveable,
@@ -264,19 +345,63 @@ class TransformerLM(nn.Module):
                      init_fn=lambda: jnp.zeros((), jnp.float32))
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
         x = constrain(x, ("batch", "seq", None))
+        unembed = None if cfg.tie_embeddings else self._unembed_param()
+        if return_hidden:
+            return x
+        return self._logits(x, embed, unembed)
+
+    def _unembed_param(self):
+        cfg = self.cfg
+        return self.param(
+            "unembed",
+            _p(nn.initializers.normal(0.02), "embed_lookup", "vocab"),
+            (cfg.d_model, cfg.vocab_size), cfg.param_dtype)
+
+    def _logits(self, x, embed, unembed):
+        """Shared output head (training/prefill AND decode): final-norm
+        hidden -> vocab logits, honoring tie_embeddings/logits_fp32."""
+        cfg = self.cfg
         if cfg.tie_embeddings:
-            if return_hidden:
-                return x
             logits = jnp.einsum("bld,vd->blv", x, embed.astype(cfg.dtype))
         else:
-            out = self.param(
-                "unembed",
-                _p(nn.initializers.normal(0.02), "embed_lookup", "vocab"),
-                (cfg.d_model, cfg.vocab_size), cfg.param_dtype)
-            if return_hidden:
-                return x
-            logits = jnp.einsum("bld,dv->blv", x, out.astype(cfg.dtype))
+            logits = jnp.einsum("bld,dv->blv", x,
+                                unembed.astype(cfg.dtype))
         return logits.astype(jnp.float32) if cfg.logits_fp32 else logits
+
+    def _decode(self, x, positions, cache, embed, return_hidden):
+        """Serving decode forward: applies every layer against the KV
+        cache and returns (logits|hidden, new_cache). Shares the
+        training param tree — the decode scan mirrors ScanBlock's
+        naming ('layers'/'block')."""
+        cfg = self.cfg
+        L = x.shape[1]
+        idx = cache["idx"]
+        if cfg.scan_layers:
+            stack = nn.scan(
+                DecodeScanBlock,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=0,
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")
+            (x, _, _), (k_new, v_new) = stack((x, positions, idx),
+                                              (cache["k"], cache["v"]))
+        else:
+            ks, vs = [], []
+            for i in range(cfg.n_layers):
+                x, _aux, (k_i, v_i) = Block(cfg, name=f"layer_{i}")(
+                    x, positions, (cache["k"][i], cache["v"][i], idx))
+                ks.append(k_i)
+                vs.append(v_i)
+            k_new = jnp.stack(ks)
+            v_new = jnp.stack(vs)
+        new_cache = {"k": k_new, "v": v_new, "idx": idx + L}
+        x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
+        if return_hidden:
+            return x, new_cache
+        unembed = None if cfg.tie_embeddings else self._unembed_param()
+        return self._logits(x, embed, unembed), new_cache
 
 
 def count_params(params) -> int:

@@ -51,6 +51,10 @@ class TrainWorker:
         from ray_tpu._private.rpc import node_ip_address
         return node_ip_address()
 
+    def get_node_id(self):
+        from ray_tpu._private.worker import global_worker
+        return global_worker.core.node_id
+
     def setup_jax_distributed(self, group_name: str, world_size: int,
                               rank: int):
         # rank 0 binds a free port on ITS host and publishes via GCS KV
@@ -172,11 +176,26 @@ class BackendExecutor:
         split_names = getattr(data_config, "datasets_to_split", "all") \
             if data_config is not None else "all"
         n = len(self.workers)
+        # locality hints (fetched lazily, once, only if a split happens):
+        # bundles already resident on a worker's node deal to that
+        # worker (split.py locality-aware dealing)
+        hints_box: List = []
+
+        def _hints():
+            if not hints_box:
+                try:
+                    hints_box.append(ray_tpu.get(
+                        [w.get_node_id.remote() for w in self.workers],
+                        timeout=60))
+                except Exception:
+                    hints_box.append(None)
+            return hints_box[0]
+
         per_worker = {i: {} for i in range(n)}
         for name, ds in datasets.items():
             split = split_names == "all" or name in split_names
             if split and n > 1:
-                shards = streaming_split(ds, n)
+                shards = streaming_split(ds, n, locality_hints=_hints())
                 for i in range(n):
                     per_worker[i][name] = shards[i]
             else:

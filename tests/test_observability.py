@@ -105,11 +105,18 @@ def test_cluster_stack_dump(ray_start):
             return True
 
     a = Sleeper.remote()
-    ref = a.nap.remote(8.0)
-    time.sleep(1.0)     # let the nap start
-    dump = cluster_stacks()
-    assert dump, "no nodes in stack dump"
-    text = format_cluster_stacks(dump)
+    ref = a.nap.remote(20.0)
+    # retry until the nap frame is visible: under load the actor may
+    # take several seconds to construct and enter the method
+    text = ""
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        dump = cluster_stacks()
+        assert dump, "no nodes in stack dump"
+        text = format_cluster_stacks(dump)
+        if "nap" in text and "_t.sleep(s)" in text:
+            break
+        time.sleep(1.0)
     # the actor's sleeping frame is visible somewhere in the cluster
     assert "nap" in text and "_t.sleep(s)" in text
     # the node manager's own threads are present
