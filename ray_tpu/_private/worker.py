@@ -920,8 +920,28 @@ class CoreWorker:
         # than silently collapsed (ray.wait raises on duplicate refs)
         if len({r.id for r in refs}) != len(refs):
             raise ValueError("wait() expects a list of distinct ObjectRefs")
-        pending = {self._spawn(self._resolve(r)): r for r in refs}
-        ready_ids = set()
+        # fast path: a LOCALLY-materialized entry ("wire" bytes in
+        # memory / "shm" in the local store) means wait's fetch-local
+        # contract is already satisfied — no resolve coroutine per ref,
+        # which at wait([1000 ready refs]) is the whole cost (one task
+        # spawn + value deserialization each). "loc" entries (value
+        # lives on ANOTHER node) still go through resolve: declaring
+        # them ready would skip the local fetch (and any lineage
+        # reconstruction if that node died) that ray.wait's default
+        # fetch_local=True promises.
+        def _local(entry):
+            return entry is not None and entry[0] in ("wire", "shm")
+
+        ready_ids = {r.id for r in refs
+                     if _local(self.memory_store.get(r.id))}
+        if len(ready_ids) >= num_returns or len(ready_ids) == len(refs):
+            ready_in_order = [r for r in refs
+                              if r.id in ready_ids][:num_returns]
+            taken = {r.id for r in ready_in_order}
+            return (ready_in_order,
+                    [r for r in refs if r.id not in taken])
+        pending = {self._spawn(self._resolve(r)): r for r in refs
+                   if r.id not in ready_ids}
         deadline = None if timeout is None else time.monotonic() + timeout
         while pending and len(ready_ids) < num_returns:
             tmo = None if deadline is None else max(0, deadline - time.monotonic())

@@ -55,6 +55,7 @@ class _SplitCoordinator:
         self._hints = list(locality_hints or [])
         self._locality_hits = 0
         self._locality_total = 0
+        self._pending = None     # (bundle, dest) parked on a full queue
         self._done = False
         self._trimmed = False
 
@@ -89,8 +90,6 @@ class _SplitCoordinator:
         return balanced
 
     # ------------------------------------------------------------ dealing
-    _pending = None      # (bundle, dest) parked on a full queue
-
     def _advance(self):
         """Pull one bundle from the stream and deal it. Returns True on
         progress, False at end of stream, None when blocked on a full

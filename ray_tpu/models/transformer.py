@@ -178,7 +178,16 @@ class Attention(nn.Module):
             k_cache, k.astype(k_cache.dtype), (0, idx, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(
             v_cache, v.astype(v_cache.dtype), (0, idx, 0, 0))
-        out = _cached_attention(q, k_cache, v_cache, idx)
+        if L > 1:
+            # prefill (L is static): the block attends only within
+            # itself, so the fused flash/ring kernel computes it — the
+            # cache is just written, never read. This assumes prefill
+            # starts from an EMPTY cache (idx==0, the make_generate_fn
+            # contract); chunked prefill would need the cached path.
+            out = attention_dispatch(q, k, v, causal=True,
+                                     impl=cfg.attention_impl)
+        else:
+            out = _cached_attention(q, k_cache, v_cache, idx)
         return proj(out), (k_cache, v_cache)
 
 

@@ -48,6 +48,9 @@ class ReplicaShard:
         self._lock = threading.Lock()
         # serializes SPMD entry on rank 0 (see module docstring)
         self._spmd_lock = threading.Lock()
+        # set when a stream died mid-collective: the gang's ranks are
+        # desynchronized and must be replaced as a unit
+        self._wedged = False
 
     def setup_distributed(self, group_name: str) -> bool:
         """Join the group's jax.distributed world (KV rendezvous). Must
@@ -115,6 +118,61 @@ class ReplicaShard:
                 fn(*args, **kwargs), global_worker.core.loop).result()
         return fn(*args, **kwargs)
 
+    # --------------------------------------------------------- streaming
+    def handle_stream(self, method: str, args: Tuple, kwargs: Dict):
+        """Rank-0 streaming ingress (token streaming): every rank runs
+        the same generator method; rank 0 yields its chunks to the
+        router while peers drain theirs. Lockstep comes from the SPMD
+        collectives themselves — with one stream admitted at a time
+        (the SPMD lock), each rank's generator steps through the same
+        collective sequence and the rendezvous throttles whoever runs
+        ahead.
+
+        Abandoned streams: if the client walks away mid-collective, rank
+        0's generator closes but the peers stay parked at the
+        rendezvous. The drain wait is BOUNDED; on timeout the group
+        marks itself wedged — health checks then fail and the
+        controller replaces the whole gang (a half-finished SPMD world
+        cannot be safely reused)."""
+        import ray_tpu
+        kwargs = dict(kwargs)
+        kwargs.pop("__serve_model_id", None)
+        with self._lock:
+            self._ongoing += 1
+        try:
+            with self._spmd_lock:
+                refs = [p.run_shard_drain.remote(method, args, kwargs)
+                        for p in self._peers]
+                completed = False
+                try:
+                    fn = self._callable if self._is_function \
+                        else getattr(self._callable, method)
+                    for chunk in fn(*args, **kwargs):
+                        yield chunk
+                    completed = True
+                finally:
+                    try:
+                        ray_tpu.get(refs,
+                                    timeout=300 if completed else 15)
+                    except Exception:
+                        self._wedged = True
+                        raise
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def run_shard_drain(self, method: str, args: Tuple, kwargs: Dict):
+        """Peer side of a streamed request: step the generator to
+        exhaustion (outputs discarded — rank 0 owns the response)."""
+        kwargs = dict(kwargs)
+        kwargs.pop("__serve_model_id", None)
+        fn = self._callable if self._is_function \
+            else getattr(self._callable, method)
+        n = 0
+        for _ in fn(*args, **kwargs):
+            n += 1
+        return n
+
     # --------------------------------------------------------- control plane
     def get_queue_len(self) -> int:
         return self._ongoing
@@ -123,6 +181,9 @@ class ReplicaShard:
         """Rank 0 probes every peer: one dead rank = unhealthy group, so
         the controller replaces the gang as a unit (slice semantics)."""
         import ray_tpu
+        if self._wedged:
+            raise ray_tpu.ActorDiedError(
+                "sharded replica gang wedged by an abandoned stream")
         fn = getattr(self._callable, "check_health", None)
         if fn is not None:
             fn()

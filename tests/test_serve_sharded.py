@@ -135,6 +135,35 @@ def test_sharded_replica_http_and_rolling_update(ray_start):
     serve.delete("shttp")
 
 
+class ShardedStreamer(ShardedSum):
+    """Token-streaming shape: each yielded chunk is one SPMD step (the
+    jitted cross-process all-reduce), so a correct stream proves the
+    ranks advance their generators in lockstep."""
+
+    def stream(self, x):
+        import jax
+        for i in range(5):
+            y = self._f(np.float32(float(x) + i), self.w)
+            yield float(jax.device_get(y))
+
+
+def test_sharded_replica_streaming(ray_start):
+    """Streamed responses from a sharded gang: rank 0 yields per-step
+    SPMD results; every chunk must be present, ordered, and correct."""
+    app = serve.deployment(ShardedStreamer, num_hosts=2,
+                           ray_actor_options={"num_cpus": 0.5}).bind(1.0)
+    handle = serve.run(app, name="sstream", route_prefix=None)
+    gen = handle.options(stream=True).stream.remote(2.0)
+    got = [chunk for chunk in gen]
+    assert got == [pytest.approx(_expected(2.0 + i, 1.0))
+                   for i in range(5)], got
+    # a second stream after the first completes (SPMD lock released)
+    gen = handle.options(stream=True).stream.remote(0.0)
+    assert [c for c in gen] == [pytest.approx(_expected(float(i), 1.0))
+                                for i in range(5)]
+    serve.delete("sstream")
+
+
 def test_sharded_group_torn_down_with_app(ray_start):
     """Deleting the app kills every rank of the gang and releases its
     placement group — no orphaned shard actors or bundles."""
