@@ -296,17 +296,50 @@ class NodeManager:
                         logger.info("GCS moved: %s -> %s",
                                     self.gcs_address, fresh)
                         self.gcs_address = fresh
-                try:
-                    self.gcs = await rpc.connect(
+                # bound the WHOLE reconnect attempt (dial + re-register
+                # + resubscribe) by the remaining exit deadline: a
+                # 20-retry backoff chain alone runs ~30s, and an
+                # accepted-but-unresponsive GCS would hang the untimed
+                # register call forever — either way the death check
+                # above must get control back in time
+                remaining = max(
+                    0.5, down_since + cfg.gcs_reconnect_timeout_s
+                    - time.monotonic())
+
+                async def _redial():
+                    conn = await rpc.connect(
                         self.gcs_address, handlers=self.gcs.handlers,
                         name="nm->gcs", retries=20)
-                    await self.gcs.call(
-                        "register_node", node_id=self.node_id,
-                        address=self.address,
-                        object_store_address=self.store_path,
-                        resources=self.total, labels=self.labels,
-                        node_ip=rpc.node_ip_address())
-                    await self.gcs.call("subscribe", channel="NODE")
+                    try:
+                        await conn.call(
+                            "register_node", node_id=self.node_id,
+                            address=self.address,
+                            object_store_address=self.store_path,
+                            resources=self.total, labels=self.labels,
+                            node_ip=rpc.node_ip_address())
+                        await conn.call("subscribe", channel="NODE")
+                        return conn
+                    except BaseException:
+                        # incl. the deadline's CancelledError: never
+                        # leak a half-registered connection
+                        try:
+                            await conn.close()
+                        except Exception:
+                            pass
+                        raise
+
+                try:
+                    conn = await asyncio.wait_for(_redial(),
+                                                  timeout=remaining)
+                    old = self.gcs
+                    self.gcs = conn
+                    # a half-open predecessor holds a socket + a parked
+                    # reader task: close it or every reconnect cycle
+                    # leaks one of each
+                    try:
+                        await old.close()
+                    except Exception:
+                        pass
                 except Exception:
                     pass
             await asyncio.sleep(cfg.heartbeat_interval_s)
