@@ -2,6 +2,8 @@ from ray_tpu.rl.algorithm import PPO, Algorithm
 from ray_tpu.rl.appo import APPO
 from ray_tpu.rl.config import AlgorithmConfig
 from ray_tpu.rl.dqn import DQN
+from ray_tpu.rl.external import (ExternalPPO, PolicyClient,
+                                  PolicyServer)
 from ray_tpu.rl.impala import IMPALA
 from ray_tpu.rl.multi_agent import (MultiAgentConfig, MultiAgentEnv,
                                     MultiAgentEnvRunner, MultiAgentPPO)
@@ -12,6 +14,7 @@ from ray_tpu.rl.sac import SAC
 from ray_tpu.rl.vtrace import vtrace
 
 __all__ = ["Algorithm", "PPO", "APPO", "IMPALA", "DQN", "SAC",
+           "ExternalPPO", "PolicyClient", "PolicyServer",
            "AlgorithmConfig", "ReplayBuffer", "PrioritizedReplayBuffer",
            "make_replay_buffer", "vtrace", "MultiAgentEnv",
            "MultiAgentConfig", "MultiAgentEnvRunner", "MultiAgentPPO",

@@ -109,9 +109,14 @@ class GrpcProxy:
         self._server.start()
         from ray_tpu._private.rpc import node_ip_address
         self._addr = f"{node_ip_address()}:{bound}"
+        self._prime_routes()
         self._poller = threading.Thread(target=self._longpoll_loop,
                                         daemon=True)
         self._poller.start()
+
+    def _prime_routes(self):
+        from ray_tpu.serve.long_poll import prime_snapshot
+        prime_snapshot(self.controller, self._versions, self._on_update)
 
     def _longpoll_loop(self):
         from ray_tpu.serve.long_poll import run_longpoll_loop

@@ -46,3 +46,27 @@ def run_longpoll_loop(get_controller: Callable,
                 on_update(key, item["data"])
             except Exception:
                 pass
+
+
+def prime_snapshot(controller, versions: Dict[str, int],
+                   on_update: Callable[[str, Dict], None],
+                   keys=("routes",), timeout: float = 30.0) -> None:
+    """Synchronous initial snapshot of `keys` before the long-poll loop
+    starts: a component that reports ready() must already hold state
+    deployed before it came up (first-request 404 race otherwise). The
+    -1 sentinel version always returns immediately (controller versions
+    start at 0). Failure is logged, not raised — the loop converges."""
+    import logging
+
+    import ray_tpu
+    try:
+        hits = ray_tpu.get(controller.listen_for_change.remote(
+            {k: -1 for k in keys}, 5.0), timeout=timeout)
+        for key, item in (hits or {}).items():
+            versions[key] = item["version"]
+            on_update(key, item["data"])
+    except Exception:
+        logging.getLogger(__name__).warning(
+            "initial %s snapshot failed; relying on the long-poll loop "
+            "to converge (first requests may miss routes)", keys,
+            exc_info=True)

@@ -26,9 +26,14 @@ class HttpProxy:
         from ray_tpu._private.worker import global_worker
         asyncio.run_coroutine_threadsafe(
             self._start(), global_worker.core.loop).result(timeout=30)
+        self._prime_routes()
         self._poller = threading.Thread(target=self._longpoll_loop,
                                         daemon=True)
         self._poller.start()
+
+    def _prime_routes(self):
+        from ray_tpu.serve.long_poll import prime_snapshot
+        prime_snapshot(self.controller, self._versions, self._on_update)
 
     async def _start(self):
         from aiohttp import web
