@@ -1,0 +1,84 @@
+"""Single-chip decode-throughput probe (bench.py subprocess; the
+serving-side counterpart of mfu_ablate.py): prefill a prompt, then
+lax.scan single-token KV-cache decode steps, report tokens/s.
+
+Usage: python decode_probe.py --one '{"model": "tpu-1b", "B": 8,
+                                      "prompt": 128, "new": 64}'
+Prints one line: RESULT {json}
+"""
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def run(spec):
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ray_tpu.models import MODEL_REGISTRY, TransformerLM
+    from ray_tpu.models.generate import make_generate_fn
+    from ray_tpu.parallel import MeshConfig, make_mesh
+
+    cfg = MODEL_REGISTRY[spec["model"]]
+    # bf16 params: inference wants the half-width weights (and the 3B
+    # rung only fits one 16 GB chip that way)
+    cfg = dataclasses.replace(cfg, param_dtype=jnp.bfloat16,
+                              dtype=jnp.bfloat16, remat=False)
+    model = TransformerLM(cfg)
+    B = spec.get("B", 8)
+    prompt_len = spec.get("prompt", 128)
+    new = spec.get("new", 64)
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1, seq=1, tensor=1),
+                     devices=jax.devices()[:1])
+    # two generate programs differing ONLY in decode-step count: the
+    # DIFFERENCE of their wall times isolates the per-token decode rate
+    # from the shared prefill cost and the tunneled device's fixed
+    # per-call round-trip (~140ms here — it would otherwise dominate)
+    short = max(4, new // 4)
+    init_fn, gen_long, _ = make_generate_fn(model, mesh, batch=B,
+                                            prompt_len=prompt_len,
+                                            max_new_tokens=new)
+    _, gen_short, _ = make_generate_fn(model, mesh, batch=B,
+                                       prompt_len=prompt_len,
+                                       max_new_tokens=short)
+    params = init_fn(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, prompt_len),
+                                0, cfg.vocab_size)
+
+    def timed(fn, key):
+        # np.asarray forces the full device->host materialization
+        # (block_until_ready alone proved unreliable through the
+        # tunneled device: reported ~100x above the HBM roofline);
+        # fresh keys per call so no layer can serve a cached result
+        t0 = time.perf_counter()
+        np.asarray(fn(params, tokens, key))
+        return time.perf_counter() - t0
+
+    out = np.asarray(gen_long(params, tokens, jax.random.PRNGKey(2)))
+    assert out.shape == (B, new)
+    np.asarray(gen_short(params, tokens, jax.random.PRNGKey(3)))
+    rates, e2e = [], []
+    for i in range(3):
+        dt_long = timed(gen_long, jax.random.PRNGKey(10 + i))
+        dt_short = timed(gen_short, jax.random.PRNGKey(20 + i))
+        rates.append(B * (new - short) / max(1e-6, dt_long - dt_short))
+        e2e.append(B * new / dt_long)
+    rates.sort()
+    e2e.sort()
+    return {"model": spec["model"], "B": B, "prompt": prompt_len,
+            "new": new, "decode_tokens_per_s": round(rates[1], 1),
+            "e2e_tokens_per_s": round(e2e[1], 1),
+            "runs": [round(r, 1) for r in rates]}
+
+
+if __name__ == "__main__":
+    spec = json.loads(sys.argv[sys.argv.index("--one") + 1])
+    print("RESULT " + json.dumps(run(spec)), flush=True)

@@ -50,12 +50,19 @@ def gcs_restart_cluster(tmp_path):
     gcs_proc, gcs_addr = _spawn_gcs(port, persist, session)
     node = node_mod.start_node(gcs_addr, num_cpus=2, session_name=session)
     ray_tpu.init(address=gcs_addr)
-    yield {"port": port, "persist": persist, "session": session,
+    ctx = {"port": port, "persist": persist, "session": session,
            "gcs_proc": gcs_proc, "addr": gcs_addr}
+    yield ctx
     ray_tpu.shutdown()
     node.kill()
-    if gcs_proc.poll() is None:
-        gcs_proc.kill()
+    # kill the CURRENT GCS from ctx, not the local from setup: tests
+    # restart the GCS and reassign ctx["gcs_proc"] — killing the stale
+    # local leaked every restarted instance until pytest itself exited
+    # (round-5 hygiene-gate evidence: exactly one stray per fixture test)
+    cur = ctx["gcs_proc"]
+    if cur.poll() is None:
+        cur.kill()
+    cur.wait()
 
 
 def test_gcs_restart_recovers_state(gcs_restart_cluster):
