@@ -164,6 +164,71 @@ def test_sharded_replica_streaming(ray_start):
     serve.delete("sstream")
 
 
+def test_sharded_autoscaling_gangs(ray_start):
+    """Autoscaling where one replica = one GANG: sustained queue depth
+    on the single gang (SPMD lock serializes requests) upscales to a
+    second 2-process gang; idling back down retires a whole gang."""
+
+    class SlowShardedSum(ShardedSum):
+        def __call__(self, x):
+            import time as _t
+            _t.sleep(0.3)       # hold the SPMD slot: queue builds
+            return super().__call__(x)
+
+    from ray_tpu.serve.api import _get_controller
+    app = serve.deployment(
+        SlowShardedSum, num_hosts=2,
+        ray_actor_options={"num_cpus": 0.25},
+        autoscaling_config={"min_replicas": 1, "max_replicas": 2,
+                            "target_ongoing_requests": 1.0,
+                            "upscale_delay_s": 1.0,
+                            "downscale_delay_s": 4.0,
+                            "look_back_period_s": 4.0},
+    ).bind(1.0)
+    handle = serve.run(app, name="sauto", route_prefix=None)
+    ctrl = _get_controller()
+
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                handle.remote(1.0).result(timeout=120)
+            except Exception:
+                pass
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 120
+        scaled_up = False
+        while time.monotonic() < deadline:
+            st = ray_tpu.get(ctrl.get_status.remote(), timeout=30)
+            if st["sauto"]["SlowShardedSum"]["running"] >= 2:
+                scaled_up = True
+                break
+            time.sleep(1.0)
+        assert scaled_up, "never scaled to a second gang"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=130)
+    # idle: a whole gang drains away back to min_replicas
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        st = ray_tpu.get(ctrl.get_status.remote(), timeout=30)
+        if st["sauto"]["SlowShardedSum"]["running"] == 1:
+            break
+        time.sleep(1.0)
+    assert st["sauto"]["SlowShardedSum"]["running"] == 1, st
+    # the survivor still serves
+    assert handle.remote(2.0).result(timeout=120) == \
+        pytest.approx(_expected(2.0, 1.0))
+    serve.delete("sauto")
+
+
 def test_sharded_group_torn_down_with_app(ray_start):
     """Deleting the app kills every rank of the gang and releases its
     placement group — no orphaned shard actors or bundles."""
