@@ -839,6 +839,8 @@ def main():
                         format="[gcs] %(asctime)s %(levelname)s %(message)s")
 
     async def run():
+        from ray_tpu.util import sanitizers
+        sanitizers.maybe_install()
         gcs = GcsServer(port=args.port, session_name=args.session_name,
                         persist_path=args.persist_path)
         addr = await gcs.start()

@@ -141,6 +141,9 @@ class NodeManager:
         self._log_files: Dict[int, list] = {}
         # compiled-DAG channel mirrors this daemon writes into
         self._dag_channels: Dict[str, object] = {}
+        # thread_checker.h analog: no-op unless RAY_TPU_LOOP_SANITIZER
+        from ray_tpu.util.sanitizers import SingleLoopChecker
+        self._loop_checker = SingleLoopChecker("NodeManager")
 
     # -------------------------------------------------------------- lifecycle
     async def start(self) -> str:
@@ -839,6 +842,7 @@ class NodeManager:
         that has already been redirected once is grant-or-queue here — never
         redirected again (the reference's grant_or_reject spillback rule,
         preventing ping-pong on stale cluster views)."""
+        self._loop_checker.check()
         deadline = time.monotonic() + cfg.lease_wait_timeout_s
         strategy = scheduling.get("strategy", "DEFAULT")
         infeasible_since = None
@@ -1128,8 +1132,10 @@ class NodeManager:
         threads plus every connected worker's (the `ray_tpu stack` fan-
         out point; reference: `ray stack` py-spy over local PIDs)."""
         from ray_tpu._private.proc_util import format_thread_stacks
+        from ray_tpu.util import sanitizers
         out = {"node_manager": {"pid": os.getpid(),
-                                "stacks": format_thread_stacks()},
+                                "stacks": format_thread_stacks(),
+                                "loop_stats": sanitizers.stats_snapshot()},
                "workers": {}}
         for wid, w in list(self.workers.items()):
             if w.conn is None or w.conn.closed or w.state == "dead":
@@ -1614,6 +1620,8 @@ def main():
                         format="[node] %(asctime)s %(levelname)s %(message)s")
 
     async def run():
+        from ray_tpu.util import sanitizers
+        sanitizers.maybe_install()
         nm = NodeManager(gcs_address=args.gcs_address, node_id=args.node_id,
                          resources=json.loads(args.resources),
                          labels=json.loads(args.labels),

@@ -2684,8 +2684,10 @@ class CoreWorker:
         `ray_tpu stack` data plane; reference: `ray stack` via py-spy —
         here each process serves its own frames, no ptrace)."""
         from ray_tpu._private.proc_util import format_thread_stacks
+        from ray_tpu.util import sanitizers
         return {"pid": os.getpid(), "mode": self.mode,
-                "stacks": format_thread_stacks()}
+                "stacks": format_thread_stacks(),
+                "loop_stats": sanitizers.stats_snapshot()}
 
     async def dump_cluster_stacks_async(self) -> Dict[str, Any]:
         """node_id -> {node_manager: ..., workers: {worker_id: ...}} for

@@ -49,7 +49,10 @@ def main():
                       store_path=args.store_path, node_id=args.node_id)
     loop = asyncio.new_event_loop()
     asyncio.set_event_loop(loop)
+    from ray_tpu.util import sanitizers
     loop.run_until_complete(core.start_async())
+    if sanitizers.enabled():
+        loop.call_soon(sanitizers.maybe_install)
     worker_mod.global_worker = Worker(core, owns_loop=False)
 
     import ray_tpu

@@ -25,20 +25,42 @@ def lib_path(name: str) -> str:
     return os.path.join(_BUILD_DIR, f"lib{name}.so")
 
 
-def build(name: str) -> str:
-    """Compile (if stale) and return the path to lib<name>.so."""
-    srcs = [os.path.join(_HERE, s) for s in _SOURCES[name]]
-    out = lib_path(name)
+def _compile(srcs, out, flags) -> str:
+    """Compile (if stale vs source mtimes) srcs -> out; atomic replace."""
     with _LOCK:
         src_mtime = max(os.path.getmtime(s) for s in srcs)
         if os.path.exists(out) and os.path.getmtime(out) >= src_mtime:
             return out
         os.makedirs(_BUILD_DIR, exist_ok=True)
         tmp = f"{out}.tmp.{os.getpid()}"  # per-process tmp; os.replace is atomic
-        cmd = [
-            "g++", "-O2", "-g", "-shared", "-fPIC", "-std=c++17",
-            "-o", tmp, *srcs, "-lpthread",
-        ]
+        cmd = ["g++", "-std=c++17", *flags, "-o", tmp, *srcs, "-lpthread"]
         subprocess.run(cmd, check=True, capture_output=True)
         os.replace(tmp, out)
     return out
+
+
+def build(name: str) -> str:
+    """Compile (if stale) and return the path to lib<name>.so."""
+    srcs = [os.path.join(_HERE, s) for s in _SOURCES[name]]
+    return _compile(srcs, lib_path(name),
+                    ["-O2", "-g", "-shared", "-fPIC"])
+
+
+# Standalone sanitizer harnesses (the reference's build:asan/build:ubsan
+# CI story, .bazelrc:104-125): each entry is a main() program compiled
+# WITH the component sources under -fsanitize and run as a subprocess by
+# tests/test_sanitizers.py. tsan is available the same way
+# (sanitize="thread") but the suite runs asan+ubsan by default — the
+# robust-mutex arena is cross-process, which tsan models poorly.
+_SELFTESTS = {
+    "shm_store_selftest": ["shm_store_selftest.cpp", "shm_store.cpp"],
+}
+
+
+def build_selftest(name: str, sanitize: str = "address,undefined") -> str:
+    """Compile (if stale) a sanitizer selftest binary; returns its path."""
+    srcs = [os.path.join(_HERE, s) for s in _SELFTESTS[name]]
+    out = os.path.join(_BUILD_DIR, f"{name}.{sanitize.replace(',', '_')}")
+    return _compile(srcs, out,
+                    ["-O1", "-g", f"-fsanitize={sanitize}",
+                     "-fno-omit-frame-pointer"])

@@ -1,0 +1,158 @@
+// Local-mode C++ runtime example/selftest (reference:
+// cpp/src/ray/test/examples + local_mode_ray_runtime tests): native
+// task registration, dependency chaining through object refs, error
+// propagation, serialized actor mailboxes under concurrent submission,
+// and Wait. Run by tests/test_cpp_api.py; prints LOCAL_MODE_OK.
+#include <atomic>
+#include <cstdio>
+#include <stdexcept>
+#include <thread>
+#include <vector>
+
+#include "local_mode.hpp"
+
+using rt::Value;
+using rt::local::Arg;
+using rt::local::LocalObjectRef;
+using rt::local::LocalRuntime;
+
+static Value Pow(const std::vector<Value>& a) {
+  int64_t base = a[0].i, exp = a[1].i, out = 1;
+  for (int64_t k = 0; k < exp; k++) out *= base;
+  return Value::Int(out);
+}
+RT_LOCAL_REMOTE(Pow);
+
+static Value AddOne(const std::vector<Value>& a) {
+  return Value::Int(a[0].i + 1);
+}
+RT_LOCAL_REMOTE(AddOne);
+
+static Value Fails(const std::vector<Value>&) {
+  throw std::runtime_error("intentional boom");
+}
+RT_LOCAL_REMOTE(Fails);
+
+static Value SlowEcho(const std::vector<Value>& a) {
+  std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  return a[0];
+}
+RT_LOCAL_REMOTE(SlowEcho);
+
+// An actor: counter with history-order check.
+class Counter {
+ public:
+  explicit Counter(const std::vector<Value>& args)
+      : total_(args.empty() ? 0 : args[0].i) {}
+  Value Add(const std::vector<Value>& a) {
+    // detect concurrent entry (would corrupt `entered_` discipline)
+    if (entered_.exchange(true)) return Value::Str("CONCURRENT!");
+    std::this_thread::sleep_for(std::chrono::microseconds(200));
+    total_ += a[0].i;
+    entered_ = false;
+    return Value::Int(total_);
+  }
+  Value Total(const std::vector<Value>&) { return Value::Int(total_); }
+
+ private:
+  int64_t total_;
+  std::atomic<bool> entered_{false};
+};
+
+int main() {
+  rt::local::RegisterActorClass<Counter>(
+      "Counter", {{"Add", &Counter::Add}, {"Total", &Counter::Total}});
+
+  LocalRuntime rt(4);
+
+  // task + get
+  auto r1 = rt.Task("Pow", {Arg(Value::Int(2)), Arg(Value::Int(10))});
+  if (rt.Get(r1).i != 1024) return 1;
+  printf("pow=%lld\n", (long long)rt.Get(r1).i);
+
+  // dependency chain: AddOne(AddOne(Pow(2,3))) == 10
+  auto c1 = rt.Task("Pow", {Arg(Value::Int(2)), Arg(Value::Int(3))});
+  auto c2 = rt.Task("AddOne", {Arg(c1)});
+  auto c3 = rt.Task("AddOne", {Arg(c2)});
+  if (rt.Get(c3).i != 10) return 2;
+  printf("chain=%lld\n", (long long)rt.Get(c3).i);
+
+  // error propagation
+  bool threw = false;
+  try {
+    rt.Get(rt.Task("Fails", {}));
+  } catch (const std::exception& e) {
+    threw = std::string(e.what()).find("intentional boom") !=
+            std::string::npos;
+  }
+  if (!threw) return 3;
+  printf("error propagated\n");
+
+  // unknown function fails fast at submission
+  threw = false;
+  try {
+    rt.Task("Nope", {});
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  if (!threw) return 4;
+
+  // Put/Get + Wait
+  auto p = rt.Put(Value::Str("hello"));
+  std::vector<LocalObjectRef> refs = {
+      p, rt.Task("SlowEcho", {Arg(Value::Int(7))})};
+  auto ready = rt.Wait(refs, 1, 1000);
+  if (ready.empty()) return 5;
+  auto all = rt.Wait(refs, 2, 5000);
+  if (all.size() != 2) return 6;
+  printf("wait ok\n");
+
+  // actor: 64 concurrent Adds from 4 threads must serialize FIFO
+  auto h = rt.CreateActor("Counter", {Value::Int(100)});
+  std::vector<LocalObjectRef> adds;
+  std::mutex addmu;
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 4; t++) {
+    ts.emplace_back([&] {
+      for (int i = 0; i < 16; i++) {
+        auto r = rt.CallActor(h, "Add", {Arg(Value::Int(1))});
+        std::lock_guard<std::mutex> g(addmu);
+        adds.push_back(r);
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+  for (auto& r : adds) {
+    Value v = rt.Get(r);
+    if (v.type == Value::STR) {
+      printf("CONCURRENT ACTOR ENTRY\n");
+      return 7;
+    }
+  }
+  auto total = rt.Get(rt.CallActor(h, "Total", {}));
+  if (total.i != 164) return 8;
+  printf("actor_total=%lld\n", (long long)total.i);
+
+  // dependency-gating regression: on a 1-thread pool, a task whose dep
+  // is unresolved must not occupy the worker (old blocking design
+  // deadlocked here); later independent tasks keep flowing, and actor
+  // FIFO order is preserved across an unresolved-dep head-of-line
+  {
+    LocalRuntime rt1(1);
+    LocalObjectRef pending;  // resolved manually below
+    auto gated = rt1.Task("AddOne", {Arg(pending)});
+    auto free1 = rt1.Task("Pow", {Arg(Value::Int(3)), Arg(Value::Int(2))});
+    if (rt1.Get(free1, 2000).i != 9) return 9;   // pool not wedged
+    auto h1 = rt1.CreateActor("Counter", {Value::Int(0)});
+    auto a1 = rt1.CallActor(h1, "Add", {Arg(pending)});
+    auto a2 = rt1.CallActor(h1, "Total", {});
+    if (!rt1.Wait({a2}, 1, 200).empty()) return 10;  // FIFO held back
+    pending.Resolve(Value::Int(41));
+    if (rt1.Get(gated, 2000).i != 42) return 11;
+    if (rt1.Get(a2, 2000).i != 41) return 12;        // Add ran first
+    printf("gating ok\n");
+  }
+
+  printf("LOCAL_MODE_OK\n");
+  return 0;
+}

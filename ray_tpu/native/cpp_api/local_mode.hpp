@@ -1,0 +1,428 @@
+// In-process ("local mode") C++ runtime for the ray_tpu C++ API.
+//
+// Counterpart of the reference's local-mode runtime (reference:
+// cpp/src/ray/runtime/local_mode_ray_runtime.cc +
+// cpp/src/ray/runtime/task/local_mode_task_submitter.cc): tasks and
+// actors registered as native C++ functions execute inside the calling
+// process on a small thread pool — no cluster, no sockets — while
+// keeping the task/actor/object semantics (futures as object refs,
+// dependency resolution of ref arguments before execution, serialized
+// FIFO actor mailboxes, error capture + rethrow on Get). The remote
+// path (ray_tpu_api.hpp Client) and this local path share the same
+// Value model, mirroring the reference's AbstractRayRuntime split.
+//
+// Usage:
+//   Value Pow(const std::vector<Value>& a);
+//   RT_LOCAL_REMOTE(Pow);
+//   ...
+//   rt::local::LocalRuntime rt(4);
+//   auto ref = rt.Task("Pow", {Value::Int(2), Value::Int(10)});
+//   Value v = rt.Get(ref);                      // 1024
+//
+// Dependency-free C++17; header-only like the client API.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <future>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <utility>
+#include <variant>
+#include <vector>
+
+#include "ray_tpu_api.hpp"
+
+namespace rt {
+namespace local {
+
+using TaskFn = std::function<Value(const std::vector<Value>&)>;
+
+// ------------------------------------------------------- task registry
+// RAY_REMOTE analog (reference: cpp/include/ray/api/ray_remote.h):
+// static registration of free functions by name.
+class FunctionRegistry {
+ public:
+  static FunctionRegistry& Instance() {
+    static FunctionRegistry r;
+    return r;
+  }
+  void Register(const std::string& name, TaskFn fn) {
+    std::lock_guard<std::mutex> g(mu_);
+    fns_[name] = std::move(fn);
+  }
+  TaskFn Find(const std::string& name) const {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = fns_.find(name);
+    if (it == fns_.end())
+      throw std::runtime_error("no such task function: " + name);
+    return it->second;
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::map<std::string, TaskFn> fns_;
+};
+
+struct Registrar {
+  Registrar(const std::string& name, TaskFn fn) {
+    FunctionRegistry::Instance().Register(name, std::move(fn));
+  }
+};
+
+#define RT_LOCAL_REMOTE(fn) \
+  static ::rt::local::Registrar _rt_local_reg_##fn(#fn, fn)
+
+// ------------------------------------------------------ actor registry
+// Actor classes register a factory + named methods; instances live as
+// shared_ptr<void> so the runtime is class-agnostic (the reference's
+// local mode keeps a map of actor handles to untyped instances).
+struct ActorClassInfo {
+  std::function<std::shared_ptr<void>(const std::vector<Value>&)> factory;
+  std::map<std::string,
+           std::function<Value(void*, const std::vector<Value>&)>>
+      methods;
+};
+
+class ActorRegistry {
+ public:
+  static ActorRegistry& Instance() {
+    static ActorRegistry r;
+    return r;
+  }
+  void RegisterClass(const std::string& name, ActorClassInfo info) {
+    std::lock_guard<std::mutex> g(mu_);
+    classes_[name] = std::move(info);
+  }
+  const ActorClassInfo& Find(const std::string& name) const {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = classes_.find(name);
+    if (it == classes_.end())
+      throw std::runtime_error("no such actor class: " + name);
+    return it->second;
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::map<std::string, ActorClassInfo> classes_;
+};
+
+// Typed registration helper: methods must have the uniform signature
+// Value (T::*)(const std::vector<Value>&) — the same calling convention
+// tasks use, keeping the wire/value model single.
+template <typename T>
+void RegisterActorClass(
+    const std::string& name,
+    std::map<std::string, Value (T::*)(const std::vector<Value>&)>
+        methods) {
+  ActorClassInfo info;
+  info.factory = [](const std::vector<Value>& args) {
+    return std::static_pointer_cast<void>(std::make_shared<T>(args));
+  };
+  for (auto& kv : methods) {
+    auto m = kv.second;
+    info.methods[kv.first] = [m](void* self, const std::vector<Value>& a) {
+      return (static_cast<T*>(self)->*m)(a);
+    };
+  }
+  ActorRegistry::Instance().RegisterClass(name, std::move(info));
+}
+
+// ---------------------------------------------------------- object refs
+// A local-mode ObjectRef is a shared future: Put resolves immediately,
+// Task/CallActor resolve when the pool executes the work. Errors are
+// carried in-band and rethrown at Get (the reference's RayTaskError).
+struct ObjectState {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool ready = false;
+  Value value;
+  std::string error;  // nonempty => Get throws
+  std::vector<std::function<void()>> callbacks;  // fired once on ready
+};
+
+class LocalObjectRef {
+ public:
+  LocalObjectRef() : st_(std::make_shared<ObjectState>()) {}
+  bool Ready() const {
+    std::lock_guard<std::mutex> g(st_->mu);
+    return st_->ready;
+  }
+  // Run fn when the ref resolves (immediately if already resolved).
+  // The scheduler uses this to gate dependent work instead of blocking
+  // a pool thread in Get — a fixed-size pool + blocking resolution
+  // would deadlock on out-of-order dependency chains.
+  void OnReady(std::function<void()> fn) const {
+    {
+      std::lock_guard<std::mutex> g(st_->mu);
+      if (!st_->ready) {
+        st_->callbacks.push_back(std::move(fn));
+        return;
+      }
+    }
+    fn();
+  }
+  void Resolve(Value v) {
+    std::vector<std::function<void()>> cbs;
+    {
+      std::lock_guard<std::mutex> g(st_->mu);
+      st_->value = std::move(v);
+      st_->ready = true;
+      cbs.swap(st_->callbacks);
+    }
+    st_->cv.notify_all();
+    for (auto& cb : cbs) cb();
+  }
+  void Fail(std::string err) {
+    std::vector<std::function<void()>> cbs;
+    {
+      std::lock_guard<std::mutex> g(st_->mu);
+      st_->error = std::move(err);
+      st_->ready = true;
+      cbs.swap(st_->callbacks);
+    }
+    st_->cv.notify_all();
+    for (auto& cb : cbs) cb();
+  }
+  Value Get(int64_t timeout_ms = -1) const {
+    std::unique_lock<std::mutex> g(st_->mu);
+    if (timeout_ms < 0) {
+      st_->cv.wait(g, [&] { return st_->ready; });
+    } else if (!st_->cv.wait_for(g, std::chrono::milliseconds(timeout_ms),
+                                 [&] { return st_->ready; })) {
+      throw std::runtime_error("Get timed out");
+    }
+    if (!st_->error.empty())
+      throw std::runtime_error("task failed: " + st_->error);
+    return st_->value;
+  }
+
+ private:
+  std::shared_ptr<ObjectState> st_;
+};
+
+// Task arguments may be plain Values or ObjectRefs; refs are resolved
+// (blocking the worker, not the submitter) before the function runs —
+// the reference local mode's dependency semantics.
+using Arg = std::variant<Value, LocalObjectRef>;
+
+struct MailboxEntry {
+  std::function<void()> work;        // runs with deps already resolved
+  std::vector<LocalObjectRef> deps;  // ref args this call waits on
+};
+
+struct ActorStateBox {
+  std::shared_ptr<void> instance;
+  const ActorClassInfo* cls = nullptr;
+  std::mutex mu;                 // serializes the mailbox
+  std::deque<MailboxEntry> mailbox;
+  bool draining = false;
+};
+
+// ---------------------------------------------------------- the runtime
+class LocalRuntime {
+ public:
+  explicit LocalRuntime(int num_threads = 4) : stop_(false) {
+    for (int i = 0; i < num_threads; i++)
+      pool_.emplace_back([this] { WorkerLoop(); });
+  }
+  ~LocalRuntime() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : pool_) t.join();
+  }
+
+  LocalObjectRef Put(Value v) {
+    LocalObjectRef ref;
+    ref.Resolve(std::move(v));
+    return ref;
+  }
+
+  Value Get(const LocalObjectRef& ref, int64_t timeout_ms = -1) {
+    return ref.Get(timeout_ms);
+  }
+
+  // Wait: indices of ready refs once num_ready are ready or timeout.
+  std::vector<size_t> Wait(const std::vector<LocalObjectRef>& refs,
+                           size_t num_ready, int64_t timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    std::vector<size_t> ready;
+    for (;;) {
+      ready.clear();
+      for (size_t i = 0; i < refs.size(); i++)
+        if (refs[i].Ready()) ready.push_back(i);
+      if (ready.size() >= num_ready ||
+          std::chrono::steady_clock::now() >= deadline)
+        return ready;
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  }
+
+  LocalObjectRef Task(const std::string& name, std::vector<Arg> args) {
+    TaskFn fn = FunctionRegistry::Instance().Find(name);  // fail fast
+    LocalObjectRef ref;
+    auto work = [fn, args, ref]() mutable { RunInto(ref, fn, args); };
+    // dependency-gate: enqueue only once every ref arg is resolved, so
+    // pool threads never block on unresolved deps (submission order of
+    // plain tasks is not an execution-order contract)
+    WhenArgsReady(args, [this, work = std::move(work)]() mutable {
+      Enqueue(std::move(work));
+    });
+    return ref;
+  }
+
+  // ----------------------------------------------------------- actors
+  struct ActorHandle {
+    std::shared_ptr<ActorStateBox> box;
+  };
+
+  ActorHandle CreateActor(const std::string& cls_name,
+                          const std::vector<Value>& args) {
+    const ActorClassInfo& cls = ActorRegistry::Instance().Find(cls_name);
+    ActorHandle h;
+    h.box = std::make_shared<ActorStateBox>();
+    h.box->cls = &cls;
+    h.box->instance = cls.factory(args);  // synchronous ctor, like ref
+    return h;
+  }
+
+  LocalObjectRef CallActor(const ActorHandle& h, const std::string& method,
+                           std::vector<Arg> args) {
+    auto it = h.box->cls->methods.find(method);
+    if (it == h.box->cls->methods.end())
+      throw std::runtime_error("no such actor method: " + method);
+    auto m = it->second;
+    LocalObjectRef ref;
+    auto box = h.box;
+    MailboxEntry entry;
+    for (auto& a : args)
+      if (std::holds_alternative<LocalObjectRef>(a))
+        entry.deps.push_back(std::get<LocalObjectRef>(a));
+    entry.work = [box, m, args = std::move(args), ref]() mutable {
+      void* self = box->instance.get();
+      RunInto(ref,
+              [self, m](const std::vector<Value>& a) { return m(self, a); },
+              args);
+    };
+    // FIFO mailbox: enqueue; if no drainer is active, this submission
+    // becomes the drainer — actor methods never run concurrently and
+    // run in submission order (actor semantics). The drainer yields its
+    // pool thread when the front entry's deps are unresolved.
+    bool start_drain = false;
+    {
+      std::lock_guard<std::mutex> g(box->mu);
+      box->mailbox.push_back(std::move(entry));
+      if (!box->draining) {
+        box->draining = true;
+        start_drain = true;
+      }
+    }
+    if (start_drain) Enqueue([this, box] { DrainActor(box); });
+    return ref;
+  }
+
+ private:
+  template <typename F>
+  static void RunInto(LocalObjectRef& ref, F&& fn, std::vector<Arg>& args) {
+    try {
+      std::vector<Value> vals;
+      vals.reserve(args.size());
+      for (auto& a : args) {
+        if (std::holds_alternative<Value>(a))
+          vals.push_back(std::get<Value>(a));
+        else
+          vals.push_back(std::get<LocalObjectRef>(a).Get());
+      }
+      ref.Resolve(fn(vals));
+    } catch (const std::exception& e) {
+      ref.Fail(e.what());
+    }
+  }
+
+  // Fire fn once every ref arg in args is resolved (immediately when
+  // none are pending). Countdown starts at 1 so fn can't fire before
+  // all OnReady registrations are in place.
+  template <typename F>
+  static void WhenArgsReady(const std::vector<Arg>& args, F fn) {
+    auto pending = std::make_shared<std::atomic<int>>(1);
+    auto shared_fn = std::make_shared<F>(std::move(fn));
+    auto fire = [pending, shared_fn] {
+      if (pending->fetch_sub(1) == 1) (*shared_fn)();
+    };
+    for (const auto& a : args) {
+      if (std::holds_alternative<LocalObjectRef>(a)) {
+        pending->fetch_add(1);
+        std::get<LocalObjectRef>(a).OnReady(fire);
+      }
+    }
+    fire();
+  }
+
+  void DrainActor(const std::shared_ptr<ActorStateBox>& box) {
+    for (;;) {
+      MailboxEntry entry;
+      {
+        std::lock_guard<std::mutex> g(box->mu);
+        if (box->mailbox.empty()) {
+          box->draining = false;
+          return;
+        }
+        // front's deps unresolved: keep FIFO order — yield this pool
+        // thread and restart the drain when they resolve
+        for (const auto& d : box->mailbox.front().deps) {
+          if (!d.Ready()) {
+            // draining stays true: no second drainer can start
+            d.OnReady([this, box] { Enqueue([this, box] {
+              DrainActor(box);
+            }); });
+            return;
+          }
+        }
+        entry = std::move(box->mailbox.front());
+        box->mailbox.pop_front();
+      }
+      entry.work();
+    }
+  }
+
+  void Enqueue(std::function<void()> work) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      queue_.push_back(std::move(work));
+    }
+    cv_.notify_one();
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      std::function<void()> work;
+      {
+        std::unique_lock<std::mutex> g(mu_);
+        cv_.wait(g, [&] { return stop_ || !queue_.empty(); });
+        if (stop_ && queue_.empty()) return;
+        work = std::move(queue_.front());
+        queue_.pop_front();
+      }
+      work();
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::function<void()>> queue_;
+  std::vector<std::thread> pool_;
+  bool stop_;
+};
+
+}  // namespace local
+}  // namespace rt

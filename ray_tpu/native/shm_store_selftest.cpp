@@ -1,0 +1,148 @@
+// Standalone ASan/UBSan harness for the shm object store — the
+// build:asan/build:tsan analog for this repo's native layer (reference:
+// .bazelrc build:asan + src/ray/object_manager plasma store tests run
+// under sanitizers in CI). Built by native/build.py with
+// -fsanitize=address,undefined and run as a subprocess by
+// tests/test_sanitizers.py; any heap-buffer-overflow / UB aborts the
+// process with a nonzero exit.
+//
+// Exercises: create/seal/get/release/delete round trips, abort of
+// unsealed objects, LRU eviction under pressure, cross-handle open, and
+// multi-threaded hammering of one arena (the robust-mutex path).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* rt_store_create(const char* path, uint64_t size);
+void* rt_store_open(const char* path);
+void rt_store_close(void* hs);
+uint8_t* rt_store_base(void* hs);
+int64_t rt_create(void* hs, const uint8_t* id, uint64_t data_size,
+                  uint64_t meta_size, int evictable);
+int rt_seal(void* hs, const uint8_t* id);
+int64_t rt_get(void* hs, const uint8_t* id, uint64_t* data_size,
+               uint64_t* meta_size, int pin);
+int rt_release(void* hs, const uint8_t* id);
+int rt_contains(void* hs, const uint8_t* id);
+int rt_delete(void* hs, const uint8_t* id);
+int rt_abort(void* hs, const uint8_t* id);
+uint64_t rt_evict(void* hs, uint64_t bytes);
+void rt_stats(void* hs, uint64_t* out);
+}
+
+static constexpr int kIdLen = 20;
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,         \
+              __LINE__, #cond);                                        \
+      return 1;                                                        \
+    }                                                                  \
+  } while (0)
+
+static void make_id(uint8_t* id, uint64_t n) {
+  memset(id, 0, kIdLen);
+  memcpy(id, &n, sizeof(n));
+}
+
+int main(int argc, char** argv) {
+  std::string path = argc > 1 ? argv[1] : "/dev/shm/rt_selftest";
+  const uint64_t kArena = 4 << 20;  // 4 MiB
+  void* s = rt_store_create(path.c_str(), kArena);
+  CHECK(s != nullptr);
+
+  // --- round trip -------------------------------------------------------
+  uint8_t id[kIdLen];
+  make_id(id, 1);
+  int64_t off = rt_create(s, id, 1024, 16, 1);
+  CHECK(off > 0);
+  uint8_t* base = rt_store_base(s);
+  memset(base + off, 0xAB, 1024 + 16);  // fill data+meta exactly
+  CHECK(rt_seal(s, id) == 0);
+  uint64_t dsz = 0, msz = 0;
+  int64_t goff = rt_get(s, id, &dsz, &msz, 1);
+  CHECK(goff == off && dsz == 1024 && msz == 16);
+  for (int i = 0; i < 1024; i++) CHECK(base[goff + i] == 0xAB);
+  CHECK(rt_release(s, id) == 0);
+  CHECK(rt_contains(s, id) == 1);
+
+  // --- abort of an unsealed object -------------------------------------
+  uint8_t id2[kIdLen];
+  make_id(id2, 2);
+  CHECK(rt_create(s, id2, 256, 0, 1) > 0);
+  CHECK(rt_abort(s, id2) == 0);
+  CHECK(rt_contains(s, id2) == 0);
+
+  // --- delete-pending while pinned --------------------------------------
+  make_id(id2, 3);
+  CHECK(rt_create(s, id2, 128, 0, 1) > 0);
+  CHECK(rt_seal(s, id2) == 0);
+  CHECK(rt_get(s, id2, &dsz, &msz, 1) > 0);
+  CHECK(rt_delete(s, id2) == 0);       // pinned: becomes delete-pending
+  CHECK(rt_release(s, id2) == 0);      // release completes the delete
+  CHECK(rt_contains(s, id2) == 0);
+
+  // --- eviction under pressure ------------------------------------------
+  // fill beyond capacity with 64 KiB objects; creates must keep
+  // succeeding via LRU eviction of sealed, unpinned entries
+  for (uint64_t n = 100; n < 100 + 128; n++) {
+    uint8_t eid[kIdLen];
+    make_id(eid, n);
+    int64_t o = rt_create(s, eid, 64 << 10, 0, 1);
+    CHECK(o > 0);
+    memset(base + o, (int)(n & 0xff), 64 << 10);
+    CHECK(rt_seal(s, eid) == 0);
+  }
+  uint64_t st[9];
+  rt_stats(s, st);
+  CHECK(st[3] > 0);       // evictions happened
+  CHECK(st[8] == 0);      // not poisoned
+
+  // --- cross-handle open -------------------------------------------------
+  void* s2 = rt_store_open(path.c_str());
+  CHECK(s2 != nullptr);
+  CHECK(rt_contains(s2, id) == rt_contains(s, id));
+
+  // --- concurrent hammering ---------------------------------------------
+  std::atomic<int> failures{0};
+  auto worker = [&](int tid) {
+    void* h = rt_store_open(path.c_str());
+    if (!h) { failures++; return; }
+    uint8_t* b = rt_store_base(h);
+    for (uint64_t n = 0; n < 200; n++) {
+      uint8_t wid[kIdLen];
+      make_id(wid, 10000 + tid * 1000 + n);
+      int64_t o = rt_create(h, wid, 4096, 0, 1);
+      if (o <= 0) continue;  // ENOMEM under pressure is legal
+      memset(b + o, tid, 4096);
+      if (rt_seal(h, wid) != 0) { failures++; continue; }
+      uint64_t d, m;
+      int64_t g = rt_get(h, wid, &d, &m, 1);
+      if (g > 0) {
+        if (b[g] != (uint8_t)tid || d != 4096) failures++;
+        rt_release(h, wid);
+      }
+      if (n % 3 == 0) rt_delete(h, wid);
+    }
+    rt_store_close(h);
+  };
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 4; t++) ts.emplace_back(worker, t);
+  for (auto& t : ts) t.join();
+  CHECK(failures.load() == 0);
+
+  rt_stats(s, st);
+  CHECK(st[8] == 0);
+  rt_store_close(s2);
+  rt_store_close(s);
+  remove(path.c_str());
+  printf("shm_store_selftest: OK\n");
+  return 0;
+}

@@ -56,16 +56,13 @@ class AppoLearner(ImpalaLearner):
         module = self.module
 
         def loss_fn(params, target_params, batch):
-            logits, values = _seq_forward(module, params, batch)
-            logp_all = jax.nn.log_softmax(logits)
-            cur_logp = jnp.take_along_axis(
-                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+            dist, values = _seq_forward(module, params, batch)
+            cur_logp, entropy = module.seq_logp_entropy(
+                dist, batch["actions"])
             # lagged copy: value targets + the off-policy correction's
             # target-policy term both come from the frozen params
-            t_logits, t_values = _seq_forward(module, target_params, batch)
-            t_logp_all = jax.nn.log_softmax(t_logits)
-            t_logp = jnp.take_along_axis(
-                t_logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+            t_dist, t_values = _seq_forward(module, target_params, batch)
+            t_logp, _ = module.seq_logp_entropy(t_dist, batch["actions"])
             discounts = gamma * (1.0 - batch["dones"])
             vt = vtrace(batch["behavior_logp"], t_logp, batch["rewards"],
                         discounts, t_values, batch["bootstrap_value"])
@@ -75,7 +72,7 @@ class AppoLearner(ImpalaLearner):
                                jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
             pg_loss = -surr.mean()
             vf_loss = ((values - vt.vs) ** 2).mean()
-            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            entropy = entropy.mean()
             total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
             return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
                            "entropy": entropy,

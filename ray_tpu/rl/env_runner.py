@@ -150,7 +150,8 @@ class EnvRunner:
         T = num_steps or self.cfg["rollout_fragment_length"]
         N = self.n_envs
         obs_buf = np.zeros((T, N) + self._cobs.shape[1:], np.float32)
-        act_buf = np.zeros((T, N), np.int64)
+        act_buf = np.zeros((T, N) + self.module.action_event_shape,
+                           self.module.action_np_dtype)
         logp_buf = np.zeros((T, N), np.float32)
         rew_buf = np.zeros((T, N), np.float32)
         done_buf = np.zeros((T, N), np.float32)
@@ -170,7 +171,12 @@ class EnvRunner:
             else:
                 action, logp, _value = self.module.sample_actions(
                     self.module.params, cobs.astype(np.float32), key)
-            nxt, rew, term, trunc, _ = self.envs.step(action)
+            # step with clipped actions; learn on the unclipped sample
+            # (its logp is what the behavior distribution produced)
+            env_action = (self.module.clip_actions(action)
+                          if hasattr(self.module, "clip_actions")
+                          else action)
+            nxt, rew, term, trunc, _ = self.envs.step(env_action)
             done = np.logical_or(term, trunc)
             obs_buf[t] = cobs
             act_buf[t] = action

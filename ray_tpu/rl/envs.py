@@ -140,6 +140,45 @@ class RepeatAfterMeEnv(_BASE):
                 self._t >= self.MAX_STEPS, {})
 
 
+class ContinuousRepeatAfterMeEnv(_BASE):
+    """Continuous-action memory probe — the Box-action sibling of
+    RepeatAfterMeEnv (reference: rllib repeat_after_me + its tuned
+    continuous variants): each step shows a random target in [-1, 1];
+    the reward pays 1 - |action - PREVIOUS step's target|. A memoryless
+    policy's best play is action=0 (E|target| = 0.5 → ~15.5 of 31);
+    carrying the previous observation approaches 31."""
+
+    MAX_STEPS = 32
+
+    def __init__(self, render_mode: Optional[str] = None):
+        self.observation_space = gym.spaces.Box(-1.0, 1.0, (1,),
+                                                np.float32)
+        self.action_space = gym.spaces.Box(-1.0, 1.0, (1,), np.float32)
+        self.render_mode = render_mode
+        self._rng = np.random.default_rng(0)
+        self._prev = None
+        self._cur = 0.0
+        self._t = 0
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._prev = None
+        self._cur = float(self._rng.uniform(-1.0, 1.0))
+        return np.array([self._cur], np.float32), {}
+
+    def step(self, action):
+        a = float(np.clip(np.asarray(action).ravel()[0], -1.0, 1.0))
+        reward = (0.0 if self._prev is None
+                  else 1.0 - abs(a - self._prev))
+        self._t += 1
+        self._prev = self._cur
+        self._cur = float(self._rng.uniform(-1.0, 1.0))
+        return (np.array([self._cur], np.float32), reward, False,
+                self._t >= self.MAX_STEPS, {})
+
+
 def register_envs():
     """Idempotently register the built-in envs with gymnasium."""
     if gym is None:
@@ -159,6 +198,12 @@ def register_envs():
     except Exception:
         gym.register(id="ray_tpu/RepeatAfterMe-v0",
                      entry_point="ray_tpu.rl.envs:RepeatAfterMeEnv")
+    try:
+        gym.spec("ray_tpu/ContinuousRepeatAfterMe-v0")
+    except Exception:
+        gym.register(
+            id="ray_tpu/ContinuousRepeatAfterMe-v0",
+            entry_point="ray_tpu.rl.envs:ContinuousRepeatAfterMeEnv")
 
 
 register_envs()

@@ -17,24 +17,29 @@ from ray_tpu.rl.config import AlgorithmConfig
 
 
 def _seq_forward(module, params, batch):
-    """(logits [T,B,A], values [T,B]) for a time-major trajectory batch,
-    recurrent- and conv-aware: feedforward modules flatten time into the
-    batch; recurrent modules re-derive every LSTM state with a scanned
-    unroll from the fragment's initial carry, resetting exactly where
-    the runner's episodes did (connector state discipline)."""
+    """(dist, values [T,B]) for a time-major trajectory batch, where
+    dist is the module family's distribution parameters (logits for
+    categorical, (mean, log_std) for Gaussian — consumed by the module's
+    `seq_logp_entropy`). Recurrent- and conv-aware: feedforward modules
+    flatten time into the batch; recurrent modules re-derive every LSTM
+    state with a scanned unroll from the fragment's initial carry,
+    resetting exactly where the runner's episodes did (connector state
+    discipline)."""
     import jax
     import jax.numpy as jnp
-    T, B = batch["actions"].shape
+    T, B = batch["dones"].shape
     if getattr(module, "is_recurrent", False):
         resets = jnp.concatenate(
             [jnp.zeros((1, B), jnp.float32), batch["dones"][:-1]], axis=0)
         carry0 = (batch["initial_state_c"], batch["initial_state_h"])
-        logits, values, _ = module.forward_seq(params, batch["obs"],
-                                               resets, carry0)
-        return logits, values
+        dist, values, _ = module.forward_seq(params, batch["obs"],
+                                             resets, carry0)
+        return dist, values
     obs = batch["obs"].reshape((T * B,) + batch["obs"].shape[2:])
-    logits, values = module.net.apply({"params": params}, obs)
-    return logits.reshape(T, B, -1), values.reshape(T, B)
+    dist, values = module.dist_values(params, obs)
+    dist = jax.tree.map(
+        lambda a: a.reshape((T, B) + a.shape[1:]), dist)
+    return dist, values.reshape(T, B)
 
 
 class ImpalaLearner:
@@ -85,17 +90,16 @@ class ImpalaLearner:
         module = self.module
 
         def loss_fn(params, batch):
-            logits, values = _seq_forward(module, params, batch)
-            logp_all = jax.nn.log_softmax(logits)
-            tgt_logp = jnp.take_along_axis(
-                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+            dist, values = _seq_forward(module, params, batch)
+            tgt_logp, entropy = module.seq_logp_entropy(
+                dist, batch["actions"])
             discounts = gamma * (1.0 - batch["dones"])
             vt = vtrace(batch["behavior_logp"], tgt_logp,
                         batch["rewards"], discounts, values,
                         batch["bootstrap_value"])
             pg_loss = -(tgt_logp * vt.pg_advantages).mean()
             vf_loss = ((values - vt.vs) ** 2).mean()
-            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            entropy = entropy.mean()
             total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
             return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
                            "entropy": entropy}
@@ -162,7 +166,9 @@ class IMPALA(Algorithm):
             # re-issue before learning: sampling overlaps the update
             self._inflight[runner.sample_trajectory.remote()] = runner
             metrics = self.learner.update_from_trajectory(traj)
-            steps += traj["actions"].size
+            # rewards is [T, N] for every action space; actions would
+            # over-count by action_dim on Box envs
+            steps += traj["rewards"].size
             n_updates += 1
         self._sync_weights()
         wall = time.perf_counter() - t0

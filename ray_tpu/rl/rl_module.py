@@ -88,6 +88,14 @@ class _ModuleBase:
     def forward(self, params, obs):
         return self._forward(params, obs)
 
+    def dist_values(self, params, obs):
+        """Traceable: (action-distribution params, values) for flat-batch
+        obs. The dist is whatever this family's `seq_logp_entropy`
+        consumes — logits for categorical, (mean, log_std) for Gaussian —
+        so the vtrace-family losses are action-space agnostic."""
+        logits, value = self.net.apply({"params": params}, obs)
+        return logits, value
+
     def get_weights(self):
         return jax.device_get(self.params)
 
@@ -95,11 +103,27 @@ class _ModuleBase:
         self.params = jax.device_put(weights)
 
 
+def _categorical_logp_entropy(logits, actions):
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(logp_all, actions[..., None], axis=-1)[..., 0]
+    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1)
+    return logp, entropy
+
+
+def _gaussian_logp_entropy(dist, actions):
+    mean, log_std = dist
+    z = (actions - mean) / jnp.exp(log_std)
+    logp = (-0.5 * (z ** 2) - log_std - 0.5 * math.log(2 * math.pi)).sum(-1)
+    entropy = (log_std + 0.5 * (1 + math.log(2 * math.pi))).sum(-1)
+    return logp, entropy
+
+
 class DiscreteRLModule(_ModuleBase):
     """Policy/value module for discrete action spaces (flat obs)."""
 
     action_np_dtype = np.int64
     action_event_shape: Tuple[int, ...] = ()
+    seq_logp_entropy = staticmethod(_categorical_logp_entropy)
 
     def __init__(self, obs_dim: int, action_dim: int,
                  hidden_sizes: Sequence[int] = (64, 64), seed: int = 0,
@@ -149,6 +173,7 @@ class ContinuousRLModule(_ModuleBase):
     time, matching rllib's default PPO setup)."""
 
     action_np_dtype = np.float32
+    seq_logp_entropy = staticmethod(_gaussian_logp_entropy)
 
     def __init__(self, obs_dim: int, action_dim: int,
                  hidden_sizes: Sequence[int] = (64, 64), seed: int = 0,
@@ -167,6 +192,10 @@ class ContinuousRLModule(_ModuleBase):
     def forward(self, params, obs):
         mean, log_std, value = self._forward(params, obs)
         return mean, value
+
+    def dist_values(self, params, obs):
+        mean, log_std, value = self.net.apply({"params": params}, obs)
+        return (mean, log_std), value
 
     def sample_actions(self, params, obs, rng):
         mean, log_std, value = self._forward(params, obs)
@@ -225,6 +254,7 @@ class RecurrentDiscreteRLModule(_ModuleBase):
     is_recurrent = True
     action_np_dtype = np.int64
     action_event_shape: Tuple[int, ...] = ()
+    seq_logp_entropy = staticmethod(_categorical_logp_entropy)
 
     def __init__(self, obs_dim: int, action_dim: int,
                  hidden_sizes: Sequence[int] = (64, 64), seed: int = 0):
@@ -287,6 +317,111 @@ class RecurrentDiscreteRLModule(_ModuleBase):
         self.params = jax.device_put(weights)
 
 
+class LSTMGaussianPolicyValueNet(nn.Module):
+    """Single-step recurrent Gaussian policy/value core for Box actions —
+    the continuous sibling of LSTMPolicyValueNet (reference:
+    rllib/models/torch/recurrent_net.py LSTMWrapper over a DiagGaussian
+    head). One step: (carry, obs[B,D]) -> (carry', ((mean, log_std),
+    value)); the dist is a pytree so the same lax.scan unroll stacks it
+    time-major."""
+    action_dim: int
+    hidden: int = 64
+    embed: int = 64
+
+    @nn.compact
+    def __call__(self, carry, obs):
+        x = nn.tanh(nn.Dense(self.embed)(obs))
+        carry, h = nn.OptimizedLSTMCell(self.hidden)(carry, x)
+        mean = nn.Dense(self.action_dim,
+                        kernel_init=nn.initializers.variance_scaling(
+                            0.01, "fan_avg", "uniform"))(h)
+        # start at sigma=e^-1~0.37, not 1.0: recurrent value estimation
+        # is slow to settle, and unit noise on a typically-[-1,1] Box
+        # swamps the memory signal for the first hundred updates
+        log_std = self.param("log_std",
+                             nn.initializers.constant(-1.0),
+                             (self.action_dim,))
+        value = nn.Dense(1)(h)[..., 0]
+        return carry, ((mean, jnp.broadcast_to(log_std, mean.shape)),
+                       value)
+
+
+class RecurrentContinuousRLModule(_ModuleBase):
+    """Recurrent (LSTM) module for Box action spaces: the
+    RecurrentDiscreteRLModule state contract (runner zeroes carries on
+    episode reset; learner re-derives every state with a scanned unroll
+    resetting at the same points) with a diagonal-Gaussian head.
+    Actions sample unsquashed; the env runner clips at step time."""
+
+    is_recurrent = True
+    action_np_dtype = np.float32
+    seq_logp_entropy = staticmethod(_gaussian_logp_entropy)
+
+    def __init__(self, obs_dim: int, action_dim: int,
+                 hidden_sizes: Sequence[int] = (64, 64), seed: int = 0,
+                 low=None, high=None):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.action_event_shape = (action_dim,)
+        self.low = None if low is None else np.asarray(low, np.float32)
+        self.high = None if high is None else np.asarray(high, np.float32)
+        self.hidden = int(hidden_sizes[0]) if hidden_sizes else 64
+        self.net = LSTMGaussianPolicyValueNet(action_dim,
+                                              hidden=self.hidden,
+                                              embed=self.hidden)
+        carry0 = self.initial_state(1)
+        self.params = self.net.init(jax.random.PRNGKey(seed), carry0,
+                                    jnp.zeros((1, obs_dim)))["params"]
+        self._step = jax.jit(
+            lambda p, c, o: self.net.apply({"params": p}, c, o))
+
+        def unroll(params, carry0, obs_seq, resets):
+            def body(carry, xs):
+                obs, reset = xs
+                carry = jax.tree.map(
+                    lambda c: c * (1.0 - reset)[:, None], carry)
+                carry, out = self.net.apply({"params": params}, carry, obs)
+                return carry, out
+            carry, (dist, values) = jax.lax.scan(
+                body, carry0, (obs_seq, resets))
+            return dist, values, carry
+
+        self._unroll = jax.jit(unroll)
+
+    def initial_state(self, batch_size: int):
+        z = jnp.zeros((batch_size, self.hidden), jnp.float32)
+        return (z, z)
+
+    def sample_actions(self, params, obs, rng, state=None):
+        """One env step: (actions, logp, value, new_state)."""
+        if state is None:
+            state = self.initial_state(len(obs))
+        state, ((mean, log_std), value) = self._step(params, state, obs)
+        std = jnp.exp(log_std)
+        noise = jax.random.normal(rng, mean.shape)
+        action = mean + std * noise
+        logp = (-0.5 * (noise ** 2) - log_std
+                - 0.5 * math.log(2 * math.pi)).sum(-1)
+        return (np.asarray(action), np.asarray(logp), np.asarray(value),
+                state)
+
+    def forward_seq(self, params, obs_seq, resets, carry0):
+        """Traceable sequence forward: ((mean, log_std) [T,B,A], values
+        [T,B], final carry)."""
+        return self._unroll(params, carry0, obs_seq, resets)
+
+    def forward(self, params, obs, state=None):
+        if state is None:
+            state = self.initial_state(len(obs))
+        state, ((mean, _log_std), value) = self._step(params, state, obs)
+        return mean, value
+
+    def clip_actions(self, actions: np.ndarray) -> np.ndarray:
+        if self.low is None:
+            return actions
+        return np.clip(actions, self.low, self.high)
+
+
 def action_spec_of(space) -> Dict:
     """gymnasium space -> serializable action spec."""
     import gymnasium as gym
@@ -307,17 +442,22 @@ def make_rl_module(obs_shape: Tuple[int, ...], action_spec: Dict,
     rllib's model_config use_lstm switch)."""
     obs_shape = tuple(obs_shape)
     if use_lstm:
-        if action_spec["type"] != "discrete":
-            raise ValueError("use_lstm currently supports discrete "
-                             "action spaces")
         if len(obs_shape) > 1:
             raise ValueError(
                 f"use_lstm requires flat observations, got shape "
                 f"{obs_shape}; stack a flattening connector or use the "
                 f"CNN module (conv+LSTM is not implemented)")
-        return RecurrentDiscreteRLModule(
-            int(np.prod(obs_shape)), action_spec["n"], hidden_sizes,
-            seed=seed)
+        if action_spec["type"] == "discrete":
+            return RecurrentDiscreteRLModule(
+                int(np.prod(obs_shape)), action_spec["n"], hidden_sizes,
+                seed=seed)
+        if action_spec["type"] == "box":
+            return RecurrentContinuousRLModule(
+                int(np.prod(obs_shape)), action_spec["dim"], hidden_sizes,
+                seed=seed, low=action_spec.get("low"),
+                high=action_spec.get("high"))
+        raise ValueError(f"use_lstm: unsupported action spec "
+                         f"{action_spec}")
     if action_spec["type"] == "discrete":
         if len(obs_shape) == 3:
             return ConvDiscreteRLModule(obs_shape, action_spec["n"],

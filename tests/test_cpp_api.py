@@ -20,6 +20,28 @@ while True:
 """
 
 
+def test_cpp_local_mode(tmp_path):
+    """Local-mode C++ runtime (reference: cpp local_mode_ray_runtime):
+    native tasks/actors execute in-process — no cluster. Covers task
+    registration, ref-dependency chaining, error propagation, FIFO actor
+    serialization under 4-thread submission, Put/Get/Wait."""
+    binary = str(tmp_path / "cpp_local")
+    build = subprocess.run(
+        ["g++", "-std=c++17", "-O2", "-o", binary,
+         os.path.join(ROOT, "ray_tpu/native/cpp_api/local_example.cpp"),
+         "-I", os.path.join(ROOT, "ray_tpu/native/cpp_api"),
+         "-lpthread"],
+        capture_output=True, text=True, timeout=120)
+    assert build.returncode == 0, build.stderr
+    out = subprocess.run([binary], capture_output=True, text=True,
+                         timeout=120)
+    assert out.returncode == 0, (out.returncode, out.stdout, out.stderr)
+    assert "LOCAL_MODE_OK" in out.stdout
+    assert "pow=1024" in out.stdout
+    assert "chain=10" in out.stdout
+    assert "actor_total=164" in out.stdout
+
+
 def test_cpp_client_cross_language(tmp_path):
     binary = str(tmp_path / "cpp_example")
     build = subprocess.run(

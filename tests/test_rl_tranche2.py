@@ -86,6 +86,69 @@ def test_recurrent_unroll_matches_stepwise():
                                atol=1e-5)
 
 
+def test_gaussian_recurrent_unroll_matches_stepwise():
+    """Same state contract as the discrete LSTM, Gaussian head: the
+    scanned unroll re-derives the runner's states across mid-fragment
+    resets, and its (mean, log_std) pytree stacks time-major."""
+    import jax.numpy as jnp
+    from ray_tpu.rl.rl_module import RecurrentContinuousRLModule
+    m = RecurrentContinuousRLModule(3, 2, (32,), seed=0)
+    T, B = 6, 3
+    rng = np.random.default_rng(0)
+    obs = rng.normal(size=(T, B, 3)).astype(np.float32)
+    dones = np.zeros((T, B), np.float32)
+    dones[2, 1] = 1.0
+    dones[4, 0] = 1.0
+    state = m.initial_state(B)
+    means, values = [], []
+    for t in range(T):
+        state2, ((mean, _ls), v) = m._step(m.params, state,
+                                           jnp.asarray(obs[t]))
+        means.append(np.asarray(mean))
+        values.append(np.asarray(v))
+        mask = 1.0 - dones[t][:, None]
+        state = tuple(np.asarray(s) * mask for s in state2)
+    resets = np.concatenate([np.zeros((1, B), np.float32), dones[:-1]], 0)
+    (mean_u, _ls_u), v_u, _ = m._unroll(m.params, m.initial_state(B),
+                                        jnp.asarray(obs),
+                                        jnp.asarray(resets))
+    np.testing.assert_allclose(np.stack(means), np.asarray(mean_u),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.stack(values), np.asarray(v_u),
+                               atol=1e-5)
+
+
+def test_gaussian_seq_logp_matches_feedforward_contract():
+    """The recurrent-continuous module's (dist, actions) -> (logp,
+    entropy) must agree with the feedforward ContinuousRLModule's
+    logp_entropy_value semantics — both are the same diagonal
+    Gaussian."""
+    import jax.numpy as jnp
+    from ray_tpu.rl.rl_module import (ContinuousRLModule,
+                                      RecurrentContinuousRLModule,
+                                      make_rl_module)
+    m = make_rl_module((3,), {"type": "box", "dim": 2,
+                              "low": [-1, -1], "high": [1, 1]},
+                       use_lstm=True)
+    assert isinstance(m, RecurrentContinuousRLModule)
+    ff = ContinuousRLModule(3, 2, (16,), seed=1)
+    rng = np.random.default_rng(2)
+    obs = jnp.asarray(rng.normal(size=(5, 3)).astype(np.float32))
+    acts = jnp.asarray(rng.normal(size=(5, 2)).astype(np.float32))
+    logp_ref, ent_ref, _v = ff.logp_entropy_value(ff.params, obs, acts)
+    dist, _v2 = ff.dist_values(ff.params, obs)
+    logp, ent = ff.seq_logp_entropy(dist, acts)
+    np.testing.assert_allclose(np.asarray(logp), np.asarray(logp_ref),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ent), np.asarray(ent_ref),
+                               atol=1e-6)
+    # recurrent module shares the same static logp/entropy fn
+    assert m.seq_logp_entropy is ff.seq_logp_entropy
+    # clip_actions respects the action-spec bounds
+    clipped = m.clip_actions(np.array([[2.0, -3.0]], np.float32))
+    np.testing.assert_allclose(clipped, [[1.0, -1.0]])
+
+
 def test_use_lstm_gated_to_vtrace_family(ray_start):
     """use_lstm with PPO must fail loudly at construction (the PPO
     minibatch learner is feedforward-only), and 3D obs with LSTM fail
@@ -159,6 +222,25 @@ def test_appo_lstm_repeat_after_me(ray_start):
                         target_update_freq=2, gamma=0.9))
     first, best = _run_algo_until(APPO(config), stop_reward=25,
                                   max_iters=80)
+    assert best >= 25, (first, best)
+
+
+@pytest.mark.slow
+def test_appo_lstm_continuous_repeat_after_me(ray_start):
+    """Continuous recurrence gate: reward echoes the PREVIOUS
+    observation's target value with a Box action, so a memoryless
+    Gaussian policy caps at ~15.5 of 31 (action=0 vs E|target|=0.5) —
+    clearing 25 requires the LSTM to carry the observation."""
+    from ray_tpu.rl import APPO
+    config = (AlgorithmConfig()
+              .environment("ray_tpu/ContinuousRepeatAfterMe-v0")
+              .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                           rollout_fragment_length=32)
+              .training(lr=2e-3, entropy_coeff=0.0, clip_param=0.3,
+                        num_epochs=4, hidden_sizes=(64,), use_lstm=True,
+                        target_update_freq=2, gamma=0.5))
+    first, best = _run_algo_until(APPO(config), stop_reward=25,
+                                  max_iters=120)
     assert best >= 25, (first, best)
 
 
