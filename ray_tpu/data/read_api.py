@@ -302,6 +302,96 @@ def read_sql(sql: str, connection_factory, *,
     return Dataset([exe.ReadStage(fns)])
 
 
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline: Optional[List[Dict]] = None,
+               shard_match: Optional[List[Dict]] = None,
+               client_factory=None) -> Dataset:
+    """MongoDB collection -> Dataset (reference: read_mongo /
+    _internal/datasource/mongo_datasource.py). Connections are created
+    INSIDE each read task via `client_factory` (zero-arg callable
+    returning a pymongo-compatible client: ``client[db][coll]
+    .aggregate(pipeline)`` yielding mapping rows) so clients never
+    pickle; default factory imports pymongo, failing with a clear error
+    when absent (pymongo is not bundled).
+
+    Parallelism mirrors the reference's partitioned reads: pass
+    `shard_match` = one $match filter document per shard (e.g. hash
+    ranges over _id) to get one read task per shard; otherwise a single
+    task streams the whole aggregation.
+    """
+    base = list(pipeline or [])
+
+    def make(match):
+        def read():
+            if client_factory is not None:
+                client = client_factory()
+            else:
+                try:
+                    import pymongo
+                except ImportError as e:
+                    raise ImportError(
+                        "read_mongo needs pymongo (not bundled) or an "
+                        "explicit client_factory") from e
+                client = pymongo.MongoClient(uri)
+            try:
+                pipe = ([{"$match": match}] if match else []) + base
+                cursor = client[database][collection].aggregate(pipe)
+                rows = []
+                for doc in cursor:
+                    d = dict(doc)
+                    # ObjectId and friends aren't arrow types
+                    if "_id" in d and not isinstance(
+                            d["_id"], (str, int, float, bytes)):
+                        d["_id"] = str(d["_id"])
+                    rows.append(d)
+            finally:
+                close = getattr(client, "close", None)
+                if close:
+                    close()
+            import pyarrow as pa
+            return block_lib.block_from_rows(rows) if rows else pa.table({})
+        return read
+
+    fns = ([make(m) for m in shard_match] if shard_match
+           else [make(None)])
+    return Dataset([exe.ReadStage(fns)])
+
+
+def read_bigquery(query: Optional[str] = None, *,
+                  project_id: Optional[str] = None,
+                  dataset: Optional[str] = None,
+                  client_factory=None) -> Dataset:
+    """BigQuery query/table -> Dataset (reference: read_bigquery /
+    _internal/datasource/bigquery_datasource.py). `client_factory` is a
+    zero-arg callable returning a google-cloud-bigquery-compatible
+    client (``client.query(sql).result()`` yielding mapping rows),
+    constructed INSIDE the read task; the default factory imports
+    google.cloud.bigquery (not bundled) with a clear error. Passing
+    `dataset` ("ds.table") without `query` reads the whole table, like
+    the reference."""
+    if query is None:
+        if dataset is None:
+            raise ValueError("read_bigquery needs `query` or `dataset`")
+        query = f"SELECT * FROM `{dataset}`"
+
+    def read():
+        if client_factory is not None:
+            client = client_factory()
+        else:
+            try:
+                from google.cloud import bigquery
+            except ImportError as e:
+                raise ImportError(
+                    "read_bigquery needs google-cloud-bigquery (not "
+                    "bundled) or an explicit client_factory") from e
+            client = bigquery.Client(project=project_id)
+        rows = [dict(r) for r in client.query(query).result()]
+        import pyarrow as pa
+        return block_lib.block_from_rows(rows) if rows else pa.table({})
+
+    return Dataset([exe.ReadStage([read])])
+
+
 def read_webdataset(paths, *, decode: bool = True) -> Dataset:
     """WebDataset tar shards -> one row per sample (reference:
     read_webdataset / webdataset_datasource.py). Files sharing a

@@ -148,3 +148,97 @@ def test_read_webdataset(ray_start, tmp_path):
     r0 = next(r for r in rows if r["__key__"] == "sample000")
     assert r0["cls"] == 0 and r0["txt"] == "sample 0"
     assert r0["json"] == {"idx": 0}
+
+
+# ------------------------------------------------- mongo / bigquery fakes
+class _FakeMongoCollection:
+    def __init__(self, docs):
+        self._docs = docs
+
+    def aggregate(self, pipeline):
+        docs = self._docs
+        for stage in pipeline:
+            if "$match" in stage:
+                m = stage["$match"]
+                docs = [d for d in docs
+                        if all(d.get(k) == v for k, v in m.items())]
+            elif "$project" in stage:
+                keep = [k for k, v in stage["$project"].items() if v]
+                docs = [{k: d[k] for k in keep if k in d} for d in docs]
+            else:
+                raise ValueError(f"fake mongo: unsupported stage {stage}")
+        return iter(docs)
+
+
+class _FakeMongoClient:
+    """pymongo surface: client[db][coll].aggregate(...)"""
+
+    def __init__(self):
+        self.closed = False
+
+    def __getitem__(self, db):
+        return {"events": _FakeMongoCollection(
+            [{"_id": _FakeObjectId(i), "grp": "ab"[i % 2], "v": i}
+             for i in range(10)])}
+
+    def close(self):
+        self.closed = True
+
+
+class _FakeObjectId:
+    """Non-arrow-native id type: read_mongo must stringify it."""
+
+    def __init__(self, i):
+        self.i = i
+
+    def __str__(self):
+        return f"oid-{self.i:04d}"
+
+
+def test_read_mongo_single_and_sharded(ray_start):
+    ds = rd.read_mongo("mongodb://unused", "db", "events",
+                       client_factory=_FakeMongoClient)
+    rows = ds.take_all()
+    assert len(rows) == 10
+    assert rows[0]["_id"].startswith("oid-")  # ObjectId stringified
+
+    sharded = rd.read_mongo(
+        "mongodb://unused", "db", "events",
+        pipeline=[{"$project": {"grp": 1, "v": 1}}],
+        shard_match=[{"grp": "a"}, {"grp": "b"}],
+        client_factory=_FakeMongoClient)
+    assert sharded.num_blocks() == 2
+    rows = sharded.take_all()
+    assert len(rows) == 10
+    assert {r["grp"] for r in rows} == {"a", "b"}
+    assert all("_id" not in r for r in rows)  # $project applied
+
+
+class _FakeBqJob:
+    def __init__(self, rows):
+        self._rows = rows
+
+    def result(self):
+        return iter(self._rows)
+
+
+class _FakeBqClient:
+    """google-cloud-bigquery surface: client.query(sql).result()"""
+
+    def query(self, sql):
+        assert "FROM" in sql, sql
+        if "`ds.t`" in sql:   # whole-table form built by read_bigquery
+            return _FakeBqJob([{"x": i, "name": f"n{i}"} for i in range(5)])
+        return _FakeBqJob([{"x": 1}])
+
+
+def test_read_bigquery_query_and_table(ray_start):
+    ds = rd.read_bigquery("SELECT x FROM t", client_factory=_FakeBqClient)
+    assert ds.take_all() == [{"x": 1}]
+
+    ds2 = rd.read_bigquery(dataset="ds.t", client_factory=_FakeBqClient)
+    rows = ds2.take_all()
+    assert len(rows) == 5 and rows[0] == {"x": 0, "name": "n0"}
+
+    with pytest.raises(ValueError, match="query.*or.*dataset"):
+        rd.read_bigquery()
