@@ -49,10 +49,19 @@ class Algorithm:
         cfg_dict["obs_shape"] = list(obs_shape)
         cfg_dict["action_spec"] = spec
         runner_cls = ray_tpu.remote(EnvRunner)
-        self.env_runners = [
-            runner_cls.remote({**cfg_dict, "runner_index": i})
-            for i in range(config.num_env_runners)]
+        from ray_tpu.rl.actor_manager import FaultTolerantRunnerSet
+        self.env_runners = FaultTolerantRunnerSet(
+            lambda i: runner_cls.remote({**cfg_dict, "runner_index": i}),
+            config.num_env_runners,
+            max_restarts=config.max_env_runner_restarts,
+            restart_enabled=config.restart_failed_env_runners)
         self._build_learner(cfg_dict, obs_dim, action_dim)
+        # restarted runners immediately receive the CURRENT weights (a
+        # fresh actor would otherwise sample one round at init weights)
+        self.env_runners.set_on_restart(
+            lambda r: ray_tpu.get(
+                r.set_weights.remote(ray_tpu.put(self.get_weights())),
+                timeout=300))
         self.iteration = 0
         self._sync_weights()
 
@@ -63,14 +72,13 @@ class Algorithm:
     def _sync_weights(self):
         import ray_tpu
         weights_ref = ray_tpu.put(self.learner_group.get_weights())
-        ray_tpu.get([r.set_weights.remote(weights_ref)
-                     for r in self.env_runners], timeout=300)
+        self.env_runners.foreach("set_weights", weights_ref, timeout=300)
 
     def training_step(self) -> Dict:
-        import ray_tpu
         t0 = time.perf_counter()
-        batches = ray_tpu.get(
-            [r.sample.remote() for r in self.env_runners], timeout=600)
+        # dead runners are replaced in-slot; the round proceeds on the
+        # survivors' batches (reference: restart_failed_env_runners)
+        batches = self.env_runners.foreach("sample", timeout=600)
         sample_time = time.perf_counter() - t0
         batch = {k: np.concatenate([b[k] for b in batches])
                  for k in batches[0]}
@@ -78,8 +86,8 @@ class Algorithm:
         learn_metrics = self.learner_group.update_from_batch(batch)
         learn_time = time.perf_counter() - t1
         self._sync_weights()
-        runner_metrics = ray_tpu.get(
-            [r.get_metrics.remote() for r in self.env_runners], timeout=120)
+        runner_metrics = self.env_runners.foreach("get_metrics",
+                                                  timeout=120)
         returns = [m["episode_return_mean"] for m in runner_metrics
                    if m["episode_return_mean"] is not None]
         steps = len(batch["obs"])

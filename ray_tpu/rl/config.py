@@ -45,6 +45,12 @@ class AlgorithmConfig:
     use_lstm: bool = False
     # APPO: learner steps between hard target-network syncs
     target_update_freq: int = 2
+    # env-runner fault tolerance (reference: AlgorithmConfig
+    # .fault_tolerance(restart_failed_env_runners=True) +
+    # rllib/utils/actor_manager.py): dead runners are replaced in-slot
+    # mid-training, current weights re-pushed, their round dropped
+    restart_failed_env_runners: bool = True
+    max_env_runner_restarts: int = 3
 
     # fluent builder API (reference: AlgorithmConfig chaining)
     def environment(self, env: str, env_config: Optional[Dict] = None):

@@ -148,8 +148,15 @@ class DQN:
         probe.close()
 
         runner_cls = ray_tpu.remote(QEnvRunner)
-        self.env_runners = [runner_cls.remote({**cfg, "runner_index": i})
-                            for i in range(config.num_env_runners)]
+        from ray_tpu.rl.actor_manager import FaultTolerantRunnerSet
+        self.env_runners = FaultTolerantRunnerSet(
+            lambda i: runner_cls.remote({**cfg, "runner_index": i}),
+            config.num_env_runners,
+            max_restarts=config.max_env_runner_restarts,
+            restart_enabled=config.restart_failed_env_runners,
+            on_restart=lambda r: __import__("ray_tpu").get(
+                r.set_weights.remote(self._current_weights_ref()),
+                timeout=300))
         self.buffer = make_replay_buffer(
             config.replay_buffer_config, cfg.get("replay_capacity", 50_000),
             seed=config.seed)
@@ -193,21 +200,22 @@ class DQN:
         self.epsilon = 1.0
         self._sync_runner_weights()
 
-    def _sync_runner_weights(self):
+    def _current_weights_ref(self):
         import jax
         import ray_tpu
-        ref = ray_tpu.put(jax.device_get(self.params))
-        ray_tpu.get([r.set_weights.remote(ref) for r in self.env_runners],
-                    timeout=300)
+        return ray_tpu.put(jax.device_get(self.params))
+
+    def _sync_runner_weights(self):
+        self.env_runners.foreach("set_weights",
+                                 self._current_weights_ref(), timeout=300)
 
     def training_step(self) -> Dict:
         import jax.numpy as jnp
         import ray_tpu
         cfg = self.config
         t0 = time.perf_counter()
-        batches = ray_tpu.get(
-            [r.sample.remote(epsilon=self.epsilon)
-             for r in self.env_runners], timeout=600)
+        batches = self.env_runners.foreach(
+            "sample", epsilon=self.epsilon, timeout=600)
         steps = 0
         for b in batches:
             self.buffer.add(b)
@@ -234,8 +242,8 @@ class DQN:
             loss = float(loss)
         self._sync_runner_weights()
         wall = time.perf_counter() - t0
-        runner_metrics = ray_tpu.get(
-            [r.get_metrics.remote() for r in self.env_runners], timeout=120)
+        runner_metrics = self.env_runners.foreach("get_metrics",
+                                                  timeout=120)
         returns = [m["episode_return_mean"] for m in runner_metrics
                    if m["episode_return_mean"] is not None]
         return {"episode_return_mean":

@@ -142,8 +142,7 @@ class IMPALA(Algorithm):
     def _sync_weights(self):
         import ray_tpu
         ref = ray_tpu.put(self.learner.get_weights())
-        ray_tpu.get([r.set_weights.remote(ref) for r in self.env_runners],
-                    timeout=300)
+        self.env_runners.foreach("set_weights", ref, timeout=300)
 
     def training_step(self) -> Dict:
         import ray_tpu
@@ -162,7 +161,25 @@ class IMPALA(Algorithm):
                                     timeout=600)
             ref = ready[0]
             runner = self._inflight.pop(ref)
-            traj = ray_tpu.get(ref)
+            try:
+                traj = ray_tpu.get(ref)
+            except ray_tpu.ActorDiedError:
+                # dead runner: replace in-slot (on_restart pushes current
+                # weights) and put the replacement to work; this round
+                # learns one fewer fragment. replace() returns None when
+                # a foreach (e.g. weight sync) already replaced it — the
+                # replacement is then the idle runner with no in-flight
+                # work, so schedule that one.
+                fresh = self.env_runners.replace(runner)
+                if fresh is None:
+                    busy = {id(r) for r in self._inflight.values()}
+                    idle = [r for r in self.env_runners
+                            if id(r) not in busy]
+                    fresh = idle[0] if idle else None
+                if fresh is not None:
+                    self._inflight[fresh.sample_trajectory.remote()] = fresh
+                n_updates += 1
+                continue
             # re-issue before learning: sampling overlaps the update
             self._inflight[runner.sample_trajectory.remote()] = runner
             metrics = self.learner.update_from_trajectory(traj)
@@ -172,8 +189,8 @@ class IMPALA(Algorithm):
             n_updates += 1
         self._sync_weights()
         wall = time.perf_counter() - t0
-        runner_metrics = ray_tpu.get(
-            [r.get_metrics.remote() for r in self.env_runners], timeout=120)
+        runner_metrics = self.env_runners.foreach("get_metrics",
+                                                  timeout=120)
         returns = [m["episode_return_mean"] for m in runner_metrics
                    if m["episode_return_mean"] is not None]
         return {

@@ -208,8 +208,15 @@ class SAC:
         probe.close()
 
         runner_cls = ray_tpu.remote(SacEnvRunner)
-        self.env_runners = [runner_cls.remote({**cfg, "runner_index": i})
-                            for i in range(config.num_env_runners)]
+        from ray_tpu.rl.actor_manager import FaultTolerantRunnerSet
+        self.env_runners = FaultTolerantRunnerSet(
+            lambda i: runner_cls.remote({**cfg, "runner_index": i}),
+            config.num_env_runners,
+            max_restarts=config.max_env_runner_restarts,
+            restart_enabled=config.restart_failed_env_runners,
+            on_restart=lambda r: __import__("ray_tpu").get(
+                r.set_weights.remote(self._current_weights_ref()),
+                timeout=300))
         self.buffer = make_replay_buffer(config.replay_buffer_config,
                                          config.replay_capacity,
                                          seed=config.seed)
@@ -306,12 +313,14 @@ class SAC:
         self._warmup = True
         self._sync_runner_weights()
 
-    def _sync_runner_weights(self):
+    def _current_weights_ref(self):
         import jax
         import ray_tpu
-        ref = ray_tpu.put(jax.device_get(self.state["pi"]))
-        ray_tpu.get([r.set_weights.remote(ref) for r in self.env_runners],
-                    timeout=300)
+        return ray_tpu.put(jax.device_get(self.state["pi"]))
+
+    def _sync_runner_weights(self):
+        self.env_runners.foreach("set_weights",
+                                 self._current_weights_ref(), timeout=300)
 
     def training_step(self) -> Dict:
         import jax
@@ -319,9 +328,8 @@ class SAC:
         import ray_tpu
         cfg = self.config
         t0 = time.perf_counter()
-        batches = ray_tpu.get(
-            [r.sample.remote(random_actions=self._warmup)
-             for r in self.env_runners], timeout=600)
+        batches = self.env_runners.foreach(
+            "sample", random_actions=self._warmup, timeout=600)
         self._warmup = False
         steps = 0
         for b in batches:
@@ -346,9 +354,8 @@ class SAC:
             metrics = {k: float(v) for k, v in metrics.items()}
         self._sync_runner_weights()
         wall = time.perf_counter() - t0
-        runner_metrics = ray_tpu.get(
-            [r.get_metrics.remote() for r in self.env_runners],
-            timeout=120)
+        runner_metrics = self.env_runners.foreach("get_metrics",
+                                                  timeout=120)
         returns = [m["episode_return_mean"] for m in runner_metrics
                    if m["episode_return_mean"] is not None]
         return {"episode_return_mean":
