@@ -54,6 +54,8 @@ def build(name: str) -> str:
 # robust-mutex arena is cross-process, which tsan models poorly.
 _SELFTESTS = {
     "shm_store_selftest": ["shm_store_selftest.cpp", "shm_store.cpp"],
+    "mutable_channel_selftest": ["mutable_channel_selftest.cpp",
+                                 "mutable_channel.cpp"],
 }
 
 

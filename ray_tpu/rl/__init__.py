@@ -1,4 +1,6 @@
 from ray_tpu.rl.algorithm import PPO, Algorithm
+from ray_tpu.rl.actor_manager import (FaultTolerantRunnerSet,
+                                      RunnerSetBroken)
 from ray_tpu.rl.appo import APPO
 from ray_tpu.rl.config import AlgorithmConfig
 from ray_tpu.rl.dqn import DQN
@@ -18,4 +20,5 @@ __all__ = ["Algorithm", "PPO", "APPO", "IMPALA", "DQN", "SAC",
            "AlgorithmConfig", "ReplayBuffer", "PrioritizedReplayBuffer",
            "make_replay_buffer", "vtrace", "MultiAgentEnv",
            "MultiAgentConfig", "MultiAgentEnvRunner", "MultiAgentPPO",
-           "BC", "BCConfig", "record_experiences"]
+           "BC", "BCConfig", "record_experiences",
+           "FaultTolerantRunnerSet", "RunnerSetBroken"]

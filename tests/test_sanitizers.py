@@ -20,14 +20,19 @@ import pytest
 
 
 @pytest.mark.slow
-def test_native_asan_selftest():
-    """shm_store under ASan+UBSan: build the standalone harness and run
-    it; sanitizer findings abort with nonzero exit + report on stderr."""
+@pytest.mark.parametrize("name,shm", [
+    ("shm_store_selftest", "/dev/shm/rt_selftest_pytest"),
+    ("mutable_channel_selftest", "/dev/shm/rtc_selftest_pytest"),
+])
+def test_native_asan_selftest(name, shm):
+    """Native components under ASan+UBSan: build the standalone harness
+    and run it; sanitizer findings abort with nonzero exit + report on
+    stderr."""
     from ray_tpu.native.build import build_selftest
-    binary = build_selftest("shm_store_selftest")
-    r = subprocess.run([binary, "/dev/shm/rt_selftest_pytest"],
+    binary = build_selftest(name)
+    r = subprocess.run([binary, shm],
                        capture_output=True, text=True, timeout=300)
-    assert r.returncode == 0, r.stderr[-4000:]
+    assert r.returncode == 0, (r.stdout, r.stderr[-4000:])
     assert "OK" in r.stdout
 
 
