@@ -594,10 +594,20 @@ def main():
         if ceil:
             results["single_client_put_gb_per_s"]["vs_box_ceiling"] = \
                 round(putv / ceil, 3)
+            # first-class per-round ratchet for the off-loop put path:
+            # single-client put bandwidth as a fraction of THIS box's warm
+            # memcpy ceiling (the irreducible one-copy cost). Target >=0.80
+            # since the caller-thread dispatch landed.
+            results["put_efficiency"] = {
+                "value": round(putv / ceil, 3),
+                "unit": "fraction_of_memcpy_ceiling",
+                "copy_threads_knob": "RAY_TPU_PUT_COPY_THREADS"}
         log(f"box ceilings: n:n/1:1 async = "
             f"{results['actor_calls_async_n_n_per_s'].get('vs_box_ceiling')}"
             f", put/memcpy = "
             f"{results['single_client_put_gb_per_s'].get('vs_box_ceiling')}")
+        log(f"put_efficiency: "
+            f"{results.get('put_efficiency', {}).get('value')}")
     except (KeyError, TypeError) as e:
         log(f"box-ceiling ratios unavailable: {e}")
 

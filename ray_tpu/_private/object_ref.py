@@ -13,11 +13,18 @@ worker runtime and registers the borrow with `_deserialization_hook`.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
+
+# Per-thread active serialization hook (set by serialization.serialize for
+# the duration of one pickling pass). Thread-local rather than a class
+# attribute: puts and task submissions serialize on their CALLING threads
+# concurrently, and a shared hook slot would cross-wire the contained-ref
+# tracking of unrelated serializations.
+_ser_tls = threading.local()
 
 
 class ObjectRef:
-    _serialization_hook = None     # set during serialize()
     _deserialization_hook = None   # set by the worker runtime at startup
 
     __slots__ = ("id", "owner_address", "_weakly_held")
@@ -51,7 +58,7 @@ class ObjectRef:
         return isinstance(other, ObjectRef) and other.id == self.id
 
     def __reduce__(self):
-        hook = ObjectRef._serialization_hook
+        hook = getattr(_ser_tls, "hook", None)
         if hook is not None:
             hook(self)
         return (_rebuild_ref, (self.id, self.owner_address))

@@ -62,9 +62,52 @@ class _Lib:
             lib.rt_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
             lib.rt_list.restype = ctypes.c_uint64
             lib.rt_list.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+            lib.rt_write_parallel.restype = None
+            lib.rt_write_parallel.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.c_int,
+            ]
             cls._instance = super().__new__(cls)
             cls._instance.lib = lib
         return cls._instance
+
+
+def copy_threads() -> int:
+    """Thread count for chunked arena copies (env RAY_TPU_PUT_COPY_THREADS;
+    default: min(4, cpu_count), so a 1-core host does one plain GIL-free
+    memcpy with no pool handoff)."""
+    global _COPY_THREADS
+    if _COPY_THREADS is None:
+        raw = os.environ.get("RAY_TPU_PUT_COPY_THREADS", "")
+        try:
+            n = int(raw)
+        except ValueError:
+            n = min(4, os.cpu_count() or 1)
+        _COPY_THREADS = max(1, n)
+    return _COPY_THREADS
+
+
+_COPY_THREADS = None
+
+
+def parallel_write(dst_mv: memoryview, src_mv: memoryview) -> bool:
+    """GIL-free (optionally multi-threaded) copy src_mv -> dst_mv through
+    the native store library. Returns False when the fast path can't be
+    taken (native lib unavailable, non-contiguous buffers) so the caller
+    falls back to a plain slice assignment."""
+    if not (dst_mv.contiguous and src_mv.contiguous):
+        return False
+    try:
+        lib = _Lib().lib
+        # numpy is address extraction only; no copy, handles readonly views
+        import numpy as np
+    except Exception:
+        return False
+    dst = np.frombuffer(dst_mv, dtype=np.uint8)
+    src = np.frombuffer(src_mv, dtype=np.uint8)
+    lib.rt_write_parallel(dst.ctypes.data, src.ctypes.data, src.nbytes,
+                          copy_threads())
+    return True
 
 
 def store_path(session_name: str, node_id_hex: str) -> str:
@@ -156,6 +199,15 @@ class ObjectStoreClient:
 
     # -- object ops ---------------------------------------------------------
 
+    def _handle(self):
+        """Live native handle, or a clean OSError after close(). Puts run
+        on caller threads now, so a put racing shutdown must fail as a
+        Python exception — never reach native code with a NULL store."""
+        h = self._h
+        if not h:
+            raise OSError(f"object store client for {self.path} is closed")
+        return h
+
     def create(self, oid: bytes, data_size: int, meta_size: int = 0,
                evictable: bool = True) -> Optional[Tuple[memoryview, memoryview]]:
         """Allocate a buffer; returns (data_view, meta_view) to write into.
@@ -163,7 +215,7 @@ class ObjectStoreClient:
         Returns None if the object already exists. Raises MemoryError if the
         arena is full even after LRU eviction.
         """
-        off = self._lib.rt_create(self._h, oid, data_size, meta_size,
+        off = self._lib.rt_create(self._handle(), oid, data_size, meta_size,
                                   1 if evictable else 0)
         if off == -17:  # EEXIST
             return None
@@ -174,7 +226,7 @@ class ObjectStoreClient:
         return data, meta
 
     def seal(self, oid: bytes) -> None:
-        rc = self._lib.rt_seal(self._h, oid)
+        rc = self._lib.rt_seal(self._handle(), oid)
         if rc != 0:
             raise KeyError(f"seal failed for {oid.hex()} rc={rc}")
 
@@ -183,13 +235,13 @@ class ObjectStoreClient:
         self.seal(oid)
 
     def abort(self, oid: bytes) -> None:
-        self._lib.rt_abort(self._h, oid)
+        self._lib.rt_abort(self._handle(), oid)
 
     def get(self, oid: bytes) -> Optional[SharedBuffer]:
         """Zero-copy read of a sealed object; None if not present."""
         dsize = ctypes.c_uint64()
         msize = ctypes.c_uint64()
-        off = self._lib.rt_get(self._h, oid, ctypes.byref(dsize),
+        off = self._lib.rt_get(self._handle(), oid, ctypes.byref(dsize),
                                ctypes.byref(msize), 1)
         if off < 0:
             return None
@@ -208,17 +260,17 @@ class ObjectStoreClient:
             self._lib.rt_release(self._h, oid)
 
     def contains(self, oid: bytes) -> bool:
-        return bool(self._lib.rt_contains(self._h, oid))
+        return bool(self._lib.rt_contains(self._handle(), oid))
 
     def delete(self, oid: bytes) -> None:
-        self._lib.rt_delete(self._h, oid)
+        self._lib.rt_delete(self._handle(), oid)
 
     def evict(self, nbytes: int) -> int:
-        return self._lib.rt_evict(self._h, nbytes)
+        return self._lib.rt_evict(self._handle(), nbytes)
 
     def gc_unsealed(self, max_age_sec: int = 300) -> int:
         """Reclaim orphaned never-sealed objects (writer died before seal)."""
-        return self._lib.rt_gc_unsealed(self._h, max_age_sec)
+        return self._lib.rt_gc_unsealed(self._handle(), max_age_sec)
 
     def put_bytes(self, oid: bytes, payload, metadata: bytes = b"") -> bool:
         """Convenience: create+write+seal. False if already present."""
@@ -227,7 +279,11 @@ class ObjectStoreClient:
         if bufs is None:
             return False
         data, meta = bufs
-        data[:] = payload
+        # same GIL-free chunked path as put's write_to (spill restores and
+        # cross-node transfers land multi-MB payloads through here)
+        if payload.nbytes < 4 * 1024 * 1024 or \
+                not parallel_write(data, payload):
+            data[:] = payload
         if metadata:
             meta[:] = metadata
         self.seal(oid)
@@ -235,7 +291,7 @@ class ObjectStoreClient:
 
     def stats(self) -> dict:
         arr = (ctypes.c_uint64 * 9)()
-        self._lib.rt_stats(self._h, arr)
+        self._lib.rt_stats(self._handle(), arr)
         keys = ["bytes_in_use", "capacity", "num_objects", "num_evictions",
                 "bytes_evicted", "create_count", "get_hits", "get_misses",
                 "poisoned"]
@@ -243,7 +299,7 @@ class ObjectStoreClient:
 
     def list_objects(self, max_n: int = 65536) -> list:
         buf = ctypes.create_string_buffer(max_n * ID_LEN)
-        n = self._lib.rt_list(self._h, buf, max_n)
+        n = self._lib.rt_list(self._handle(), buf, max_n)
         raw = buf.raw
         return [raw[i * ID_LEN:(i + 1) * ID_LEN] for i in range(n)]
 

@@ -34,6 +34,7 @@ NOTIFY = 2
 PARTIAL = 3     # [3, seq, idx, ok, payload] — streamed per-item response
 
 _MAX_FRAME = 1 << 31
+_EAGER_FLUSH_BYTES = 1 << 20    # frames this large skip the per-turn coalesce
 
 
 class RpcError(Exception):
@@ -266,6 +267,13 @@ class Connection:
         out = self._out
         out.append(len(data).to_bytes(4, "little"))
         out.append(data)
+        if len(data) >= _EAGER_FLUSH_BYTES:
+            # bulk frame (object transfer chunk, big inline value): hand
+            # it to the transport NOW so the kernel overlaps the send with
+            # the rest of this loop turn instead of buffering megabytes
+            # behind a call_soon
+            self._flush_out()
+            return
         if len(out) == 2:       # first frame this turn: schedule the flush
             asyncio.get_event_loop().call_soon(self._flush_out)
 

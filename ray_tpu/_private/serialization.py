@@ -25,6 +25,30 @@ import msgpack
 INLINE_THRESHOLD = 100 * 1024
 _ALIGN = 64
 
+# Buffers at or above this size are copied into the arena through the
+# native rt_write_parallel entry point (object_store.parallel_write):
+# ctypes drops the GIL for the call, so concurrent putters overlap, and
+# multi-core hosts additionally chunk the copy across a small pool.
+PARALLEL_COPY_MIN = 4 * 1024 * 1024
+
+_parallel_write = None     # resolved lazily; False = permanently unavailable
+
+
+def _native_copy(dst_mv: memoryview, src_mv: memoryview) -> bool:
+    global _parallel_write
+    if _parallel_write is None:
+        try:
+            from ray_tpu._private.object_store import parallel_write
+            _parallel_write = parallel_write
+        except Exception:
+            _parallel_write = False
+    if not _parallel_write:
+        return False
+    try:
+        return _parallel_write(dst_mv, src_mv)
+    except Exception:
+        return False
+
 KIND_PY = 0       # ordinary python object
 KIND_ERR = 1      # serialized exception (raised on get)
 KIND_RAW = 2      # raw bytes payload (zero pickling)
@@ -62,7 +86,9 @@ class SerializedObject:
         for b in self.buffers:
             mv = memoryview(b).cast("B")
             n = mv.nbytes
-            data_mv[off:off + n] = mv
+            if n < PARALLEL_COPY_MIN or \
+                    not _native_copy(data_mv[off:off + n], mv):
+                data_mv[off:off + n] = mv
             off += _aligned(n)
 
     def store_meta(self) -> bytes:
@@ -95,17 +121,17 @@ def serialize(obj: Any, ref_hook: Optional[Callable] = None) -> SerializedObject
         buffers.append(pb)
         return False  # out-of-band
 
-    from ray_tpu._private.object_ref import ObjectRef  # cycle-free at call time
-    prev = ObjectRef._serialization_hook
+    from ray_tpu._private import object_ref  # cycle-free at call time
+    prev = getattr(object_ref._ser_tls, "hook", None)
     try:
         def hook(ref):
             contained.append(ref)
             if ref_hook is not None:
                 ref_hook(ref)
-        ObjectRef._serialization_hook = staticmethod(hook)
+        object_ref._ser_tls.hook = hook
         pkl = cloudpickle.dumps(obj, protocol=5, buffer_callback=buffer_cb)
     finally:
-        ObjectRef._serialization_hook = prev
+        object_ref._ser_tls.hook = prev
     return SerializedObject(KIND_PY, pkl, buffers, contained)
 
 

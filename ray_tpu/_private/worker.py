@@ -28,6 +28,7 @@ import concurrent.futures
 import functools
 import hashlib
 import heapq
+import itertools
 import logging
 import os
 import threading
@@ -67,12 +68,25 @@ def _import_ref(ref: str):
     return target
 
 
-def _encode_arg(arg, ref_hook) -> list:
+def _encode_arg(arg, ref_hook, core=None) -> list:
     if isinstance(arg, ObjectRef):
         if ref_hook is not None:
             ref_hook(arg)
         return ["r", arg.id, arg.owner_address]
     s = serialization.serialize(arg, ref_hook=ref_hook)
+    if core is not None and core.store is not None and not s.is_inline():
+        # Large argument: seal it into the local shm arena on THIS thread
+        # and pass by reference (the reference promotes >100KB args to
+        # plasma the same way, put_arg path). The payload stays out of
+        # every RPC frame it would otherwise ride — GCS actor specs,
+        # per-retry task pushes — and its copy never occupies the owner
+        # loop. The implicit ref is pinned like any explicit ref arg for
+        # the task's duration via ref_hook.
+        core._spill_pressure_sync(s)
+        ref = core._put_serialized(s)
+        if ref_hook is not None:
+            ref_hook(ref)
+        return ["r", ref.id, ref.owner_address]
     kind, pkl, bufs = s.to_wire()
     return ["v", kind, pkl, bufs]
 
@@ -171,10 +185,16 @@ class CoreWorker:
         self.borrowed_counts: Dict[bytes, int] = {}
         self._local_refs: Dict[bytes, int] = {}
         self._pending_unrefs: List[bytes] = []
+        # put ids are drawn on the CALLING thread (off-loop put path);
+        # itertools.count is a single C-level op, safe under the GIL
+        self._put_counter = itertools.count(1)
+        # guards read-modify-write of _local_refs / borrowed_counts —
+        # ObjectRef hooks fire from user threads, executor threads and
+        # the loop alike
+        self._ref_lock = threading.Lock()
 
         # tasks
         self.pending_tasks: Dict[bytes, PendingTask] = {}
-        self._task_counter = 0
         # streaming generators: owner-side live generators by task id;
         # executor-side flow-control windows by task id (+ tombstones for
         # closes that raced ahead of execution)
@@ -344,7 +364,9 @@ class CoreWorker:
         loop = self.loop
 
         def local_ref(ref: ObjectRef):
-            self._local_refs[ref.id] = self._local_refs.get(ref.id, 0) + 1
+            # fires from any thread (refs are created on caller threads)
+            with self._ref_lock:
+                self._local_refs[ref.id] = self._local_refs.get(ref.id, 0) + 1
 
         def local_unref(ref: ObjectRef):
             # may fire from any thread / late interpreter shutdown
@@ -355,13 +377,15 @@ class CoreWorker:
                 pass
 
         def deser_hook(ref: ObjectRef):
-            self._local_refs[ref.id] = self._local_refs.get(ref.id, 0) + 1
-            if ref.owner_address and ref.owner_address != self.address:
-                if self.borrowed_counts.get(ref.id, 0) == 0:
-                    asyncio.run_coroutine_threadsafe(
-                        self._send_borrow(ref), loop)
-                self.borrowed_counts[ref.id] = \
-                    self.borrowed_counts.get(ref.id, 0) + 1
+            with self._ref_lock:
+                self._local_refs[ref.id] = self._local_refs.get(ref.id, 0) + 1
+                first_borrow = False
+                if ref.owner_address and ref.owner_address != self.address:
+                    cnt = self.borrowed_counts.get(ref.id, 0)
+                    first_borrow = cnt == 0
+                    self.borrowed_counts[ref.id] = cnt + 1
+            if first_borrow:
+                asyncio.run_coroutine_threadsafe(self._send_borrow(ref), loop)
 
         ObjectRef._local_ref_hook = staticmethod(local_ref)
         ObjectRef._local_unref_hook = staticmethod(local_unref)
@@ -375,15 +399,17 @@ class CoreWorker:
             pass
 
     def _dec_local_ref(self, oid: bytes, owner_address: str):
-        n = self._local_refs.get(oid, 0) - 1
-        if n > 0:
-            self._local_refs[oid] = n
-            return
-        self._local_refs.pop(oid, None)
+        with self._ref_lock:
+            n = self._local_refs.get(oid, 0) - 1
+            if n > 0:
+                self._local_refs[oid] = n
+                return
+            self._local_refs.pop(oid, None)
         if oid in self.owned:
             self._maybe_free(oid)
         elif owner_address and owner_address != self.address:
-            cnt = self.borrowed_counts.pop(oid, 0)
+            with self._ref_lock:
+                cnt = self.borrowed_counts.pop(oid, 0)
             if cnt > 0:
                 self._spawn(self._send_remove_borrow(oid, owner_address))
             self.memory_store.pop(oid, None)
@@ -494,10 +520,19 @@ class CoreWorker:
             return await self.gcs.call(method, **kw)
 
     # -------------------------------------------------- ownership bookkeeping
-    def _register_owned(self, oid: bytes, lineage=None, complete=False):
-        self.owned[oid] = {"borrowers": set(), "submitted": 0,
-                           "lineage": lineage, "location": None,
-                           "complete": complete}
+    def _register_owned(self, oid: bytes, lineage=None, complete=False,
+                        contained=None):
+        """Publish a fully-built owned entry in ONE dict store. Callers run
+        on user threads as well as the loop (off-loop puts, threadsafe task
+        submission); a single assignment is atomic under the GIL, so
+        loop-side readers never observe a half-initialized entry."""
+        entry = {"borrowers": set(), "submitted": 0,
+                 "lineage": lineage, "location": None,
+                 "complete": complete}
+        if contained is not None:
+            entry["contained"] = contained
+        self.owned[oid] = entry
+        return entry
 
     def h_add_borrow(self, conn, oid: bytes, borrower: str):
         entry = self.owned.get(oid)
@@ -519,36 +554,71 @@ class CoreWorker:
         return True
 
     # ----------------------------------------------------------------- put
+    # The put hot path runs ENTIRELY on the calling thread (reference:
+    # plasma writes happen on the caller with pickle-5 out-of-band buffers,
+    # ray paper §4.2): cloudpickle serialization, the spill-pressure check,
+    # store.create, the (GIL-free, chunked) arena copy and seal never touch
+    # the owner event loop. The loop is only involved for the rare blocking
+    # spill RPC and for waking any asyncio waiters on the object event.
     def put_local(self, value) -> ObjectRef:
-        """Synchronous put (callable from user threads)."""
-        return asyncio.run_coroutine_threadsafe(
-            self.put_async(value), self.loop).result()
+        """Synchronous put (callable from user threads AND from task code
+        executing inline on the loop — nothing here blocks on the loop)."""
+        s = serialization.serialize(value)
+        self._spill_pressure_sync(s)
+        return self._put_serialized(s)
 
     async def put_async(self, value) -> ObjectRef:
-        self._task_counter += 1
-        task_id = ids.new_task_id(ids.job_id_from_int(self.job_id))
-        oid = ids.object_id_for_put(task_id, self._task_counter)
         s = serialization.serialize(value)
-        ref = ObjectRef(oid, self.address)
-        self._register_owned(oid, complete=True)
+        await self._spill_pressure_async(s)
+        return self._put_serialized(s)
+
+    def _put_serialized(self, s: serialization.SerializedObject) -> ObjectRef:
+        task_id = ids.new_task_id(ids.job_id_from_int(self.job_id))
+        oid = ids.object_id_for_put(task_id, next(self._put_counter))
         # pin objects referenced from inside the stored value for the stored
         # value's lifetime (the reference pins nested refs the same way,
         # reference_count.h AddNestedObjectIds)
-        self.owned[oid]["contained"] = list(s.contained_refs)
-        if (not s.is_inline() and self.store is not None
-                and self.node_conn is not None):
-            # under memory pressure, spill sealed objects to disk before
-            # this create LRU-evicts them irrecoverably (reference: plasma
-            # creates wait on spilling, create_request_queue.h)
-            try:
-                st = self.store.stats()
-                cap = st["capacity"]
-                if cap and st["bytes_in_use"] + s.data_size() > 0.7 * cap:
-                    await self.node_conn.call("spill_now")
-            except Exception:
-                pass
+        self._register_owned(oid, complete=True,
+                             contained=list(s.contained_refs))
         self._store_serialized(oid, s)
-        return ref
+        return ObjectRef(oid, self.address)
+
+    def _needs_spill(self, s: serialization.SerializedObject) -> bool:
+        """Under memory pressure, spill sealed objects to disk before this
+        create LRU-evicts them irrecoverably (reference: plasma creates
+        wait on spilling, create_request_queue.h)."""
+        if s.is_inline() or self.store is None or self.node_conn is None:
+            return False
+        try:
+            st = self.store.stats()
+            cap = st["capacity"]
+            return bool(cap) and \
+                st["bytes_in_use"] + s.data_size() > 0.7 * cap
+        except Exception:
+            return False
+
+    def _spill_pressure_sync(self, s: serialization.SerializedObject):
+        if not self._needs_spill(s):
+            return
+        try:
+            if threading.get_ident() == self._loop_thread_ident:
+                # on the loop (inline-executed task code): blocking on our
+                # own loop would deadlock — kick the spill and let this
+                # create ride LRU eviction if it still can't fit
+                self._spawn(self.node_conn.call("spill_now"))
+            else:
+                asyncio.run_coroutine_threadsafe(
+                    self.node_conn.call("spill_now"), self.loop).result()
+        except Exception:
+            pass
+
+    async def _spill_pressure_async(self, s: serialization.SerializedObject):
+        if not self._needs_spill(s):
+            return
+        try:
+            await self.node_conn.call("spill_now")
+        except Exception:
+            pass
 
     def _store_serialized(self, oid: bytes, s: serialization.SerializedObject):
         if s.is_inline() or self.store is None:
@@ -558,9 +628,15 @@ class CoreWorker:
                 meta = s.store_meta()
                 bufs = self.store.create(oid, s.data_size(), len(meta))
                 if bufs is not None:
-                    data, meta_view = bufs
-                    s.write_to(data)
-                    meta_view[:] = meta
+                    try:
+                        data, meta_view = bufs
+                        s.write_to(data)
+                        meta_view[:] = meta
+                    except BaseException:
+                        # never leave a CREATED-but-unsealed object behind
+                        # for gc_unsealed to find minutes later
+                        self.store.abort(oid)
+                        raise
                     self.store.seal(oid)
                 self.memory_store[oid] = ("shm",)
                 entry = self.owned.get(oid)
@@ -571,7 +647,14 @@ class CoreWorker:
                 self.memory_store[oid] = ("wire",) + s.to_wire()
         ev = self.object_events.pop(oid, None)
         if ev is not None:
-            ev.set()
+            # asyncio.Event is not thread-safe: waiters park on the loop
+            if threading.get_ident() == self._loop_thread_ident:
+                ev.set()
+            else:
+                try:
+                    self.loop.call_soon_threadsafe(ev.set)
+                except RuntimeError:
+                    pass   # loop closing during shutdown
 
     # ----------------------------------------------------------------- get
     def get_local(self, refs, timeout: Optional[float] = None):
@@ -1076,8 +1159,8 @@ class CoreWorker:
         spec = {
             "task_id": task_id, "job_id": self.job_id,
             "name": name or getattr(func, "__name__", "task"),
-            "args": [_encode_arg(a, arg_refs.append) for a in args],
-            "kwargs": {k: _encode_arg(v, arg_refs.append)
+            "args": [_encode_arg(a, arg_refs.append, self) for a in args],
+            "kwargs": {k: _encode_arg(v, arg_refs.append, self)
                        for k, v in (kwargs or {}).items()},
             "return_ids": return_ids, "owner_address": self.address,
             "owner_node": self.node_id,
@@ -1684,8 +1767,9 @@ class CoreWorker:
             "actor_id": actor_id, "job_id": self.job_id,
             "class_id": cid, "name": name,
             "namespace": namespace or self.namespace,
-            "init_args": [_encode_arg(a, arg_refs.append) for a in init_args],
-            "init_kwargs": {k: _encode_arg(v, arg_refs.append)
+            "init_args": [_encode_arg(a, arg_refs.append, self)
+                          for a in init_args],
+            "init_kwargs": {k: _encode_arg(v, arg_refs.append, self)
                             for k, v in (init_kwargs or {}).items()},
             "resources": dict(resources or {"CPU": 1.0}),
             "max_restarts": max_restarts,
@@ -1771,8 +1855,8 @@ class CoreWorker:
         spec = {
             "task_id": task_id, "job_id": self.job_id, "name": method,
             "actor_id": actor_id, "method": method,
-            "args": [_encode_arg(a, arg_refs.append) for a in args],
-            "kwargs": {k: _encode_arg(v, arg_refs.append)
+            "args": [_encode_arg(a, arg_refs.append, self) for a in args],
+            "kwargs": {k: _encode_arg(v, arg_refs.append, self)
                        for k, v in (kwargs or {}).items()},
             "return_ids": return_ids, "owner_address": self.address,
             "owner_node": self.node_id,
@@ -2819,7 +2903,10 @@ class Worker:
 
     # public-api operations
     def put(self, value) -> ObjectRef:
-        return self._run(self.core.put_async(value))
+        # no loop bridge: serialization + arena copy + seal run right here
+        # on the calling thread (also makes put safe from inline-executed
+        # task code — it no longer blocks on the loop it runs on)
+        return self.core.put_local(value)
 
     def get(self, refs, timeout=None):
         single = isinstance(refs, ObjectRef)

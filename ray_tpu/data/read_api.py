@@ -372,6 +372,14 @@ def read_bigquery(query: Optional[str] = None, *,
     if query is None:
         if dataset is None:
             raise ValueError("read_bigquery needs `query` or `dataset`")
+        import re
+        # the name is interpolated into backtick-quoted SQL: restrict it
+        # to legal BigQuery dataset/table characters so a crafted string
+        # can't escape the quoting and smuggle SQL
+        if not re.fullmatch(r"[A-Za-z0-9_.$-]+", dataset):
+            raise ValueError(
+                f"invalid BigQuery dataset name {dataset!r}: expected "
+                "only letters, digits, '_', '.', '$' or '-'")
         query = f"SELECT * FROM `{dataset}`"
 
     def read():

@@ -49,9 +49,11 @@ def build(name: str) -> str:
 # Standalone sanitizer harnesses (the reference's build:asan/build:ubsan
 # CI story, .bazelrc:104-125): each entry is a main() program compiled
 # WITH the component sources under -fsanitize and run as a subprocess by
-# tests/test_sanitizers.py. tsan is available the same way
-# (sanitize="thread") but the suite runs asan+ubsan by default — the
-# robust-mutex arena is cross-process, which tsan models poorly.
+# tests/test_sanitizers.py. The suite runs asan+ubsan plus a
+# sanitize="thread" build of the shm store's concurrent sections (the
+# off-loop put path: allocator + rt_write_parallel copy pool). tsan runs
+# single-process multi-thread only — the cross-process robust-mutex
+# recovery path is beyond its model.
 _SELFTESTS = {
     "shm_store_selftest": ["shm_store_selftest.cpp", "shm_store.cpp"],
     "mutable_channel_selftest": ["mutable_channel_selftest.cpp",
@@ -63,6 +65,9 @@ def build_selftest(name: str, sanitize: str = "address,undefined") -> str:
     """Compile (if stale) a sanitizer selftest binary; returns its path."""
     srcs = [os.path.join(_HERE, s) for s in _SELFTESTS[name]]
     out = os.path.join(_BUILD_DIR, f"{name}.{sanitize.replace(',', '_')}")
+    # tsan's runtime slowdown (5-15x) is hostile at -O1 on 1-core CI
+    # hosts; -O2 keeps the hammer sections inside their test timeouts
+    opt = "-O2" if sanitize == "thread" else "-O1"
     return _compile(srcs, out,
-                    ["-O1", "-g", f"-fsanitize={sanitize}",
+                    [opt, "-g", f"-fsanitize={sanitize}",
                      "-fno-omit-frame-pointer"])

@@ -252,17 +252,19 @@ class LocalRuntime {
   }
 
   // Wait: indices of ready refs once num_ready are ready or timeout.
+  // timeout_ms < 0 blocks forever, matching Get's convention.
   std::vector<size_t> Wait(const std::vector<LocalObjectRef>& refs,
                            size_t num_ready, int64_t timeout_ms) {
+    const bool forever = timeout_ms < 0;
     auto deadline = std::chrono::steady_clock::now() +
-                    std::chrono::milliseconds(timeout_ms);
+                    std::chrono::milliseconds(forever ? 0 : timeout_ms);
     std::vector<size_t> ready;
     for (;;) {
       ready.clear();
       for (size_t i = 0; i < refs.size(); i++)
         if (refs[i].Ready()) ready.push_back(i);
       if (ready.size() >= num_ready ||
-          std::chrono::steady_clock::now() >= deadline)
+          (!forever && std::chrono::steady_clock::now() >= deadline))
         return ready;
       std::this_thread::sleep_for(std::chrono::milliseconds(1));
     }

@@ -18,8 +18,13 @@
 
 #include <atomic>
 #include <cerrno>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
 
 #include <fcntl.h>
 #include <pthread.h>
@@ -308,9 +313,113 @@ uint64_t evict_locked(Store* s, uint64_t bytes) {
   return freed;
 }
 
+// ------------------------------------------------------------ copy pool
+// Chunked arena copies for the put hot path: the Python binding (ctypes)
+// drops the GIL for the duration of the call, and the pool spreads large
+// memcpys across a few threads. Per-call latency on a 1-core host is the
+// memcpy itself (nthreads<=1 short-circuits to a plain memcpy, no pool
+// wakeup); wider hosts split the copy into near-equal 64B-aligned chunks.
+struct CopyBatch {
+  std::mutex mu;
+  std::condition_variable cv;
+  int remaining = 0;
+};
+
+struct CopyChunk {
+  uint8_t* dst;
+  const uint8_t* src;
+  uint64_t n;
+  CopyBatch* batch;
+};
+
+class CopyPool {
+ public:
+  static CopyPool& Instance() {
+    static CopyPool* pool = new CopyPool();  // never destroyed: workers may
+    return *pool;                            // outlive static teardown order
+  }
+
+  // Copy n bytes dst<-src split across `nchunks` pieces; the calling
+  // thread copies the first chunk itself, pool threads do the rest.
+  void Run(uint8_t* dst, const uint8_t* src, uint64_t n, int nchunks) {
+    if (nchunks > kMaxThreads) nchunks = kMaxThreads;
+    // 64B-aligned chunk size so no two threads share a cache line
+    uint64_t chunk = (n / nchunks + 63) & ~63ULL;
+    int pieces = (int)((n + chunk - 1) / chunk);
+    if (pieces <= 1) {
+      memcpy(dst, src, n);
+      return;
+    }
+    EnsureThreads(pieces - 1);
+    CopyBatch batch;
+    batch.remaining = pieces - 1;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (int i = 1; i < pieces; i++) {
+        uint64_t off = (uint64_t)i * chunk;
+        uint64_t len = off + chunk <= n ? chunk : n - off;
+        q_.push_back({dst + off, src + off, len, &batch});
+      }
+    }
+    cv_.notify_all();
+    memcpy(dst, src, chunk);  // caller's share overlaps the workers
+    std::unique_lock<std::mutex> g(batch.mu);
+    batch.cv.wait(g, [&] { return batch.remaining == 0; });
+  }
+
+ private:
+  static constexpr int kMaxThreads = 16;
+
+  void EnsureThreads(int want) {
+    std::lock_guard<std::mutex> g(mu_);
+    while ((int)threads_.size() < want && (int)threads_.size() < kMaxThreads)
+      threads_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      CopyChunk c;
+      {
+        std::unique_lock<std::mutex> g(mu_);
+        cv_.wait(g, [this] { return !q_.empty(); });
+        c = q_.front();
+        q_.pop_front();
+      }
+      memcpy(c.dst, c.src, c.n);
+      {
+        // notify while holding the lock: the batch lives on the caller's
+        // stack and is destroyed the moment Run() observes remaining==0,
+        // so the cv must not be touched after this block releases mu
+        std::lock_guard<std::mutex> g(c.batch->mu);
+        c.batch->remaining--;
+        c.batch->cv.notify_one();
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<CopyChunk> q_;
+  std::vector<std::thread> threads_;
+};
+
 }  // namespace
 
 extern "C" {
+
+// Chunked (optionally multi-threaded) memcpy into the arena. Called via
+// ctypes, which releases the GIL for the duration — large put copies no
+// longer serialize every Python thread in the process. threads<=1 (or a
+// copy too small to split) is a plain memcpy on the calling thread.
+void rt_write_parallel(void* dst, const void* src, uint64_t n, int threads) {
+  if (n == 0) return;
+  if (threads <= 1 || n < (1u << 20)) {
+    memcpy(dst, src, n);
+    return;
+  }
+  CopyPool::Instance().Run(static_cast<uint8_t*>(dst),
+                           static_cast<const uint8_t*>(src), n, threads);
+}
 
 void* rt_store_create(const char* path, uint64_t size) {
   // Always create a fresh inode (O_EXCL after unlink): truncating an

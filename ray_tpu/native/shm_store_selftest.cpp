@@ -34,6 +34,7 @@ int rt_delete(void* hs, const uint8_t* id);
 int rt_abort(void* hs, const uint8_t* id);
 uint64_t rt_evict(void* hs, uint64_t bytes);
 void rt_stats(void* hs, uint64_t* out);
+void rt_write_parallel(void* dst, const void* src, uint64_t n, int threads);
 }
 
 static constexpr int kIdLen = 20;
@@ -137,6 +138,65 @@ int main(int argc, char** argv) {
   for (int t = 0; t < 4; t++) ts.emplace_back(worker, t);
   for (auto& t : ts) t.join();
   CHECK(failures.load() == 0);
+
+  rt_stats(s, st);
+  CHECK(st[8] == 0);
+
+  // --- parallel chunked copies (the off-loop put data path) --------------
+  // correctness across split shapes (1 thread = plain memcpy; >1 exercises
+  // the pool, odd sizes exercise the tail chunk), then 4 caller threads
+  // hammering rt_write_parallel concurrently INTO the arena while others
+  // create/seal — the data race surface the tsan wiring exists to watch.
+  {
+    const uint64_t kN = (3 << 20) + 137;  // odd size: tail chunk
+    std::vector<uint8_t> src(kN), dst(kN);
+    for (uint64_t i = 0; i < kN; i++) src[i] = (uint8_t)(i * 31 + 7);
+    for (int threads : {1, 2, 4, 7}) {
+      memset(dst.data(), 0, kN);
+      rt_write_parallel(dst.data(), src.data(), kN, threads);
+      CHECK(memcmp(dst.data(), src.data(), kN) == 0);
+    }
+
+    // payloads above the 1 MiB split threshold so concurrent callers
+    // genuinely share the pool (queue + per-batch completion handshake);
+    // a separate 32 MiB arena keeps this from thrashing the tiny store
+    // the eviction section above sized deliberately small
+    std::string cpath = path + ".copy";
+    void* cs = rt_store_create(cpath.c_str(), 32 << 20);
+    CHECK(cs != nullptr);
+    std::atomic<int> copy_failures{0};
+    auto copier = [&](int tid) {
+      void* h = rt_store_open(cpath.c_str());
+      if (!h) { copy_failures++; return; }
+      uint8_t* b = rt_store_base(h);
+      std::vector<uint8_t> payload((3 << 20) + 64 * tid);
+      for (size_t i = 0; i < payload.size(); i++)
+        payload[i] = (uint8_t)(tid * 13 + i);
+      for (uint64_t n = 0; n < 20; n++) {
+        uint8_t wid[kIdLen];
+        make_id(wid, 50000 + tid * 1000 + n);
+        int64_t o = rt_create(h, wid, payload.size(), 0, 1);
+        if (o <= 0) continue;  // ENOMEM under pressure is legal
+        rt_write_parallel(b + o, payload.data(), payload.size(), 4);
+        if (rt_seal(h, wid) != 0) { copy_failures++; continue; }
+        uint64_t d, m;
+        int64_t g = rt_get(h, wid, &d, &m, 1);
+        if (g > 0) {
+          if (memcmp(b + g, payload.data(), payload.size()) != 0)
+            copy_failures++;
+          rt_release(h, wid);
+        }
+        rt_delete(h, wid);
+      }
+      rt_store_close(h);
+    };
+    std::vector<std::thread> cts;
+    for (int t = 0; t < 4; t++) cts.emplace_back(copier, t);
+    for (auto& t : cts) t.join();
+    CHECK(copy_failures.load() == 0);
+    rt_store_close(cs);
+    remove(cpath.c_str());
+  }
 
   rt_stats(s, st);
   CHECK(st[8] == 0);

@@ -80,16 +80,29 @@ class FaultTolerantRunnerSet(list):
     def foreach(self, method: str, *args, timeout: float = 600.0,
                 **kwargs) -> List[Any]:
         """Call `method` on every runner; per-runner result gather.
-        Dead runners are replaced and their result dropped — callers
-        get >=1 result or RunnerSetBroken."""
+        Dead AND timed-out runners are replaced and their result dropped —
+        callers get >=1 result or RunnerSetBroken. `timeout` is ONE shared
+        deadline for the whole gather (N runners never stretch a round to
+        N x timeout; a runner that hangs past the deadline is treated as
+        failed exactly like one that died)."""
+        import time
+
         import ray_tpu
         calls = [(r, getattr(r, method).remote(*args, **kwargs))
                  for r in list(self)]
         results = []
+        deadline = time.monotonic() + timeout
         for runner, ref in calls:
+            remaining = deadline - time.monotonic()
             try:
-                results.append(ray_tpu.get(ref, timeout=timeout))
+                results.append(
+                    ray_tpu.get(ref, timeout=max(0.001, remaining)))
             except ray_tpu.ActorDiedError:
+                self.replace(runner)
+            except TimeoutError:   # asyncio.TimeoutError is an alias
+                logger.warning(
+                    "env runner hung in %s past the %.0fs deadline; "
+                    "treating it as failed", method, timeout)
                 self.replace(runner)
         if not results:
             raise RunnerSetBroken(f"every env runner died during {method}")

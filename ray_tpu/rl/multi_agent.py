@@ -57,6 +57,10 @@ class MultiAgentConfig:
     grad_clip: float = 0.5
     hidden_sizes: tuple = (64, 64)
     seed: int = 0
+    # fault tolerance: dead/hung env runners are replaced in their slot
+    # mid-training with current weights pushed to the replacement
+    restart_failed_env_runners: bool = True
+    max_runner_restarts: int = 3
 
 
 class MultiAgentEnvRunner:
@@ -187,6 +191,7 @@ class MultiAgentPPO:
 
     def __init__(self, config: MultiAgentConfig):
         import ray_tpu
+        from ray_tpu.rl.actor_manager import FaultTolerantRunnerSet
         from ray_tpu.rl.learner import JaxLearner
 
         self.config = config
@@ -194,8 +199,15 @@ class MultiAgentPPO:
         cfg_dict["env_maker"] = config.env_maker
         cfg_dict["policy_mapping_fn"] = config.policy_mapping_fn
         runner_cls = ray_tpu.remote(num_cpus=0.25)(MultiAgentEnvRunner)
-        self.env_runners = [runner_cls.remote(cfg_dict, i)
-                            for i in range(config.num_env_runners)]
+        # fault-tolerant runner set: slot i is always runner_index=i, so a
+        # restart preserves seeding/sharding; the on_restart hook (below,
+        # once learners exist) pushes the CURRENT per-policy weights so a
+        # replacement rejoins mid-training at the live optimum
+        self.env_runners = FaultTolerantRunnerSet(
+            lambda i: runner_cls.remote(cfg_dict, i),
+            config.num_env_runners,
+            max_restarts=config.max_runner_restarts,
+            restart_enabled=config.restart_failed_env_runners)
         # learners are built from the env's spaces, one per policy
         probe = config.env_maker()
         self.learners: Dict[str, JaxLearner] = {}
@@ -205,20 +217,26 @@ class MultiAgentPPO:
                 obs_dim = int(np.prod(probe.observation_space(aid).shape))
                 act_dim = probe.action_space(aid).n
                 self.learners[pid] = JaxLearner(cfg_dict, obs_dim, act_dim)
+        self.env_runners.set_on_restart(self._restore_runner)
         self.iteration = 0
         self._sync_weights()
 
-    def _sync_weights(self):
+    def _restore_runner(self, runner):
         import ray_tpu
         weights = {pid: ln.get_weights()
                    for pid, ln in self.learners.items()}
+        ray_tpu.get(runner.set_weights.remote(ray_tpu.put(weights)),
+                    timeout=60.0)
+
+    def _sync_weights(self):
+        weights = {pid: ln.get_weights()
+                   for pid, ln in self.learners.items()}
+        import ray_tpu
         ref = ray_tpu.put(weights)
-        ray_tpu.get([r.set_weights.remote(ref) for r in self.env_runners])
+        self.env_runners.foreach("set_weights", ref, timeout=120.0)
 
     def training_step(self) -> Dict:
-        import ray_tpu
-        batches = ray_tpu.get([r.sample.remote()
-                               for r in self.env_runners])
+        batches = self.env_runners.foreach("sample")
         merged: Dict[str, Dict[str, np.ndarray]] = {}
         for b in batches:
             for pid, pb in b.items():
@@ -234,10 +252,8 @@ class MultiAgentPPO:
         return stats
 
     def train(self) -> Dict:
-        import ray_tpu
         stats = self.training_step()
-        metrics = ray_tpu.get([r.get_metrics.remote()
-                               for r in self.env_runners])
+        metrics = self.env_runners.foreach("get_metrics")
         returns = [m["episode_return_mean"] for m in metrics
                    if m["episode_return_mean"] is not None]
         return {"iteration": self.iteration,

@@ -36,6 +36,23 @@ def test_native_asan_selftest(name, shm):
     assert "OK" in r.stdout
 
 
+@pytest.mark.slow
+def test_native_tsan_concurrent_puts():
+    """The off-loop put path's native surface under ThreadSanitizer: the
+    selftest's concurrent sections run 4 caller threads through
+    create/rt_write_parallel/seal/get on one arena plus the shared copy
+    pool (queue + per-batch completion handshake). Single-process
+    multi-thread is the regime tsan models well; cross-process
+    robust-mutex recovery stays with the asan harness above. Any data
+    race on the allocator or pool aborts with a nonzero exit."""
+    from ray_tpu.native.build import build_selftest
+    binary = build_selftest("shm_store_selftest", sanitize="thread")
+    r = subprocess.run([binary, "/dev/shm/rt_selftest_tsan_pytest"],
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr[-4000:])
+    assert "OK" in r.stdout
+
+
 _LOOP_SCRIPT = textwrap.dedent("""
     import asyncio, json, os, time
     os.environ["RAY_TPU_LOOP_SANITIZER"] = "1"
