@@ -119,8 +119,48 @@ def bench_rl_env_steps(iters: int = 3):
     finally:
         algo.stop()
     value = round(float(sum(rates) / len(rates)), 1)
+    rates = sorted(rates)
+    med = rates[len(rates) // 2]
+    # the ratchet metric must carry its own reproducibility evidence:
+    # per-run rates + relative spread, like the phase-A/B batteries
+    spread = (rates[-1] - rates[0]) / med if med else 0.0
     return {"value": value, "unit": "env_steps_per_s",
+            "spread": round(spread, 3),
+            "runs": [round(r, 1) for r in rates],
             "vs_r4_ratchet": round(value / RL_ENV_STEPS_R4, 3)}
+
+
+def bench_shuffle_bandwidth(ray_tpu, total_mb: int = 128,
+                            parallelism: int = 16, row_pad: int = 4096):
+    """Streaming push-based shuffle throughput (ray_tpu/data/shuffle.py):
+    GB of input rows moved through the map/merge/reduce pipeline per
+    second. Input blocks are materialized FIRST so the number isolates
+    the shuffle, not row generation."""
+    import numpy as np
+
+    import ray_tpu.data as rd
+    from ray_tpu.data import shuffle as shuffle_lib
+    row_bytes = row_pad + 8
+    n_rows = max(parallelism, total_mb * 1024 * 1024 // row_bytes)
+    pad = "x" * row_pad
+
+    def _fatten(batch):
+        return {"id": batch["id"],
+                "pad": np.array([pad] * len(batch["id"]), dtype=object)}
+
+    ds = (rd.range(n_rows, parallelism=parallelism)
+          .map_batches(_fatten).materialize())
+    t0 = time.perf_counter()
+    out_rows = 0
+    for batch in ds.random_shuffle(seed=0).iter_batches(
+            batch_size=8192, batch_format="pyarrow"):
+        out_rows += batch.num_rows
+    dt = time.perf_counter() - t0
+    assert out_rows == n_rows, (out_rows, n_rows)
+    st = shuffle_lib.last_shuffle_stats()
+    moved = (st.input_bytes if st is not None and st.input_bytes
+             else n_rows * row_bytes)
+    return moved / dt / 1e9
 
 
 def log(msg):
@@ -566,6 +606,29 @@ def main():
         log(f"rl_ppo_env_steps_per_s FAILED: {e}")
         results["rl_ppo_env_steps_per_s"] = {"value": 0.0,
                                              "error": str(e)[:200]}
+
+    try:
+        import os as _os
+
+        import ray_tpu
+        ray_tpu.init(num_cpus=max(4, _os.cpu_count() or 1),
+                     object_store_memory=512 * 1024 * 1024)
+        try:
+            from ray_tpu.data import shuffle as _shuffle_lib
+            rate = bench_shuffle_bandwidth(ray_tpu)
+            st = _shuffle_lib.last_shuffle_stats()
+            results["shuffle_gb_per_s"] = {
+                "value": round(rate, 3), "unit": "GB/s",
+                "map_tasks": getattr(st, "map_tasks", None),
+                "merge_tasks": getattr(st, "merge_tasks", None),
+                "reduce_tasks": getattr(st, "reduce_tasks", None),
+                "peak_live_inputs": getattr(st, "peak_live_inputs", None)}
+        finally:
+            ray_tpu.shutdown()
+        log(f"shuffle_gb_per_s: {results['shuffle_gb_per_s']['value']}")
+    except Exception as e:
+        log(f"shuffle_gb_per_s FAILED: {e}")
+        results["shuffle_gb_per_s"] = {"value": 0.0, "error": str(e)[:200]}
 
     try:
         ceiling = bench_memcpy_ceiling()

@@ -40,6 +40,47 @@ def _chips_per_host(accel_type: str) -> int:
     return _SINGLE_HOST_CHIPS.get(gen, 4)
 
 
+# --------------------------------------------------- GCE metadata autodetect
+# Real TPU-VMs publish accelerator-type / worker-number / instance-id on the
+# GCE metadata server (reference: tpu.py:198 pod-type detection). Consulted
+# BEFORE the env-var fallback so unattended TPU-VMs work with no env setup;
+# gated behind a DMI platform sniff + short timeout + negative caching so
+# non-GCE boxes (and unit tests) never pay a network wait.
+_GCE_METADATA_URL = "http://metadata.google.internal/computeMetadata/v1/"
+_GCE_TIMEOUT_S = 0.5
+_metadata_cache: Dict[str, Optional[str]] = {}
+
+
+def _on_gce() -> bool:
+    if os.environ.get("RAY_TPU_DISABLE_GCE_METADATA"):
+        return False
+    try:
+        with open("/sys/class/dmi/id/product_name") as f:
+            return "Google" in f.read()
+    except OSError:
+        return False
+
+
+def _gce_metadata(path: str) -> Optional[str]:
+    """One metadata attribute, cached (including misses) per process."""
+    if path in _metadata_cache:
+        return _metadata_cache[path]
+    value = None
+    if _on_gce():
+        try:
+            import urllib.request
+            req = urllib.request.Request(
+                _GCE_METADATA_URL + path,
+                headers={"Metadata-Flavor": "Google"})
+            with urllib.request.urlopen(req, timeout=_GCE_TIMEOUT_S) as r:
+                if r.status == 200:
+                    value = r.read().decode().strip() or None
+        except Exception:
+            value = None
+    _metadata_cache[path] = value
+    return value
+
+
 class TPUAcceleratorManager(AcceleratorManager):
     @staticmethod
     def get_resource_name() -> str:
@@ -60,13 +101,23 @@ class TPUAcceleratorManager(AcceleratorManager):
             n = len(glob.glob("/dev/vfio/*")) - (1 if os.path.exists(
                 "/dev/vfio/vfio") else 0)
             n = max(0, n)
+        if n == 0:
+            # no device nodes visible (some TPU-VM images mount them
+            # late): infer the per-host chip count from the detected
+            # accelerator type so unattended bring-up still advertises TPU
+            accel = TPUAcceleratorManager.get_current_node_accelerator_type()
+            if accel:
+                n = _chips_per_host(accel)
         if n == 0 and os.environ.get("RAY_TPU_FAKE_CHIPS"):
             n = int(os.environ["RAY_TPU_FAKE_CHIPS"])
         return n
 
     @staticmethod
     def get_current_node_accelerator_type() -> Optional[str]:
-        return os.environ.get(GCE_TPU_ACCEL_TYPE_ENV)
+        # autodetect first (GCE metadata, short timeout, cached), env last
+        # — a real TPU-VM then works unattended with no env setup
+        return (_gce_metadata("instance/attributes/accelerator-type")
+                or os.environ.get(GCE_TPU_ACCEL_TYPE_ENV))
 
     @staticmethod
     def get_current_process_visible_accelerator_ids() -> Optional[List[str]]:
@@ -82,8 +133,15 @@ class TPUAcceleratorManager(AcceleratorManager):
         # masking alone suffices for same-host isolation.
 
     @staticmethod
+    def get_current_node_tpu_pod_name() -> Optional[str]:
+        return (_gce_metadata("instance/attributes/instance-id")
+                or os.environ.get(GCE_TPU_NAME_ENV))
+
+    @staticmethod
     def is_pod_worker_0() -> bool:
-        return os.environ.get(GCE_TPU_WORKER_ID_ENV, "0") == "0"
+        wid = (_gce_metadata("instance/attributes/agent-worker-number")
+               or os.environ.get(GCE_TPU_WORKER_ID_ENV, "0"))
+        return wid == "0"
 
     @staticmethod
     def get_current_node_additional_resources() -> Dict[str, float]:
@@ -92,7 +150,7 @@ class TPUAcceleratorManager(AcceleratorManager):
         tpu.py:334-397)."""
         out: Dict[str, float] = {}
         accel_type = TPUAcceleratorManager.get_current_node_accelerator_type()
-        pod_name = os.environ.get(GCE_TPU_NAME_ENV)
+        pod_name = TPUAcceleratorManager.get_current_node_tpu_pod_name()
         if accel_type and _is_multi_host(accel_type):
             if pod_name:
                 # prefixed so slice-membership markers are recognizable to

@@ -620,13 +620,38 @@ class CoreWorker:
         except Exception:
             pass
 
+    def _create_with_spill_retry(self, oid: bytes, data_size: int,
+                                 meta_size: int):
+        """store.create with one spill-backed second chance: an
+        arena-full MemoryError asks the node manager to spill sealed
+        objects to disk and retries, so workloads larger than the object
+        store (streaming shuffle sub-blocks) land via spill instead of
+        falling back to unbounded worker-heap copies."""
+        try:
+            return self.store.create(oid, data_size, meta_size)
+        except MemoryError:
+            if self.node_conn is not None:
+                try:
+                    if threading.get_ident() == self._loop_thread_ident:
+                        # executing ON the loop: blocking would deadlock —
+                        # kick the spill and retry on LRU eviction alone
+                        self._spawn(self.node_conn.call("spill_now"))
+                    else:
+                        asyncio.run_coroutine_threadsafe(
+                            self.node_conn.call("spill_now"),
+                            self.loop).result(timeout=30)
+                except Exception:
+                    pass
+            return self.store.create(oid, data_size, meta_size)
+
     def _store_serialized(self, oid: bytes, s: serialization.SerializedObject):
         if s.is_inline() or self.store is None:
             self.memory_store[oid] = ("wire",) + s.to_wire()
         else:
             try:
                 meta = s.store_meta()
-                bufs = self.store.create(oid, s.data_size(), len(meta))
+                bufs = self._create_with_spill_retry(oid, s.data_size(),
+                                                     len(meta))
                 if bufs is not None:
                     try:
                         data, meta_view = bufs

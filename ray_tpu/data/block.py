@@ -94,7 +94,13 @@ def slice_block(block: pa.Table, start: int, end: int) -> pa.Table:
 
 
 def concat_blocks(blocks: List[pa.Table]) -> pa.Table:
-    blocks = [b for b in blocks if b.num_rows > 0]
-    if not blocks:
+    nonempty = [b for b in blocks if b.num_rows > 0]
+    if not nonempty:
+        # preserve the schema through an all-empty concat: a shuffle
+        # partition that received only empty sub-blocks must still carry
+        # its columns (downstream schema() / writes depend on it)
+        for b in blocks:
+            if len(b.column_names):
+                return b.slice(0, 0)
         return pa.table({})
-    return pa.concat_tables(blocks, promote_options="default")
+    return pa.concat_tables(nonempty, promote_options="default")

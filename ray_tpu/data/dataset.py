@@ -10,6 +10,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional
 import ray_tpu
 from ray_tpu.data import block as block_lib
 from ray_tpu.data import execution as exe
+from ray_tpu.data import shuffle as shuffle_lib
 
 
 class Dataset:
@@ -58,15 +59,23 @@ class Dataset:
                                          concurrency=concurrency))
 
     def repartition(self, num_blocks: int) -> "Dataset":
-        return self._extend(exe.AllToAllStage("repartition",
-                                              num_blocks=num_blocks))
+        return self._extend(shuffle_lib.ShuffleStage(
+            "repartition", num_blocks=num_blocks))
 
-    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        return self._extend(exe.AllToAllStage("random_shuffle", seed=seed))
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       num_partitions: Optional[int] = None) -> "Dataset":
+        """Streaming push-based shuffle: inputs are consumed and
+        partitioned incrementally, so memory stays bounded by the
+        in-flight window + object-store spill, not the dataset size
+        (ray_tpu.data.shuffle)."""
+        return self._extend(shuffle_lib.ShuffleStage(
+            "random_shuffle", seed=seed, num_partitions=num_partitions))
 
-    def sort(self, key: str, descending: bool = False) -> "Dataset":
-        return self._extend(exe.AllToAllStage("sort", key=key,
-                                              descending=descending))
+    def sort(self, key: str, descending: bool = False, *,
+             num_partitions: Optional[int] = None) -> "Dataset":
+        return self._extend(shuffle_lib.ShuffleStage(
+            "sort", key=key, descending=descending,
+            num_partitions=num_partitions))
 
     def limit(self, n: int) -> "Dataset":
         return self._extend(exe.LimitStage(n))
@@ -353,7 +362,8 @@ class GroupedData:
         self._key = key
 
     def _agg(self, col: str, fn: str, out_name: str) -> Dataset:
-        return self._ds._extend(exe.AllToAllStage(
+        from ray_tpu.data import shuffle as shuffle_lib
+        return self._ds._extend(shuffle_lib.ShuffleStage(
             "groupby_agg", key=self._key,
             aggs=[(col, self._ARROW_FNS[fn], out_name)]))
 
@@ -376,5 +386,6 @@ class GroupedData:
         return self._agg(col, "std", f"std({col})")
 
     def map_groups(self, fn) -> Dataset:
-        return self._ds._extend(exe.AllToAllStage(
+        from ray_tpu.data import shuffle as shuffle_lib
+        return self._ds._extend(shuffle_lib.ShuffleStage(
             "map_groups", key=self._key, fn=fn))

@@ -27,6 +27,18 @@ def partition_random(block, n: int, seed):
     return [block.take(np.nonzero(assign == j)[0]) for j in range(n)]
 
 
+def partition_round_robin(block, n: int):
+    """Row-cyclic split into n near-equal partitions (the streaming
+    repartition map fn: no global row offset is needed, so it works on a
+    stream; each output partition ends up within one row of balance per
+    input block)."""
+    import numpy as np
+    if block.num_rows == 0:
+        return [block] * n
+    idx = np.arange(block.num_rows) % n
+    return [block.take(np.nonzero(idx == j)[0]) for j in range(n)]
+
+
 def _stable_hash(v) -> int:
     """Process-independent hash. Python's builtin hash() of str/bytes is
     salted per interpreter (PYTHONHASHSEED), so two partition tasks on

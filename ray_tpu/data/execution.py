@@ -5,9 +5,12 @@ python/ray/data/_internal/execution/streaming_executor.py:48 — dedicated
 thread, operator scheduling loop, backpressure policies). Here each
 operator is a generator stage over a stream of block refs: map stages keep
 a bounded window of in-flight remote tasks (pipelining + backpressure in
-~40 lines instead of a scheduling loop), all-to-all stages materialize
-their input. Only refs flow through the executor; blocks stay in the
-object store."""
+~40 lines instead of a scheduling loop). All-to-all reshapes run as the
+push-based streaming shuffle in ray_tpu/data/shuffle.py (map tasks
+partition each block as it arrives, reduce tasks stream-merge with
+locality placement and spill-backed overflow); the materializing
+AllToAllStage below survives only as the tiny-input fallback. Only refs
+flow through the executor; blocks stay in the object store."""
 
 from __future__ import annotations
 
@@ -484,7 +487,11 @@ class ActorPoolMapStage(Stage):
 
 
 class AllToAllStage(Stage):
-    """Materializes input, then reshapes (repartition / shuffle / sort)."""
+    """LEGACY materializing reshape (repartition / shuffle / sort):
+    buffers every input ref at a barrier before reshaping. Kept only as
+    the tiny-input fallback of ray_tpu.data.shuffle.ShuffleStage — at
+    <= a couple of blocks the barrier is free and the single-block local
+    paths below are exact; everything larger streams."""
 
     def __init__(self, kind: str, **kwargs):
         self.kind = kind

@@ -61,12 +61,10 @@ class _SplitCoordinator:
 
     def _locate(self, ref) -> Optional[str]:
         """Node id of a block this coordinator owns (cheap local read —
-        experimental.object_locations plane)."""
-        try:
-            from ray_tpu._private.worker import global_worker
-            return global_worker.core.object_locations([ref])[0]
-        except Exception:
-            return None
+        the shared locality plane in ray_tpu.data.shuffle, also used for
+        shuffle reduce placement)."""
+        from ray_tpu.data.shuffle import object_node_ids
+        return object_node_ids([ref])[0]
 
     def _pick_dest(self, bundle) -> int:
         balanced = min(range(self._n), key=lambda i: self._rows_dealt[i])

@@ -65,17 +65,45 @@ def run(spec):
     out = np.asarray(gen_long(params, tokens, jax.random.PRNGKey(2)))
     assert out.shape == (B, new)
     np.asarray(gen_short(params, tokens, jax.random.PRNGKey(3)))
-    rates, e2e = [], []
-    for i in range(3):
-        dt_long = timed(gen_long, jax.random.PRNGKey(10 + i))
-        dt_short = timed(gen_short, jax.random.PRNGKey(20 + i))
-        rates.append(B * (new - short) / max(1e-6, dt_long - dt_short))
+
+    # bench integrity: each decode step streams the full weight set from
+    # HBM once, so tokens/s is bounded by B * HBM_BW / param_bytes. A
+    # sample whose long-minus-short delta is ~0 (the 384e9 tok/s
+    # artifact: both programs served by a caching layer) or whose rate
+    # beats the roofline with 2x slack is physically impossible —
+    # reject it and resample instead of publishing it.
+    param_bytes = sum(np.asarray(x).size * np.asarray(x).dtype.itemsize
+                      for x in jax.tree_util.tree_leaves(params))
+    hbm_bw = float(os.environ.get("RAY_TPU_HBM_GBPS", 819)) * 1e9
+    roofline = 2.0 * B * hbm_bw / max(1, param_bytes)
+    min_delta = 1e-3          # below timer noise = not a real measurement
+    rates, e2e, rejected = [], [], 0
+    attempt = 0
+    while len(rates) < 3 and attempt < 10:
+        dt_long = timed(gen_long, jax.random.PRNGKey(10 + attempt))
+        dt_short = timed(gen_short, jax.random.PRNGKey(20 + attempt))
+        attempt += 1
+        delta = dt_long - dt_short
+        rate = B * (new - short) / max(1e-9, delta)
+        if delta < min_delta or rate > roofline:
+            rejected += 1
+            continue
+        rates.append(rate)
         e2e.append(B * new / dt_long)
+    if not rates:
+        raise RuntimeError(
+            f"decode probe produced no physically plausible sample in "
+            f"{attempt} attempts ({rejected} rejected; roofline "
+            f"{roofline:.3e} tok/s)")
     rates.sort()
     e2e.sort()
+    med = rates[len(rates) // 2]
+    spread = (rates[-1] - rates[0]) / med if med else 0.0
     return {"model": spec["model"], "B": B, "prompt": prompt_len,
-            "new": new, "decode_tokens_per_s": round(rates[1], 1),
-            "e2e_tokens_per_s": round(e2e[1], 1),
+            "new": new, "decode_tokens_per_s": round(med, 1),
+            "e2e_tokens_per_s": round(e2e[len(e2e) // 2], 1),
+            "spread": round(spread, 3), "rejected_samples": rejected,
+            "roofline_tokens_per_s": round(roofline, 1),
             "runs": [round(r, 1) for r in rates]}
 
 

@@ -32,6 +32,8 @@ def proxy_addr():
     addr = None
     deadline = time.time() + 60
     while time.time() < deadline:
+        if proc.poll() is not None:
+            break              # host died: readline() would spin on ''
         line = proc.stdout.readline()
         if line.startswith("PROXY_ADDR="):
             addr = line.strip().split("=", 1)[1]

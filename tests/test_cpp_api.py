@@ -59,6 +59,8 @@ def test_cpp_client_cross_language(tmp_path):
         gcs = None
         deadline = time.time() + 60
         while time.time() < deadline:
+            if host.poll() is not None:
+                break          # host died: readline() would spin on ''
             line = host.stdout.readline()
             if line.startswith("GCS="):
                 gcs = line.strip().split("=", 1)[1]
