@@ -385,6 +385,42 @@ def bench_decode_tokens_per_s(tpu_ok: bool = True):
     return {"skipped": True, "reason": last}
 
 
+def bench_serve_tokens_per_s(tpu_ok: bool = False):
+    """Continuous-batching serving throughput (ray_tpu/inference/):
+    Poisson arrivals over a mixed-length workload through the slot-pool
+    engine, with p50/p95 TTFT and the static-batching baseline
+    (fixed-batch make_generate_fn over the same requests) recorded in
+    the SAME entry — vs_static >= 1.0 is the engine's reason to exist.
+    Runs on CPU when no TPU is reachable (the comparison is
+    platform-independent); the probe reports per-run rates + spread
+    like the RL ratchet."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    runner = os.path.join(here, "reports", "serve_probe.py")
+    if tpu_ok:
+        ladder = [
+            {"model": "tpu-1b", "n_slots": 8, "max_len": 512,
+             "prefill_chunk": 64, "n_requests": 32,
+             "prompt_lens": [16, 128], "new_tokens": [16, 128],
+             "arrival_rate_rps": 50.0, "runs": 3},
+            {"model": "tiny", "n_slots": 8, "n_requests": 24,
+             "new_tokens": [4, 64], "runs": 3},
+        ]
+    else:
+        ladder = [{"model": "tiny", "n_slots": 8, "n_requests": 24,
+                   "new_tokens": [4, 64], "runs": 3}]
+    last = "unknown"
+    for attempt in range(2):
+        if attempt:
+            time.sleep(10)
+        for spec in ladder:
+            result, last = _run_probe(runner, spec, timeout=1200)
+            if result is not None:
+                return result
+            log(f"serve probe failed: {last}")
+    return {"skipped": True, "reason": last}
+
+
 def bench_train_step_mfu():
     """Flagship-model train step on the real chip: tokens/s + MFU.
 
@@ -703,6 +739,30 @@ def main():
         log(f"decode probe FAILED: {e}")
         results["decode_tokens_per_s"] = {"skipped": True,
                                           "reason": str(e)[:200]}
+
+    try:
+        tpu_ok = not mfu_res.get("skipped")
+        srv = bench_serve_tokens_per_s(tpu_ok)
+        if not srv.get("skipped"):
+            results["serve_tokens_per_s"] = {
+                "value": srv["serve_tokens_per_s"],
+                "unit": "tokens_per_s", "model": srv["model"],
+                "n_slots": srv["n_slots"],
+                "ttft_p50_ms": srv["ttft_p50_ms"],
+                "ttft_p95_ms": srv["ttft_p95_ms"],
+                "static_tokens_per_s": srv["static_tokens_per_s"],
+                "vs_static": srv["vs_static"],
+                "spread": srv["spread"], "runs": srv["runs"]}
+            log(f"serve_tokens_per_s: {srv['serve_tokens_per_s']} "
+                f"({srv['model']}, vs_static {srv['vs_static']}x, "
+                f"ttft p50 {srv['ttft_p50_ms']}ms)")
+        else:
+            results["serve_tokens_per_s"] = srv
+            log(f"serve probe skipped: {srv.get('reason')}")
+    except Exception as e:
+        log(f"serve probe FAILED: {e}")
+        results["serve_tokens_per_s"] = {"skipped": True,
+                                         "reason": str(e)[:200]}
     if not mfu_res.get("skipped"):
         results["train_step_mfu"] = {
             "value": round(mfu_res["mfu"], 4),

@@ -1,0 +1,182 @@
+"""`LLMDeployment`: the continuous-batching engine behind a Serve
+deployment, streaming tokens over the existing replica/handle streaming
+path (replica.handle_stream -> ObjectRefGenerator).
+
+Usage::
+
+    from ray_tpu import serve
+    from ray_tpu.inference import LLMDeployment
+
+    app = serve.deployment(LLMDeployment).bind("llama-debug", n_slots=4)
+    serve.run(app, name="llm")
+    h = serve.get_app_handle("llm").options(stream=True)
+    for tok in h.remote([1, 2, 3], max_new_tokens=32):
+        ...
+
+Each streamed request holds one engine slot; a client that drops the
+iterator mid-generation cancels the request in a ``finally`` — the slot
+is reclaimed by the next engine step and the queue metrics decrement
+(see tests/test_serve_streaming.py). Composes with Serve multiplexing
+(the deployment is an ordinary callable; sticky model-id routing works
+unchanged) and, for models wider than one host, with sharded replicas —
+pass a mesh + pre-sharded params via ``params_fn``.
+
+Metrics (ray_tpu/util/metrics.py, aggregated at /metrics):
+  serve_llm_ttft_ms        histogram  time to first token per request
+  serve_llm_tpot_ms        histogram  per-token latency after the first
+  serve_llm_requests_total counter    finished requests, by finish_reason
+  serve_llm_tokens_total   counter    generated tokens
+  serve_llm_slot_occupancy gauge      occupied slots (per engine step)
+  serve_llm_queue_depth    gauge      queued (unadmitted) requests
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.inference.engine import EngineConfig, InferenceEngine
+
+
+def _resolve_model(model):
+    """Accept a registry name, a TransformerConfig, or a ready
+    TransformerLM module."""
+    from ray_tpu.models import MODEL_REGISTRY, TransformerLM
+    from ray_tpu.models.transformer import TransformerConfig
+    if isinstance(model, str):
+        return TransformerLM(MODEL_REGISTRY[model])
+    if isinstance(model, TransformerConfig):
+        return TransformerLM(model)
+    return model
+
+
+class LLMDeployment:
+    """Serve callable hosting one InferenceEngine.
+
+    model: registry name / TransformerConfig / TransformerLM.
+    params_fn: optional zero-arg callable returning the param tree
+        (checkpoint restore, sharded init, ...); defaults to random
+        init with `seed` — the CI/bench shape.
+    Engine knobs (n_slots, max_len, prefill_chunk, prefill_budget,
+    eos_id, temperature, top_k, top_p) mirror EngineConfig.
+    """
+
+    def __init__(self, model="llama-debug", *, n_slots: int = 4,
+                 max_len: int = 256, prefill_chunk: int = 32,
+                 prefill_budget: int = 64, eos_id: int = -1,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, params_fn=None, mesh=None,
+                 seed: int = 0):
+        import jax
+
+        self.model = _resolve_model(model)
+        if params_fn is not None:
+            params = params_fn()
+        else:
+            import jax.numpy as jnp
+            tokens0 = jnp.zeros((1, min(8, max_len)), jnp.int32)
+            params = self.model.init(jax.random.PRNGKey(seed),
+                                     tokens0)["params"]
+        cfg = EngineConfig(n_slots=n_slots, max_len=max_len,
+                           prefill_chunk=prefill_chunk,
+                           prefill_budget=prefill_budget, eos_id=eos_id,
+                           temperature=temperature, top_k=top_k,
+                           top_p=top_p)
+        self.engine = InferenceEngine(self.model, params, cfg, mesh=mesh,
+                                      seed=seed)
+        self._metrics = _EngineMetrics()
+        self.engine.on_step = self._metrics.on_step
+        self.engine.start()
+
+    # ------------------------------------------------------------- serving
+    def __call__(self, prompt_tokens, max_new_tokens: int = 64,
+                 temperature: Optional[float] = None,
+                 eos_id: Optional[int] = None,
+                 deadline_s: Optional[float] = None):
+        """Streaming generator: yields one token id at a time. Invoked
+        with .options(stream=True) this rides the replica streaming
+        path; the finally-cancel frees the slot when the client drops
+        the iterator mid-generation (GeneratorExit lands here)."""
+        handle = self.engine.submit(prompt_tokens,
+                                    max_new_tokens=max_new_tokens,
+                                    temperature=temperature,
+                                    eos_id=eos_id, deadline_s=deadline_s)
+        prev_t: Optional[float] = None
+        try:
+            for tok in handle:
+                now = time.monotonic()
+                if prev_t is None:
+                    self._metrics.first_token(now - handle.submitted_t)
+                else:
+                    self._metrics.next_token(now - prev_t)
+                prev_t = now
+                yield tok
+        finally:
+            # client walked away OR stream completed; cancel is a no-op
+            # on a finished request
+            handle.cancel()
+            self._metrics.finished(handle.finish_reason or "cancelled")
+
+    def generate(self, prompt_tokens, **kw):
+        """Non-streaming convenience: returns the full token list."""
+        return list(self.__call__(prompt_tokens, **kw))
+
+    # ------------------------------------------------------------- control
+    def stats(self) -> Dict:
+        return self.engine.stats()
+
+    def check_health(self):
+        if self.engine._thread is not None \
+                and not self.engine._thread.is_alive():
+            raise RuntimeError("inference engine loop died")
+
+    def reconfigure(self, user_config):
+        # prefill budget is the one knob safe to move live (it is read
+        # per step); everything else is baked into compiled shapes
+        if isinstance(user_config, dict) and "prefill_budget" in user_config:
+            self.engine.sched.prefill_budget = max(
+                1, int(user_config["prefill_budget"]))
+
+    def __del__(self):
+        try:
+            self.engine.stop()
+        except Exception:
+            pass
+
+
+class _EngineMetrics:
+    """TTFT/TPOT/occupancy/queue-depth wiring (util/metrics.py)."""
+
+    def __init__(self):
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+        ms = [1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+              2500.0, 5000.0]
+        self.ttft = Histogram("serve_llm_ttft_ms",
+                              "time to first token (ms)", boundaries=ms)
+        self.tpot = Histogram("serve_llm_tpot_ms",
+                              "inter-token latency (ms)", boundaries=ms)
+        self.requests = Counter("serve_llm_requests_total",
+                                "finished requests",
+                                tag_keys=("finish_reason",))
+        self.tokens = Counter("serve_llm_tokens_total", "generated tokens")
+        self.occupancy = Gauge("serve_llm_slot_occupancy",
+                               "occupied KV slots")
+        self.queue_depth = Gauge("serve_llm_queue_depth",
+                                 "queued (unadmitted) requests")
+        self._lock = threading.Lock()
+
+    def on_step(self, stats: Dict):
+        self.occupancy.set(stats["slots_occupied"])
+        self.queue_depth.set(stats["queue_depth"])
+
+    def first_token(self, dt_s: float):
+        self.ttft.observe(dt_s * 1000.0)
+        self.tokens.inc()
+
+    def next_token(self, dt_s: float):
+        self.tpot.observe(dt_s * 1000.0)
+        self.tokens.inc()
+
+    def finished(self, reason: str):
+        self.requests.inc(tags={"finish_reason": reason})

@@ -1,0 +1,373 @@
+"""Continuous-batching inference engine: a fixed-shape KV slot pool and
+a persistent decode loop.
+
+Architecture (the TPU-serving shape — cf. slot-based continuous
+batching in the Gemma-on-TPU serving stack):
+
+- The engine owns ``n_slots`` KV-cache slots, allocated once as
+  ``[n_layers, n_slots, max_len, Hkv, D]`` per-layer stacked arrays and
+  donated through every step, so the decode step compiles exactly ONCE
+  and then mutates the pool in place for the life of the engine.
+- Each iteration of the loop (a) admits queued prompts via *chunked
+  prefill* under a per-step prefill-token budget — a long prompt is
+  split into fixed-shape chunks that run through the cached-attention
+  path (``chunked_prefill=True``) into a scratch cache, so admission
+  never stalls in-flight decodes for more than ``prefill_budget``
+  tokens of work — and (b) advances EVERY occupied slot one token in a
+  single batched decode step (per-slot ``idx`` vector: each row attends
+  and writes at its own length).
+- Tokens stream out per request through ``RequestHandle`` queues;
+  slots are evicted (and immediately reusable) on EOS, max-tokens,
+  slot-capacity, cancellation, or deadline.
+
+Shapes are static everywhere — tokens [n_slots], lengths [n_slots],
+prompt chunks [1, prefill_chunk] — so XLA compiles three programs
+(prefill chunk, slot insert, decode step) and nothing ever recompiles
+across admissions/evictions. ``decode_compile_count`` counts decode
+retraces; tests assert it stays at 1.
+
+Sampling is shared with ``make_generate_fn`` via models/sampling.py:
+greedy engine output is bit-identical to the one-program generator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.inference.scheduler import (FINISH_LENGTH, PrefillChunk,
+                                         Request, RequestHandle,
+                                         RequestState, Scheduler)
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Knobs of the slot pool and admission policy.
+
+    n_slots: decode batch width (slots advance together every step).
+    max_len: per-slot KV capacity (prompt + generated tokens).
+    prefill_chunk: static shape of one prefill call; prompts are split
+        into chunks of exactly this many tokens (last chunk padded).
+    prefill_budget: max prompt tokens admitted per engine step — the
+        knob that trades TTFT (higher = prompts land faster) against
+        inter-token latency of in-flight decodes (lower = decode steps
+        between prefill work come sooner).
+    eos_id: default EOS (<0 disables); per-request override on Request.
+    temperature/top_k/top_p: default sampling (temperature has a
+        per-request override; top_k/top_p are compiled in).
+    """
+    n_slots: int = 4
+    max_len: int = 512
+    prefill_chunk: int = 64
+    prefill_budget: int = 64
+    eos_id: int = -1
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    cache_dtype: Any = None       # default: model activation dtype
+
+
+class InferenceEngine:
+    """Continuous-batching engine over one model + params (optionally on
+    a parallel mesh: params stay wherever the caller sharded them; the
+    KV pool shards batch (slots) over the data axes and KV heads over
+    `tensor`, same as make_generate_fn's cache)."""
+
+    def __init__(self, model, params, config: Optional[EngineConfig] = None,
+                 mesh=None, rules=None, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        self.model = model
+        self.params = params
+        self.config = config or EngineConfig()
+        self.mesh = mesh
+        self._rules = rules
+        cfg = self.config
+        mcfg = model.cfg
+        if cfg.max_len > mcfg.max_seq_len:
+            raise ValueError(f"max_len={cfg.max_len} exceeds the model's "
+                             f"max_seq_len={mcfg.max_seq_len}")
+        self.sched = Scheduler(cfg.n_slots, cfg.prefill_budget,
+                               default_temperature=cfg.temperature,
+                               eos_id=cfg.eos_id,
+                               chunk_size=cfg.prefill_chunk)
+        self._lock = threading.RLock()
+        self._work = threading.Condition(self._lock)
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._rng = jax.random.PRNGKey(seed)
+
+        dtype = cfg.cache_dtype or mcfg.dtype
+        pool_shape = (mcfg.n_layers, cfg.n_slots, cfg.max_len,
+                      mcfg.n_kv_heads, mcfg.head_dim)
+        # scratch is prefill_chunk longer than a slot so a padded final
+        # chunk can never clamp its write window back onto real entries
+        self._scratch_len = cfg.max_len + cfg.prefill_chunk
+        self._scratch_shape = (mcfg.n_layers, 1, self._scratch_len,
+                               mcfg.n_kv_heads, mcfg.head_dim)
+        self._pool_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ray_tpu.parallel import sharding as sharding_lib
+            from ray_tpu.parallel.train_step import (_prune_indivisible,
+                                                     logical_pspec_to_mesh)
+            rules = rules or sharding_lib.DEFAULT_RULES
+            spec = _prune_indivisible(
+                logical_pspec_to_mesh(
+                    P(None, "batch", None, "kv_heads", None), rules),
+                pool_shape, mesh)
+            self._pool_sharding = NamedSharding(mesh, spec)
+        self._pool_k = self._zeros(pool_shape, dtype)
+        self._pool_v = self._zeros(pool_shape, dtype)
+        self._cache_dtype = dtype
+
+        # host-side slot state (fixed width, mirrors the device arrays)
+        self._lengths = np.zeros((cfg.n_slots,), np.int32)
+        self._last_tok = np.zeros((cfg.n_slots,), np.int32)
+        self._temps = np.zeros((cfg.n_slots,), np.float32)
+        self._scratch: Dict[int, Any] = {}    # rid -> (sk, sv)
+
+        self.decode_compile_count = 0
+        self.prefill_compile_count = 0
+        self.steps = 0
+        self.tokens_generated = 0
+        self.on_step: Optional[Callable[[Dict], None]] = None
+        self._build_fns()
+
+    # ------------------------------------------------------------ device fns
+    def _zeros(self, shape, dtype):
+        import jax.numpy as jnp
+        with self._mesh_ctx():
+            z = jnp.zeros(shape, dtype)
+            if self._pool_sharding is not None and len(shape) == 5 \
+                    and shape[2] == self.config.max_len:
+                import jax
+                z = jax.device_put(z, self._pool_sharding)
+            return z
+
+    def _mesh_ctx(self):
+        if self.mesh is None:
+            import contextlib
+            return contextlib.nullcontext()
+        from ray_tpu.parallel.mesh import use_mesh
+        return use_mesh(self.mesh)
+
+    def _build_fns(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.sampling import sample_logits_dynamic
+        cfg = self.config
+        model = self.model
+        top_k, top_p = cfg.top_k, cfg.top_p
+        # donation rebinds the pool buffers in place on TPU; CPU (tests)
+        # doesn't implement donation and would warn every call
+        donate = jax.default_backend() != "cpu"
+
+        def prefill(params, sk, sv, tokens, pos0, n_real, rng, temp):
+            # one budgeted chunk of prompt through the cached path;
+            # samples the would-be next token (used only on the last
+            # chunk, where it is the request's first generated token)
+            self.prefill_compile_count += 1    # traces once: fixed shapes
+            cache = {"k": sk, "v": sv, "idx": pos0}
+            logits, new = model.apply({"params": params}, tokens,
+                                      cache=cache, chunked_prefill=True)
+            last = jax.lax.dynamic_index_in_dim(logits, n_real - 1,
+                                                axis=1, keepdims=False)
+            tok = sample_logits_dynamic(last, rng, temp[None],
+                                        top_k=top_k, top_p=top_p)
+            return tok[0].astype(jnp.int32), new["k"], new["v"]
+
+        def insert(pk, pv, sk, sv, slot):
+            # scratch carries prefill_chunk of padding tail; the slot
+            # takes the first max_len entries
+            sk = sk[:, :, :cfg.max_len]
+            sv = sv[:, :, :cfg.max_len]
+            pk = jax.lax.dynamic_update_slice(pk, sk, (0, slot, 0, 0, 0))
+            pv = jax.lax.dynamic_update_slice(pv, sv, (0, slot, 0, 0, 0))
+            return pk, pv
+
+        def decode(params, pk, pv, lengths, toks, rng, temps):
+            # ONE program for the life of the engine: fixed [n_slots]
+            # shapes, per-slot idx vector. Python side effect below runs
+            # only at trace time — it counts XLA cache misses. The key
+            # splits INSIDE the program (returned for the next step) so
+            # the host does exactly one dispatch per decoded token.
+            self.decode_compile_count += 1
+            rng, sub = jax.random.split(rng)
+            cache = {"k": pk, "v": pv, "idx": lengths}
+            logits, new = model.apply({"params": params}, toks[:, None],
+                                      cache=cache)
+            tok = sample_logits_dynamic(logits[:, -1, :], sub, temps,
+                                        top_k=top_k, top_p=top_p)
+            return tok.astype(jnp.int32), new["k"], new["v"], rng
+
+        self._prefill_fn = jax.jit(
+            prefill, donate_argnums=(1, 2) if donate else ())
+        self._insert_fn = jax.jit(
+            insert, donate_argnums=(0, 1) if donate else ())
+        self._decode_fn = jax.jit(
+            decode, donate_argnums=(1, 2) if donate else ())
+
+    # -------------------------------------------------------------- intake
+    def submit(self, tokens, max_new_tokens: int = 64,
+               temperature: Optional[float] = None,
+               eos_id: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> RequestHandle:
+        """Queue one prompt; returns a streaming RequestHandle.
+        deadline_s is relative (seconds from now) — a request still
+        queued past it fails with finish_reason='deadline'."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if len(tokens) == 0:
+            raise ValueError("empty prompt")
+        if len(tokens) > self.config.max_len - 1:
+            raise ValueError(
+                f"prompt ({len(tokens)} tokens) must leave room to "
+                f"decode in a {self.config.max_len}-token slot")
+        req = Request(tokens=tokens, max_new_tokens=int(max_new_tokens),
+                      temperature=temperature, eos_id=eos_id,
+                      deadline_s=(time.monotonic() + deadline_s
+                                  if deadline_s is not None else None))
+        with self._work:
+            if self._stop:
+                raise RuntimeError("engine is stopped")
+            h = self.sched.submit(req)
+            self._work.notify_all()
+        return h
+
+    # --------------------------------------------------------------- loop
+    def start(self) -> "InferenceEngine":
+        with self._lock:
+            if self._thread is None:
+                self._stop = False
+                self._thread = threading.Thread(
+                    target=self._loop, name="inference-engine", daemon=True)
+                self._thread.start()
+        return self
+
+    def stop(self):
+        with self._work:
+            self._stop = True
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        with self._lock:
+            self.sched.fail_all(RuntimeError("engine stopped"))
+
+    def _loop(self):
+        while True:
+            with self._work:
+                if self._stop:
+                    return
+                if not self.sched.has_work():
+                    # deadline sweeps still need an occasional wake
+                    self._work.wait(timeout=0.05)
+                    if self._stop:
+                        return
+            try:
+                self.step()
+            except Exception as e:           # engine must not die silently
+                with self._lock:
+                    self.sched.fail_all(e)
+
+    # --------------------------------------------------------------- step
+    def step(self) -> bool:
+        """One engine iteration: reap cancels/deadlines, run budgeted
+        prefill chunks (admission), advance every occupied slot one
+        token. Returns True if any device work ran."""
+        import jax
+
+        with self._lock:
+            now = time.monotonic()
+            for st in self.sched.reap(now):
+                self._scratch.pop(st.rid, None)
+            chunks = self.sched.plan_prefill()
+            did = False
+            for ch in chunks:
+                self._run_prefill_chunk(ch, now)
+                did = True
+
+            # capacity eviction BEFORE the step: a full slot has nowhere
+            # to write its next token
+            for st in self.sched.active_states():
+                if self._lengths[st.slot] >= self.config.max_len:
+                    self.sched.evict(st, FINISH_LENGTH)
+            active = self.sched.active_states()
+            if active:
+                with self._mesh_ctx():
+                    toks, self._pool_k, self._pool_v, self._rng = \
+                        self._decode_fn(
+                            self.params, self._pool_k, self._pool_v,
+                            self._lengths, self._last_tok, self._rng,
+                            self._temps)
+                toks_host = np.asarray(toks)
+                now = time.monotonic()
+                for st in active:
+                    slot = st.slot
+                    self._lengths[slot] += 1
+                    self._last_tok[slot] = toks_host[slot]
+                    self.tokens_generated += 1
+                    self.sched.decode_emit(st, int(toks_host[slot]), now)
+                did = True
+            self.steps += 1
+            if self.on_step is not None:
+                try:
+                    self.on_step(self.stats())
+                except Exception:
+                    pass
+            return did
+
+    def _run_prefill_chunk(self, ch: PrefillChunk, now: float):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        st = ch.state
+        sk_sv = self._scratch.get(st.rid)
+        if sk_sv is None:
+            sk_sv = (self._zeros(self._scratch_shape, self._cache_dtype),
+                     self._zeros(self._scratch_shape, self._cache_dtype))
+        sk, sv = sk_sv
+        prompt = st.request.tokens
+        chunk = np.zeros((1, cfg.prefill_chunk), np.int32)
+        chunk[0, :ch.length] = prompt[ch.start:ch.start + ch.length]
+        self._rng, k = jax.random.split(self._rng)
+        with self._mesh_ctx():
+            tok, sk, sv = self._prefill_fn(
+                self.params, sk, sv, jnp.asarray(chunk),
+                np.int32(ch.start), np.int32(ch.length), k,
+                np.float32(st.temperature))
+        if ch.is_last:
+            slot = st.slot
+            with self._mesh_ctx():
+                self._pool_k, self._pool_v = self._insert_fn(
+                    self._pool_k, self._pool_v, sk, sv, np.int32(slot))
+            self._scratch.pop(st.rid, None)
+            self._lengths[slot] = len(prompt)
+            first = int(tok)
+            self._last_tok[slot] = first
+            self._temps[slot] = st.temperature
+            self.sched.prefill_done(st, first, time.monotonic())
+        else:
+            self._scratch[st.rid] = (sk, sv)
+            self.sched.advance_prefill(st, ch.length)
+
+    # -------------------------------------------------------------- stats
+    def stats(self) -> Dict:
+        return {
+            "n_slots": self.config.n_slots,
+            "slots_occupied": self.sched.occupancy(),
+            "slots_free": self.config.n_slots - self.sched.occupancy(),
+            "queue_depth": self.sched.queue_depth(),
+            "active": len(self.sched.active_slots()),
+            "steps": self.steps,
+            "tokens_generated": self.tokens_generated,
+            "decode_compile_count": self.decode_compile_count,
+        }

@@ -52,6 +52,8 @@ MODEL_REGISTRY = {
 }
 
 from ray_tpu.models.generate import make_generate_fn
+from ray_tpu.models.sampling import sample_logits, sample_logits_dynamic
 
 __all__ = ["TransformerConfig", "TransformerLM", "MODEL_REGISTRY",
-           "count_params", "init_cache", "make_generate_fn"]
+           "count_params", "init_cache", "make_generate_fn",
+           "sample_logits", "sample_logits_dynamic"]

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ray_tpu.models.sampling import sample_logits
 from ray_tpu.models.transformer import init_cache
 from ray_tpu.parallel import sharding as sharding_lib
 from ray_tpu.parallel.mesh import use_mesh
@@ -65,10 +66,9 @@ def make_generate_fn(model: nn.Module, mesh: Mesh, rules=None,
                 "idx": NamedSharding(mesh, P())}
 
     def _pick(logits, rng):
-        if temperature and temperature > 0.0:
-            return jax.random.categorical(
-                rng, logits.astype(jnp.float32) / temperature, axis=-1)
-        return jnp.argmax(logits, axis=-1)
+        # shared with the inference engine (models/sampling.py); static
+        # temperature=0 compiles to the same bare argmax as before
+        return sample_logits(logits, rng, temperature=temperature)
 
     def generate(params, tokens, rng):
         cache = init_cache(cfg, batch, max_len)

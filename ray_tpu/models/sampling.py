@@ -1,0 +1,83 @@
+"""Token selection shared by every generation path: make_generate_fn's
+in-scan `_pick` and the continuous-batching engine's prefill/decode
+steps (ray_tpu/inference/engine.py) call the same functions, so greedy
+decoding is bit-identical across them by construction.
+
+Two entry points for the two shapes of temperature:
+
+- ``sample_logits``: temperature is a *static* Python float (compiled
+  into the program). temperature<=0 short-circuits to a pure
+  ``jnp.argmax`` — no masking, no division — which is exactly the op the
+  pre-refactor ``_pick`` compiled, keeping temperature=0 outputs
+  bit-identical.
+- ``sample_logits_dynamic``: temperature is a *traced* per-row [B]
+  vector (the slot pool mixes requests with different temperatures in
+  one decode step). Greedy rows (temperature<=0) select the same argmax
+  as the static path via ``jnp.where``.
+
+top-k / top-p (nucleus) filtering are static knobs applied before
+sampling; both default off (top_k=0, top_p=1.0).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _apply_top_k(logits, top_k: int):
+    """Keep the top_k highest logits per row; mask the rest."""
+    if not top_k or top_k >= logits.shape[-1]:
+        return logits
+    kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+    return jnp.where(logits >= kth, logits, _NEG_INF)
+
+
+def _apply_top_p(logits, top_p: float):
+    """Nucleus filtering: keep the smallest prefix of the
+    probability-sorted vocab whose cumulative mass reaches top_p (the
+    first token is always kept)."""
+    if top_p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # exclusive cumsum: a token is kept while the mass BEFORE it < top_p
+    keep_sorted = (cum - probs) < top_p
+    # threshold = smallest kept logit; everything below it is masked
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                     axis=-1, keepdims=True)
+    return jnp.where(logits >= thresh, logits, _NEG_INF)
+
+
+def _filtered(logits, top_k: int, top_p: float):
+    logits = logits.astype(jnp.float32)
+    logits = _apply_top_k(logits, top_k)
+    return _apply_top_p(logits, top_p)
+
+
+def sample_logits(logits, rng, temperature: float = 0.0, top_k: int = 0,
+                  top_p: float = 1.0):
+    """logits [..., V] -> token ids [...]. Static temperature:
+    temperature<=0 is greedy argmax (bit-identical to the historical
+    `_pick`); otherwise softmax sampling at the given temperature after
+    static top-k/top-p filtering."""
+    if not temperature or temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    return jax.random.categorical(
+        rng, _filtered(logits, top_k, top_p) / temperature, axis=-1)
+
+
+def sample_logits_dynamic(logits, rng, temperature, top_k: int = 0,
+                          top_p: float = 1.0):
+    """logits [B, V], temperature [B] (traced) -> token ids [B]. Rows
+    with temperature<=0 take the greedy argmax; the rest sample at their
+    own temperature. One program serves every mix of per-request
+    sampling settings, so the decode step never recompiles."""
+    greedy = jnp.argmax(logits, axis=-1)
+    temp = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    sampled = jax.random.categorical(
+        rng, _filtered(logits, top_k, top_p) / temp[:, None], axis=-1)
+    return jnp.where(jnp.asarray(temperature) > 0.0, sampled, greedy)

@@ -122,9 +122,12 @@ def _cached_attention(q, k_cache, v_cache, q_pos0):
 
     q [B,S,H,D] are the S newest positions (absolute start q_pos0);
     caches [B,M,Hkv,D] already contain the new keys/values written at
-    [q_pos0, q_pos0+S). Mask: query i attends cache slots j <= q_pos0+i
-    (causal over absolute positions; padded tail masked out). Plain dot-
-    product in fp32 — decode is bandwidth-bound on the cache read, not
+    [q_pos0, q_pos0+S). q_pos0 is a scalar (shared start, the
+    make_generate_fn shape) or a [B] vector (per-slot starts — the
+    continuous-batching slot pool, where every sequence sits at its own
+    length). Mask: query i attends cache slots j <= q_pos0+i (causal
+    over absolute positions; padded tail masked out). Plain dot-product
+    in fp32 — decode is bandwidth-bound on the cache read, not
     MXU-bound, so there is nothing for the flash kernel to win here."""
     B, S, H, D = q.shape
     M, Hkv = k_cache.shape[1], k_cache.shape[2]
@@ -134,24 +137,43 @@ def _cached_attention(q, k_cache, v_cache, q_pos0):
     qg = q.reshape(B, S, Hkv, G, D).astype(jnp.float32)
     scores = jnp.einsum("bshgd,bmhd->bhgsm", qg,
                         k_cache.astype(jnp.float32)) / jnp.sqrt(float(D))
-    qpos = q_pos0 + jnp.arange(S)
-    mask = jnp.arange(M)[None, :] <= qpos[:, None]          # [S, M]
-    scores = jnp.where(mask[None, None, None], scores, -1e30)
+    # [1,S] (scalar start) or [B,S] (per-slot starts)
+    qpos = jnp.reshape(q_pos0, (-1, 1)) + jnp.arange(S)[None, :]
+    mask = jnp.arange(M)[None, None, :] <= qpos[:, :, None]  # [B|1,S,M]
+    scores = jnp.where(mask[:, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgsm,bmhd->bshgd", probs,
                      v_cache.astype(jnp.float32))
     return out.reshape(B, S, H, D).astype(q.dtype)
 
 
+def _cache_write(cache, new, idx):
+    """Write `new` [B,L,Hkv,D] into `cache` [B,M,Hkv,D] at position
+    `idx`: a scalar (all rows share one write offset) or a [B] vector
+    (per-slot offsets — each row lands at its own length). XLA clamps
+    out-of-range starts, so a full/free slot writes at M-L harmlessly."""
+    new = new.astype(cache.dtype)
+    if jnp.ndim(idx) == 0:
+        return jax.lax.dynamic_update_slice(cache, new, (0, idx, 0, 0))
+    return jax.vmap(
+        lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (i, 0, 0))
+    )(cache, new, idx)
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
+    # static: route L>1 cache writes through _cached_attention (prefill
+    # CONTINUES an occupied cache — chunked prefill) instead of assuming
+    # an empty cache and using the fused kernel
+    chunked: bool = False
 
     @nn.compact
     def __call__(self, x, positions, cache=None):
         """cache=None: training/prefill forward (flash/ring dispatch),
         returns out. cache=(k_cache, v_cache, idx): serving decode —
-        writes this call's K/V at [idx, idx+L), attends against the
-        cache, returns (out, (k_cache', v_cache'))."""
+        writes this call's K/V at [idx, idx+L) (idx scalar or per-slot
+        [B] vector), attends against the cache, returns
+        (out, (k_cache', v_cache'))."""
         cfg = self.cfg
         B, L, E = x.shape
         H, Hkv, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -174,16 +196,16 @@ class Attention(nn.Module):
                                      impl=cfg.attention_impl)
             return proj(out)
         k_cache, v_cache, idx = cache
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (0, idx, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (0, idx, 0, 0))
-        if L > 1:
-            # prefill (L is static): the block attends only within
-            # itself, so the fused flash/ring kernel computes it — the
-            # cache is just written, never read. This assumes prefill
-            # starts from an EMPTY cache (idx==0, the make_generate_fn
-            # contract); chunked prefill would need the cached path.
+        k_cache = _cache_write(k_cache, k, idx)
+        v_cache = _cache_write(v_cache, v, idx)
+        if L > 1 and not self.chunked:
+            # one-shot prefill (L is static): the block attends only
+            # within itself, so the fused flash/ring kernel computes it
+            # — the cache is just written, never read. This assumes
+            # prefill starts from an EMPTY cache (idx==0, the
+            # make_generate_fn contract); chunked prefill (idx>0) sets
+            # `chunked` and takes the cached path below, which attends
+            # the earlier chunks at the correct causal offset.
             out = attention_dispatch(q, k, v, causal=True,
                                      impl=cfg.attention_impl)
         else:
@@ -209,11 +231,12 @@ class MLP(nn.Module):
 
 class Block(nn.Module):
     cfg: TransformerConfig
+    chunked: bool = False
 
     @nn.compact
     def __call__(self, x, positions, cache=None):
         cfg = self.cfg
-        att = Attention(cfg, name="attn")(
+        att = Attention(cfg, self.chunked, name="attn")(
             RMSNorm(cfg.norm_eps, cfg.dtype, name="attn_norm")(x),
             positions, cache)
         new_cache = None
@@ -256,11 +279,12 @@ class DecodeScanBlock(nn.Module):
     comes back in the ys. Param names mirror ScanBlock ('block' under
     the scan) so the SAME trained/stacked params apply."""
     cfg: TransformerConfig
+    chunked: bool = False
 
     @nn.compact
     def __call__(self, carry, cache_kv):
         x, positions, idx = carry
-        out, _aux, new_cache = Block(self.cfg, name="block")(
+        out, _aux, new_cache = Block(self.cfg, self.chunked, name="block")(
             x, positions, (cache_kv[0], cache_kv[1], idx))
         return (out, positions, idx), new_cache
 
@@ -280,18 +304,27 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, return_hidden=False,
-                 cache=None):
+                 cache=None, chunked_prefill=False):
         """return_hidden=True skips the unembed projection and returns the
         final-norm hidden states [B,L,d] — callers (train_step's chunked
         cross-entropy) then compute logits a block at a time so the
-        [B,L,vocab] buffer never exists in HBM."""
+        [B,L,vocab] buffer never exists in HBM.
+
+        chunked_prefill=True (static; needs cache): this L>1 forward
+        CONTINUES a partially-filled cache — attention runs against the
+        cache with the causal offset cache["idx"] instead of assuming
+        idx==0 (the inference engine's budgeted prompt chunks).
+        cache["idx"] may be a scalar or a per-row [B] vector (slot pool:
+        every row decodes at its own length)."""
         cfg = self.cfg
         B, L = tokens.shape
         if positions is None:
             if cache is not None:
                 # decode: tokens continue at the cache's write position
-                positions = cache["idx"] + jnp.broadcast_to(
-                    jnp.arange(L)[None, :], (B, L))
+                # (scalar idx, or [B] per-slot write positions)
+                positions = jnp.broadcast_to(
+                    jnp.reshape(cache["idx"], (-1, 1))
+                    + jnp.arange(L)[None, :], (B, L))
             else:
                 positions = jnp.broadcast_to(jnp.arange(L)[None, :],
                                              (B, L))
@@ -307,7 +340,8 @@ class TransformerLM(nn.Module):
         from ray_tpu.parallel.sharding import constrain
         x = constrain(x, ("batch", "seq", None))
         if cache is not None:
-            return self._decode(x, positions, cache, embed, return_hidden)
+            return self._decode(x, positions, cache, embed, return_hidden,
+                                chunked_prefill)
 
         # (training/prefill path continues below)
 
@@ -377,7 +411,8 @@ class TransformerLM(nn.Module):
                                 unembed.astype(cfg.dtype))
         return logits.astype(jnp.float32) if cfg.logits_fp32 else logits
 
-    def _decode(self, x, positions, cache, embed, return_hidden):
+    def _decode(self, x, positions, cache, embed, return_hidden,
+                chunked_prefill=False):
         """Serving decode forward: applies every layer against the KV
         cache and returns (logits|hidden, new_cache). Shares the
         training param tree — the decode scan mirrors ScanBlock's
@@ -393,13 +428,14 @@ class TransformerLM(nn.Module):
                 in_axes=0,
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, name="layers")
+            )(cfg, chunked_prefill, name="layers")
             (x, _, _), (k_new, v_new) = stack((x, positions, idx),
                                               (cache["k"], cache["v"]))
         else:
             ks, vs = [], []
             for i in range(cfg.n_layers):
-                x, _aux, (k_i, v_i) = Block(cfg, name=f"layer_{i}")(
+                x, _aux, (k_i, v_i) = Block(
+                    cfg, chunked_prefill, name=f"layer_{i}")(
                     x, positions, (cache["k"][i], cache["v"][i], idx))
                 ks.append(k_i)
                 vs.append(v_i)

@@ -105,7 +105,20 @@ class DeploymentResponseGenerator:
             self._router._dec(self._idx)
             self._router = None
 
+    def close(self):
+        """Walk away mid-stream: stops the replica-side generator (its
+        finally/GeneratorExit path runs, freeing whatever the stream
+        held — e.g. an inference-engine slot), drops unconsumed chunks,
+        and releases this handle's in-flight routing count."""
+        try:
+            self._gen.close()
+        except Exception:
+            pass
+        self._settle()
+
     def __del__(self):
+        # only the routing count here: the underlying ObjectRefGenerator
+        # closes itself (non-blocking) in its own __del__
         self._settle()
 
 

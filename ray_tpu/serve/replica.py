@@ -92,15 +92,28 @@ class Replica:
                 it = iter(fn(*args, **kwargs))
             finally:
                 multiplex._current_model_id.reset(tok)
-            while True:
-                tok = multiplex._set_model_id(model_id)
-                try:
-                    chunk = next(it)
-                except StopIteration:
-                    break
-                finally:
-                    multiplex._current_model_id.reset(tok)
-                yield chunk
+            try:
+                while True:
+                    tok = multiplex._set_model_id(model_id)
+                    try:
+                        chunk = next(it)
+                    except StopIteration:
+                        break
+                    finally:
+                        multiplex._current_model_id.reset(tok)
+                    yield chunk
+            finally:
+                # consumer walked away (GeneratorExit lands on the yield
+                # above) or the stream errored: close the USER generator
+                # deterministically so its finally/except runs NOW —
+                # engine slots, file handles etc. free immediately
+                # instead of at some future GC pass
+                close = getattr(it, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:
+                        pass
         finally:
             with self._lock:
                 self._ongoing -= 1

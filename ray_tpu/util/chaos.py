@@ -53,3 +53,62 @@ class NodeKiller:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+
+
+class ServeReplicaKiller:
+    """Kill serve replica actors mid-request (streaming included) and
+    let the controller's reconcile loop replace them — the serving
+    analog of NodeKiller. Used by the kill-replica-mid-stream tests to
+    assert that per-replica resources (inference-engine slots, queue
+    gauges) come back clean on the replacement replica."""
+
+    def __init__(self, app_name: str, deployment_name: str, seed: int = 0):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self.killed = 0
+        self._rng = random.Random(seed)
+
+    def _info(self):
+        import ray_tpu
+        from ray_tpu.serve.api import _get_controller
+        return ray_tpu.get(_get_controller().get_deployment_info.remote(
+            self.app_name, self.deployment_name), timeout=30)
+
+    def replicas(self) -> List:
+        return list(self._info().get("replicas") or [])
+
+    def kill_one(self) -> bool:
+        """Kill one (random) replica actor; returns False when none are
+        up. The controller detects the death on its next reconcile and
+        builds a replacement."""
+        import ray_tpu
+        reps = self.replicas()
+        if not reps:
+            return False
+        victim = self._rng.choice(reps)
+        try:
+            ray_tpu.kill(victim)
+        except Exception:
+            return False
+        self.killed += 1
+        return True
+
+    def wait_for_replacement(self, timeout_s: float = 60.0,
+                             min_running: int = 1) -> bool:
+        """Block until the deployment again reports >= min_running
+        replicas under a NEW version set (the controller bumps the
+        router view when the replica set changes)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                reps = self.replicas()
+                if len(reps) >= min_running:
+                    import ray_tpu
+                    # replacement must actually answer, not just exist
+                    ray_tpu.get([r.get_queue_len.remote() for r in reps],
+                                timeout=10)
+                    return True
+            except Exception:
+                pass
+            time.sleep(0.5)
+        return False

@@ -40,10 +40,15 @@ def list_named_actors(namespace: Optional[str] = None) -> List[Dict]:
 def list_objects(limit: int = 1000) -> List[Dict]:
     """Objects in this node's shared-memory store plus this process's
     ownership entries (reference: `ray memory` merges the store view with
-    per-worker refcount tables)."""
+    per-worker refcount tables).
+
+    Merge order: the shm-store scan runs first, then this process's
+    owned table folds INTO it — an object present in both yields ONE
+    row (kind="owned+shm", carrying both the store's size_bytes and the
+    ownership fields) rather than two. At most `limit` rows return;
+    shm rows win the budget because they represent real arena bytes."""
     core = _w().core
-    out = []
-    seen = set()
+    rows: Dict[bytes, Dict] = {}
     if core.store is not None:
         for oid in core.store.list_objects(max_n=limit):
             size = 0
@@ -51,21 +56,23 @@ def list_objects(limit: int = 1000) -> List[Dict]:
             if buf is not None:
                 size = len(buf.data) + len(buf.metadata or b"")
                 buf.close()
-            seen.add(oid)
-            out.append({"object_id": oid.hex(), "node_id": core.node_id,
-                        "size_bytes": size, "kind": "shm"})
-    for oid, entry in list(core.owned.items())[:limit]:
-        row = {
-            "object_id": oid.hex(), "node_id": core.node_id,
-            "kind": "owned", "complete": bool(entry.get("complete")),
+            rows[oid] = {"object_id": oid.hex(), "node_id": core.node_id,
+                         "size_bytes": size, "kind": "shm"}
+    for oid, entry in list(core.owned.items()):
+        owned_fields = {
+            "complete": bool(entry.get("complete")),
             "location": entry.get("location"),
             "borrowers": len(entry.get("borrowers") or ()),
             "task_pins": entry.get("submitted", 0),
         }
-        if oid in seen:
+        row = rows.get(oid)
+        if row is not None:
+            row.update(owned_fields)
             row["kind"] = "owned+shm"
-        out.append(row)
-    return out[:limit * 2]
+        elif len(rows) < limit:
+            rows[oid] = {"object_id": oid.hex(), "node_id": core.node_id,
+                         "kind": "owned", **owned_fields}
+    return list(rows.values())[:limit]
 
 
 def summarize_tasks() -> Dict[str, Dict[str, int]]:

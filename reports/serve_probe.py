@@ -1,0 +1,196 @@
+"""Continuous-batching serving throughput probe (bench.py subprocess;
+the serving counterpart of decode_probe.py).
+
+Drives the slot-pool engine (ray_tpu/inference/) with a seeded Poisson
+arrival process over a MIXED-length workload (prompt lengths and
+max_new_tokens both vary per request), measures:
+
+- serve_tokens_per_s: generated tokens / wall-clock from first arrival
+  to last completion (median of `runs` repetitions + spread, like the
+  RL ratchet),
+- ttft_p50_ms / ttft_p95_ms: per-request time-to-first-token under
+  those arrivals,
+- static_tokens_per_s: the same request set pushed through the
+  fixed-batch `make_generate_fn` path (pad every prompt to the longest,
+  run every batch to the longest max_new — what the pre-engine stack
+  did), recorded in the SAME entry so the artifact carries its own
+  baseline,
+- vs_static: continuous / static (>= 1.0 expected on mixed lengths).
+
+Usage: python serve_probe.py --one '{"model": "tiny", "n_slots": 8,
+                                     "n_requests": 24}'
+Prints one line: RESULT {json}
+
+"tiny" is a CPU-sized debug config: unlike the MFU/decode probes this
+one runs without a TPU (the continuous-vs-static comparison is
+platform-independent), so bench.py records it every round.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _model_cfg(name):
+    import jax.numpy as jnp
+
+    from ray_tpu.models import MODEL_REGISTRY
+    from ray_tpu.models.transformer import TransformerConfig
+    if name == "tiny":
+        # big enough that a decode step's device time dominates the
+        # host-side step overhead (the regime real serving lives in);
+        # small enough to compile+run in seconds on the CI CPU
+        return TransformerConfig(
+            vocab_size=256, d_model=256, n_layers=6, n_heads=8,
+            n_kv_heads=4, d_ff=1024, max_seq_len=512, dtype=jnp.float32,
+            param_dtype=jnp.float32, remat=False)
+    cfg = MODEL_REGISTRY[name]
+    return dataclasses.replace(cfg, param_dtype=jnp.bfloat16,
+                               dtype=jnp.bfloat16, remat=False)
+
+
+def _workload(spec, rng):
+    """Mixed-length request set + Poisson arrival offsets (seconds)."""
+    n = spec.get("n_requests", 24)
+    plo, phi = spec.get("prompt_lens", [4, 48])
+    nlo, nhi = spec.get("new_tokens", [8, 48])
+    vocab = spec.get("vocab", 128)
+    reqs = []
+    for _ in range(n):
+        p = int(rng.integers(plo, phi + 1))
+        reqs.append({
+            "prompt": rng.integers(0, vocab, size=p).astype("int32"),
+            "new": int(rng.integers(nlo, nhi + 1)),
+        })
+    rate = spec.get("arrival_rate_rps", 50.0)
+    gaps = rng.exponential(1.0 / rate, size=n)
+    arrivals = gaps.cumsum()
+    arrivals[0] = 0.0
+    return reqs, arrivals
+
+
+def _run_continuous(engine, reqs, arrivals):
+    """Submit at Poisson offsets; returns (tokens_per_s, ttfts_ms)."""
+    handles = [None] * len(reqs)
+
+    def submitter():
+        t0 = time.perf_counter()
+        for i, (r, at) in enumerate(zip(reqs, arrivals)):
+            delay = at - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            handles[i] = engine.submit(r["prompt"],
+                                       max_new_tokens=r["new"])
+    t_start = time.perf_counter()
+    th = threading.Thread(target=submitter)
+    th.start()
+    th.join()
+    total = 0
+    for h in handles:
+        total += len(h.tokens())          # drains to completion
+    wall = time.perf_counter() - t_start
+    ttfts = [h.ttft_s * 1000.0 for h in handles if h.ttft_s is not None]
+    return total / wall, ttfts
+
+
+def _run_static(model, params, mesh, reqs, n_slots, vocab):
+    """Fixed-batch baseline: batches of n_slots in arrival order, every
+    prompt padded to the set's longest, every batch decoded to the
+    longest max_new. Useful tokens = what each request asked for."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models.generate import make_generate_fn
+    prompt_len = max(len(r["prompt"]) for r in reqs)
+    max_new = max(r["new"] for r in reqs)
+    _, gen_fn, _ = make_generate_fn(model, mesh, batch=n_slots,
+                                    prompt_len=prompt_len,
+                                    max_new_tokens=max_new)
+    batch_tok = np.zeros((n_slots, prompt_len), np.int32)
+    gen_fn(params, batch_tok, jax.random.PRNGKey(0))   # compile
+    t0 = time.perf_counter()
+    useful = 0
+    for lo in range(0, len(reqs), n_slots):
+        group = reqs[lo:lo + n_slots]
+        batch_tok = np.zeros((n_slots, prompt_len), np.int32)
+        for j, r in enumerate(group):
+            # left-pad-free: static batching pads the tail; positions
+            # beyond the real prompt just echo token 0 — cost model is
+            # identical and that's all this baseline measures
+            batch_tok[j, :len(r["prompt"])] = r["prompt"]
+        np.asarray(gen_fn(params, batch_tok, jax.random.PRNGKey(1)))
+        useful += sum(r["new"] for r in group)
+    wall = time.perf_counter() - t0
+    return useful / wall
+
+
+def run(spec):
+    import jax
+    import numpy as np
+
+    from ray_tpu.inference import EngineConfig, InferenceEngine
+    from ray_tpu.models import TransformerLM
+    from ray_tpu.parallel import MeshConfig, make_mesh
+
+    cfg = _model_cfg(spec.get("model", "tiny"))
+    spec.setdefault("vocab", min(cfg.vocab_size, 128))
+    model = TransformerLM(cfg)
+    n_slots = spec.get("n_slots", 8)
+    max_len = spec.get("max_len", min(256, cfg.max_seq_len))
+    prefill_chunk = spec.get("prefill_chunk", 32)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 8), np.int32))["params"]
+    engine = InferenceEngine(
+        model, params,
+        EngineConfig(n_slots=n_slots, max_len=max_len,
+                     prefill_chunk=prefill_chunk,
+                     prefill_budget=spec.get("prefill_budget",
+                                             2 * prefill_chunk)))
+    engine.start()
+    rng = np.random.default_rng(spec.get("seed", 0))
+    reqs, arrivals = _workload(spec, rng)
+
+    # warmup: compile all three engine programs on a short request
+    list(engine.submit(reqs[0]["prompt"][:4], max_new_tokens=2))
+
+    rates, all_ttfts = [], []
+    for _ in range(spec.get("runs", 3)):
+        rate, ttfts = _run_continuous(engine, reqs, arrivals)
+        rates.append(rate)
+        all_ttfts.extend(ttfts)
+    engine.stop()
+
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1, seq=1, tensor=1),
+                     devices=jax.devices()[:1])
+    static_rate = _run_static(model, params, mesh, reqs, n_slots,
+                              spec["vocab"])
+
+    rates.sort()
+    med = rates[len(rates) // 2]
+    spread = (rates[-1] - rates[0]) / med if med else 0.0
+    all_ttfts.sort()
+    p50 = all_ttfts[len(all_ttfts) // 2] if all_ttfts else 0.0
+    p95 = all_ttfts[int(len(all_ttfts) * 0.95)] if all_ttfts else 0.0
+    return {
+        "model": spec.get("model", "tiny"), "n_slots": n_slots,
+        "max_len": max_len, "n_requests": len(reqs),
+        "arrival_rate_rps": spec.get("arrival_rate_rps", 50.0),
+        "serve_tokens_per_s": round(med, 1),
+        "spread": round(spread, 3),
+        "runs": [round(r, 1) for r in rates],
+        "ttft_p50_ms": round(p50, 1), "ttft_p95_ms": round(p95, 1),
+        "static_tokens_per_s": round(static_rate, 1),
+        "vs_static": round(med / static_rate, 3) if static_rate else None,
+    }
+
+
+if __name__ == "__main__":
+    spec = json.loads(sys.argv[sys.argv.index("--one") + 1])
+    print("RESULT " + json.dumps(run(spec)), flush=True)

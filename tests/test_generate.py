@@ -80,6 +80,77 @@ def test_cached_decode_matches_unrolled_layers(jax_cpu, debug_model):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_chunked_prefill_matches_one_shot(jax_cpu, debug_model):
+    """Prefill split into budgeted chunks through the cached-attention
+    path (chunked_prefill=True, idx>0) reproduces the one-shot prefill
+    logits at every position — the empty-cache restriction is lifted."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models import init_cache
+    cfg, model, params, tokens = debug_model
+    full = model.apply({"params": params}, tokens)
+    one_shot = init_cache(cfg, 2, 12, dtype=jnp.float32)
+    lg_one, one_shot = model.apply({"params": params}, tokens,
+                                   cache=one_shot)
+    cache = init_cache(cfg, 2, 12, dtype=jnp.float32)
+    lgs = []
+    for lo, hi in [(0, 5), (5, 9), (9, 12)]:      # uneven chunks
+        lg, cache = model.apply({"params": params}, tokens[:, lo:hi],
+                                cache=cache, chunked_prefill=True)
+        lgs.append(lg)
+    chunked = jnp.concatenate(lgs, axis=1)
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(full),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(lg_one),
+                               rtol=2e-4, atol=2e-4)
+    assert int(cache["idx"]) == 12
+    # caches agree -> subsequent decode steps agree
+    np.testing.assert_allclose(np.asarray(cache["k"]),
+                               np.asarray(one_shot["k"]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_per_slot_decode_positions(jax_cpu, debug_model):
+    """cache['idx'] as a per-row vector: each row decodes at its own
+    length (the slot-pool contract). Row parity against independent
+    scalar-idx decodes at different lengths."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models import init_cache
+    cfg, model, params, tokens = debug_model
+    lens = [7, 4]
+    # reference: each row prefilled alone to its own length, one decode
+    want = []
+    for b, ln in enumerate(lens):
+        c = init_cache(cfg, 1, 12, dtype=jnp.float32)
+        _, c = model.apply({"params": params}, tokens[b:b + 1, :ln],
+                           cache=c)
+        lg, _ = model.apply({"params": params}, tokens[b:b + 1, ln:ln + 1],
+                            cache=c)
+        want.append(np.asarray(lg[0, 0]))
+    # slot pool: both rows in one cache at different idx
+    pool = {"k": jnp.zeros((cfg.n_layers, 2, 12, cfg.n_kv_heads,
+                            cfg.head_dim), jnp.float32),
+            "v": jnp.zeros((cfg.n_layers, 2, 12, cfg.n_kv_heads,
+                            cfg.head_dim), jnp.float32),
+            "idx": jnp.zeros((), jnp.int32)}
+    for b, ln in enumerate(lens):
+        c = init_cache(cfg, 1, 12, dtype=jnp.float32)
+        _, c = model.apply({"params": params}, tokens[b:b + 1, :ln],
+                           cache=c)
+        pool["k"] = pool["k"].at[:, b:b + 1].set(c["k"])
+        pool["v"] = pool["v"].at[:, b:b + 1].set(c["v"])
+    pool["idx"] = jnp.asarray(lens, jnp.int32)
+    step_tok = jnp.stack([tokens[b, ln] for b, ln in enumerate(lens)])
+    lg, new = model.apply({"params": params}, step_tok[:, None],
+                          cache=pool)
+    for b in range(2):
+        np.testing.assert_allclose(np.asarray(lg[b, 0]), want[b],
+                                   rtol=2e-4, atol=2e-4)
+    np.testing.assert_array_equal(np.asarray(new["idx"]),
+                                  np.asarray(lens) + 1)
+
+
 def test_generate_greedy_matches_stepwise_argmax(jax_cpu, debug_model):
     """make_generate_fn's one-program generation equals a hand loop of
     full forwards + argmax."""

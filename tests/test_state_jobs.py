@@ -194,3 +194,23 @@ def test_list_objects_reports_sizes(ray_start):
     owned = [r for r in rows if "owned" in r.get("kind", "")]
     assert any(r["object_id"] == ref.id.hex() for r in owned)
     del ref
+
+
+def test_list_objects_respects_limit_and_dedupes(ray_start):
+    """An object both shm-resident and owned collapses to ONE
+    'owned+shm' row (carrying size AND ownership fields), and the
+    result never exceeds `limit` rows."""
+    import numpy as np
+    refs = [ray_tpu.put(np.ones(200_000, dtype=np.uint8))
+            for _ in range(6)]
+    rows = state.list_objects()
+    ids = [r["object_id"] for r in rows]
+    assert len(ids) == len(set(ids)), "duplicate rows for one object"
+    merged = {r["object_id"]: r for r in rows}
+    for ref in refs:
+        row = merged[ref.id.hex()]
+        assert row["kind"] == "owned+shm"
+        assert row["size_bytes"] >= 200_000
+        assert "complete" in row and "borrowers" in row
+    assert len(state.list_objects(limit=3)) <= 3
+    del refs
