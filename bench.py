@@ -421,6 +421,26 @@ def bench_serve_tokens_per_s(tpu_ok: bool = False):
     return {"skipped": True, "reason": last}
 
 
+def bench_recorder_overhead():
+    """Flight-recorder cost guard (reports/trace_probe.py): put and
+    decode-step throughput with the recorder on vs off. The
+    instrumentation only earns its keep if it is effectively free —
+    within_budget asserts < 5% on both paths."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    runner = os.path.join(here, "reports", "trace_probe.py")
+    spec = {"iters": 400, "put_iters": 3000, "runs": 3}
+    last = "unknown"
+    for attempt in range(2):
+        if attempt:
+            time.sleep(5)
+        result, last = _run_probe(runner, spec, timeout=900)
+        if result is not None:
+            return result
+        log(f"recorder overhead probe failed: {last}")
+    return {"skipped": True, "reason": last}
+
+
 def bench_train_step_mfu():
     """Flagship-model train step on the real chip: tokens/s + MFU.
 
@@ -763,6 +783,30 @@ def main():
         log(f"serve probe FAILED: {e}")
         results["serve_tokens_per_s"] = {"skipped": True,
                                          "reason": str(e)[:200]}
+
+    try:
+        rec = bench_recorder_overhead()
+        if not rec.get("skipped"):
+            results["recorder_overhead"] = {
+                "value": rec.get("overhead_decode_pct"),
+                "unit": "pct_decode_step",
+                "overhead_put_pct": rec.get("overhead_put_pct"),
+                "put_path": rec.get("put_path"),
+                "span_cost_us": rec.get("span_cost_us"),
+                "decode_steps_per_s_on": rec.get("decode_steps_per_s_on"),
+                "decode_steps_per_s_off": rec.get(
+                    "decode_steps_per_s_off"),
+                "within_budget": rec.get("within_budget")}
+            log(f"recorder_overhead: decode {rec['overhead_decode_pct']}%"
+                f" put {rec.get('overhead_put_pct')}% "
+                f"(within_budget={rec.get('within_budget')})")
+        else:
+            results["recorder_overhead"] = rec
+            log(f"recorder overhead probe skipped: {rec.get('reason')}")
+    except Exception as e:
+        log(f"recorder overhead probe FAILED: {e}")
+        results["recorder_overhead"] = {"skipped": True,
+                                        "reason": str(e)[:200]}
     if not mfu_res.get("skipped"):
         results["train_step_mfu"] = {
             "value": round(mfu_res["mfu"], 4),

@@ -277,30 +277,18 @@ def cancel(ref: ObjectRef, *, force: bool = False):
 
 
 def timeline(filename: Optional[str] = None):
-    """Export task events as a chrome://tracing JSON (reference:
-    `ray timeline`, python/ray/_private/state.py chrome trace export)."""
+    """Export the unified timeline — task executions PLUS the flight
+    recorder's runtime events (engine steps, spills, shuffle windows,
+    serve phases as per-subsystem tracks) — as a chrome://tracing JSON
+    (reference: `ray timeline`, python/ray/_private/state.py chrome
+    trace export)."""
     import json
-    events = []
-    for row in _get_worker().gcs_call("list_task_events", limit=10000):
-        times = row.get("state_times", {})
-        start = times.get("RUNNING")
-        end = times.get("FINISHED") or times.get("FAILED")
-        if start is None:
-            continue
-        end = end if end and end >= start else start
-        events.append({
-            "name": row.get("name", "task"),
-            "cat": row.get("type", "task"),
-            "ph": "X",
-            "ts": start * 1e6,
-            "dur": max(1.0, (end - start) * 1e6),
-            "pid": (row.get("node_id") or "node")[:8],
-            "tid": (row.get("worker_id") or "worker")[:8],
-            "args": {"task_id": row["task_id"], "state": row.get("state"),
-                     "trace_id": row.get("trace_id"),
-                     "span_id": row.get("span_id"),
-                     "parent_span_id": row.get("parent_span_id")},
-        })
+
+    from ray_tpu._private import events as _events
+    from ray_tpu.util.tracing import task_events_to_chrome
+    _events.flush()     # this process's buffered spans make the export
+    rows = _get_worker().gcs_call("list_task_events", limit=20000)
+    events = task_events_to_chrome(rows)
     if filename:
         with open(filename, "w") as f:
             json.dump(events, f)

@@ -76,7 +76,10 @@ class GcsServer:
         # task-event sink: ring buffer of merged per-task rows (reference:
         # GcsTaskManager, src/ray/gcs/gcs_server/gcs_task_manager.h:86)
         self.task_events: Dict[str, Dict] = {}
-        self.max_task_events = 10000
+        # runtime events (the flight recorder's kind="runtime_event"
+        # rows) share this ring with task rows; sized so a burst of
+        # engine-step spans can't evict the whole task timeline
+        self.max_task_events = 20000
         self.server = None
 
     # ------------------------------------------------------------- lifecycle
@@ -111,6 +114,7 @@ class GcsServer:
             "add_task_events": self.h_add_task_events,
             "report_metrics": self.h_report_metrics,
             "get_metrics": self.h_get_metrics,
+            "drop_worker_metrics": self.h_drop_worker_metrics,
             "list_task_events": self.h_list_task_events,
             "ping": lambda conn: "pong",
         }
@@ -411,6 +415,7 @@ class GcsServer:
         self._touch_node(node_id)
         logger.warning("node %s dead: %s", node_id[:12], reason)
         self.node_conns.pop(node_id, None)
+        self._drop_node_metrics(node_id)
         self._publish("NODE", node_id, {"state": "DEAD", "reason": reason,
                                         **_node_public(info)})
         # fail/restart actors that lived there
@@ -649,10 +654,21 @@ class GcsServer:
         return True
 
     def h_list_task_events(self, conn, limit: int = 1000,
-                           job_id: Optional[int] = None):
+                           job_id: Optional[int] = None,
+                           kind: Optional[str] = None,
+                           category: Optional[str] = None):
+        """kind=None returns everything (the unified timeline);
+        kind="task" excludes runtime events; kind="runtime_event"
+        returns only the flight recorder's rows, optionally filtered by
+        subsystem category ("engine", "store", "data", "serve")."""
         out = []
         for row in reversed(list(self.task_events.values())):
             if job_id is not None and row.get("job_id") != job_id:
+                continue
+            row_kind = row.get("kind") or "task"
+            if kind is not None and row_kind != kind:
+                continue
+            if category is not None and row.get("category") != category:
                 continue
             out.append(row)
             if len(out) >= limit:
@@ -660,16 +676,37 @@ class GcsServer:
         return out
 
     # --------------------------------------------------------------- pubsub
-    def h_report_metrics(self, conn, worker_id: str, metrics: list):
+    def h_report_metrics(self, conn, worker_id: str, metrics: list,
+                         node_id: Optional[str] = None):
         """Per-process metric snapshots (reference: the per-node metrics
-        agent collecting OpenCensus exports, metrics_agent.py:483)."""
+        agent collecting OpenCensus exports, metrics_agent.py:483).
+        node_id tags the snapshot's host so a node death can retire it
+        — a dead worker's gauges would otherwise sit in /metrics
+        forever. Counters flushed by a CLEAN worker shutdown survive
+        (the node is still alive then)."""
         if not hasattr(self, "metrics"):
             self.metrics = {}
+            self.metrics_node: Dict[str, Optional[str]] = {}
         self.metrics[worker_id] = metrics
+        self.metrics_node[worker_id] = node_id
         return True
 
     def h_get_metrics(self, conn):
         return getattr(self, "metrics", {})
+
+    def _drop_node_metrics(self, node_id: str):
+        node_of = getattr(self, "metrics_node", {})
+        for wid in [w for w, n in node_of.items() if n == node_id]:
+            getattr(self, "metrics", {}).pop(wid, None)
+            node_of.pop(wid, None)
+
+    def h_drop_worker_metrics(self, conn, worker_id: str):
+        """Node managers report crashed/killed workers here so their
+        gauges don't sit in /metrics forever. Clean DRIVER shutdowns
+        never route through this — their final counter flush persists."""
+        getattr(self, "metrics", {}).pop(worker_id, None)
+        getattr(self, "metrics_node", {}).pop(worker_id, None)
+        return True
 
     def h_subscribe(self, conn, channel: str):
         self.subscribers.setdefault(channel, set()).add(conn)

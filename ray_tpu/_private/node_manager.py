@@ -214,6 +214,23 @@ class NodeManager:
                               f"spill_{self.node_id[:8]}")
             self._spill_remote = False
         self.spilled: Dict[bytes, str] = {}
+        # flight-recorder sink: this daemon is not a worker, so its
+        # spill/restore/transfer spans ship over the node manager's own
+        # GCS connection (resolved at call time — it is replaced on GCS
+        # reconnect)
+        from ray_tpu._private import events as _events
+        _loop = asyncio.get_event_loop()
+
+        def _ship_events(batch):
+            gcs = self.gcs
+            if gcs is None or gcs.closed:
+                raise ConnectionError("gcs connection down")
+            asyncio.run_coroutine_threadsafe(
+                gcs.notify("add_task_events", events=batch), _loop)
+
+        _events.set_identity(node_id=self.node_id,
+                             worker_id=f"nm-{self.node_id[:12]}")
+        _events.set_sink(_ship_events)
         self._tasks = [
             asyncio.ensure_future(self._log_monitor_loop()),
             asyncio.ensure_future(self._heartbeat_loop()),
@@ -766,6 +783,15 @@ class NodeManager:
             self._idle.remove(w)
         self.workers.pop(w.worker_id, None)
         self._kill_proc(w)
+        if w.worker_id and self.gcs and not self.gcs.closed:
+            # retire the dead worker's metric snapshot: its gauges
+            # (queue depths, occupancy) would otherwise read as live
+            # forever in the /metrics aggregate
+            try:
+                await self.gcs.notify("drop_worker_metrics",
+                                      worker_id=w.worker_id)
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass
         if w.lease_id is not None:
             self._release_lease(w.lease_id, worker_dead=True)
         if w.worker_id:
@@ -1309,27 +1335,32 @@ class NodeManager:
                     f"{oid.hex()[:16]} ({size} bytes)")
             if status != "ok":
                 return True     # receiver already has it (or is receiving)
-            chunk = cfg.transfer_chunk_bytes
-            window = __import__("collections").deque()
-            off = 0
+            from ray_tpu._private import events
+            with events.record_span("store.transfer", category="store",
+                                    object_id=oid.hex()[:16], bytes=size,
+                                    to_node=to_node[:12],
+                                    relay=len(relay or [])):
+                chunk = cfg.transfer_chunk_bytes
+                window = __import__("collections").deque()
+                off = 0
 
-            def _check(accepted):
-                if accepted is False:
-                    raise RuntimeError(
-                        f"receiver {to_node[:12]} aborted transfer of "
-                        f"{oid.hex()[:16]} mid-stream")
+                def _check(accepted):
+                    if accepted is False:
+                        raise RuntimeError(
+                            f"receiver {to_node[:12]} aborted transfer of "
+                            f"{oid.hex()[:16]} mid-stream")
 
-            while off < size:
-                n = min(chunk, size - off)
-                f = peer.call_start_nowait(
-                    "push_chunk", {"oid": oid, "offset": off,
-                                   "data": bytes(buf.data[off:off + n])})
-                window.append(f)
-                off += n
-                if len(window) >= cfg.push_window_chunks:
-                    _check(await window.popleft())
-            for f in window:
-                _check(await f)
+                while off < size:
+                    n = min(chunk, size - off)
+                    f = peer.call_start_nowait(
+                        "push_chunk", {"oid": oid, "offset": off,
+                                       "data": bytes(buf.data[off:off + n])})
+                    window.append(f)
+                    off += n
+                    if len(window) >= cfg.push_window_chunks:
+                        _check(await window.popleft())
+                for f in window:
+                    _check(await f)
             return True
         finally:
             buf.close()
@@ -1457,6 +1488,8 @@ class NodeManager:
         else:
             _os.makedirs(self.spill_dir, exist_ok=True)
         n = 0
+        spilled_bytes = 0
+        t0 = time.time()
         for oid in self.store.list_objects():
             if oid in self.spilled:
                 # already on disk (a restored copy) — just drop the resident
@@ -1472,6 +1505,7 @@ class NodeManager:
                 continue
             try:
                 meta = bytes(buf.metadata)
+                spilled_bytes += len(buf.data) + len(meta)
                 if self._spill_remote:
                     path = _storage.join(self.spill_dir, oid.hex())
                     _storage.write_bytes(
@@ -1491,6 +1525,14 @@ class NodeManager:
             st = self.store.stats()
             if st["bytes_in_use"] < target_frac * cap:
                 break
+        if n:
+            # the span is recorded only for passes that moved something
+            # — the 1s poll's no-op passes would be pure timeline noise
+            from ray_tpu._private import events
+            events.record_complete(
+                "store.spill", t0, time.time(), category="store",
+                objects=n, bytes=spilled_bytes,
+                bytes_in_use=st["bytes_in_use"], capacity=cap)
         return n
 
     async def h_spill_now(self, conn):
@@ -1515,6 +1557,9 @@ class NodeManager:
         path = self.spilled.get(oid)
         if path is None:
             return False
+        from ray_tpu._private import events
+        rspan = events.start_span("store.restore", category="store",
+                                  object_id=oid.hex()[:16])
         try:
             if self._spill_remote:
                 from ray_tpu.util import storage as _storage
@@ -1530,6 +1575,7 @@ class NodeManager:
             self._spill_pass(trigger_frac=0.7, target_frac=0.5)
             bufs = self.store.create(oid, len(data), len(meta))
             if bufs is None:
+                rspan.end(ok=False, bytes=0)
                 return False
             dview, mview = bufs
             import numpy as np
@@ -1538,9 +1584,11 @@ class NodeManager:
             if meta:
                 mview[:] = meta
             self.store.seal(oid)
+            rspan.end(ok=True, bytes=len(data) + len(meta))
             return True
         except Exception:
             logger.exception("restore of %s failed", oid.hex()[:16])
+            rspan.end(ok=False, error="restore_failed")
             return False
 
     def h_free_object(self, conn, oid: bytes):

@@ -220,6 +220,16 @@ class ObjectStoreClient:
         if off == -17:  # EEXIST
             return None
         if off < 0:
+            # arena exhaustion is the event that triggers synchronous
+            # spills upstream — mark it on the flight-recorder timeline
+            # so spill spans line up with the allocation that forced them
+            try:
+                from ray_tpu._private import events
+                events.record_instant(
+                    "store.arena_full", category="store",
+                    object_id=oid.hex()[:16], requested=data_size, rc=off)
+            except Exception:
+                pass
             raise MemoryError(f"object store create failed (rc={off})")
         data = self._view[off:off + data_size]
         meta = self._view[off + data_size:off + data_size + meta_size]

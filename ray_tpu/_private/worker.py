@@ -2261,6 +2261,13 @@ class CoreWorker:
         self._gen_flow[task_id] = flow
         sent = 0
         agen = sgen = None
+        # streaming bodies run outside _execute's sync/async trace-setting
+        # paths (each resumption lands on whatever executor thread is
+        # free), so the task's propagated trace context is re-established
+        # around every resumption — runtime spans recorded inside a
+        # streaming generator (engine phases) parent under this task
+        trace_pair = (spec.get("trace_id"), spec.get("span_id"))
+        trace_tok = _trace_ctx.set(trace_pair)
         try:
             out = fn(*args, **kwargs)
             if hasattr(out, "__anext__"):
@@ -2274,10 +2281,14 @@ class CoreWorker:
             _SENTINEL = object()
 
             def _next_sync():
+                prev_trace = getattr(_exec_tls, "trace", None)
+                _exec_tls.trace = trace_pair
                 try:
                     return next(sgen)
                 except StopIteration:
                     return _SENTINEL
+                finally:
+                    _exec_tls.trace = prev_trace
 
             while True:
                 # bounded in-flight window: wait for consumption acks
@@ -2316,6 +2327,7 @@ class CoreWorker:
                                   ["wire"] + list(s.to_wire()))
                 sent += 1
         finally:
+            _trace_ctx.reset(trace_tok)
             self._gen_flow.pop(task_id, None)
             for g in (agen, sgen):
                 if g is not None:
@@ -2839,6 +2851,33 @@ class CoreWorker:
             try:
                 await asyncio.wait_for(
                     self.gcs.notify("add_task_events", events=batch), 1.0)
+            except Exception:
+                pass
+        if self.gcs is not None and not self.gcs.closed:
+            # flight-recorder spans buffered in this process ride the same
+            # sink — a short-lived worker's runtime events must not die
+            # with its 1s flusher cadence
+            try:
+                from ray_tpu._private import events as _events
+                ev_rows = _events.drain()
+                if ev_rows:
+                    await asyncio.wait_for(
+                        self.gcs.notify("add_task_events", events=ev_rows),
+                        1.0)
+            except Exception:
+                pass
+            # final metrics push (mirror of the task-event flush above):
+            # counters from workers shorter-lived than the 2s push cadence
+            # land in the GCS aggregate instead of vanishing
+            try:
+                from ray_tpu.util.metrics import registry_snapshot
+                payload = registry_snapshot()
+                if payload:
+                    await asyncio.wait_for(
+                        self.gcs.notify("report_metrics",
+                                        worker_id=self.worker_id,
+                                        node_id=self.node_id,
+                                        metrics=payload), 1.0)
             except Exception:
                 pass
         # cancel-and-await every background task (senders, dispatchers,

@@ -28,6 +28,8 @@ class DashboardServer:
         r.add_get("/api/nodes", self._nodes)
         r.add_get("/api/actors", self._actors)
         r.add_get("/api/tasks", self._tasks)
+        r.add_get("/api/timeline", self._timeline)
+        r.add_get("/api/runtime_events", self._runtime_events)
         r.add_get("/api/placement_groups", self._pgs)
         r.add_get("/api/jobs", self._jobs)
         r.add_post("/api/jobs", self._submit_job)
@@ -101,6 +103,27 @@ class DashboardServer:
         from aiohttp import web
         from ray_tpu.util import state
         return web.json_response(await self._in_thread(state.list_tasks))
+
+    async def _timeline(self, request):
+        """Unified chrome-trace timeline (tasks + flight-recorder
+        runtime events as per-subsystem tracks): save the body to a
+        file and open it in chrome://tracing or Perfetto."""
+        from aiohttp import web
+
+        def fetch():
+            import ray_tpu
+            return ray_tpu.timeline()
+        return web.json_response(await self._in_thread(fetch))
+
+    async def _runtime_events(self, request):
+        """Raw flight-recorder rows; ?category=engine|store|data|serve
+        filters by subsystem."""
+        from aiohttp import web
+        from ray_tpu.util import state
+        category = request.query.get("category") or None
+        rows = await self._in_thread(
+            lambda: state.list_runtime_events(category=category))
+        return web.json_response(rows)
 
     async def _pgs(self, request):
         from aiohttp import web

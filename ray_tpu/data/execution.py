@@ -654,19 +654,35 @@ class ExecutionStats:
 def _instrument(stream: Iterator[RefBundle], st: StageStats
                 ) -> Iterator[RefBundle]:
     import time
-    while True:
-        t0 = time.perf_counter()
-        try:
-            ref, meta = next(stream)
-        except StopIteration:
+
+    from ray_tpu._private import events
+    span = None
+    try:
+        while True:
+            t0 = time.perf_counter()
+            if span is None:
+                # opened on FIRST pull (plans build lazily; a stage the
+                # consumer never reaches must not appear on the timeline)
+                span = events.start_span("data.stage", category="data",
+                                         stage=st.name)
+            try:
+                ref, meta = next(stream)
+            except StopIteration:
+                st.wall_s += time.perf_counter() - t0
+                st.done = True
+                return
             st.wall_s += time.perf_counter() - t0
-            st.done = True
-            return
-        st.wall_s += time.perf_counter() - t0
-        st.tasks += 1
-        st.rows += getattr(meta, "num_rows", 0) or 0
-        st.bytes += getattr(meta, "size_bytes", 0) or 0
-        yield (ref, meta)
+            st.tasks += 1
+            st.rows += getattr(meta, "num_rows", 0) or 0
+            st.bytes += getattr(meta, "size_bytes", 0) or 0
+            yield (ref, meta)
+    finally:
+        # runs on exhaustion AND on early termination (limit pushdown,
+        # consumer walked away): a truncated stage still records, marked
+        if span is not None:
+            span.end(tasks=st.tasks, rows=st.rows, bytes=st.bytes,
+                     wall_ms=round(st.wall_s * 1e3, 3),
+                     truncated=not st.done)
 
 
 def _pushdown_limits(stages: List[Stage]) -> List[Stage]:

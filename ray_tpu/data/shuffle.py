@@ -291,52 +291,90 @@ class ShuffleStage:
         map_idx = 0
         peek_est = 0
 
-        while True:
-            while not exhausted and len(inflight) < self.max_in_flight:
-                est = 0
-                if budget is not None:
-                    est = peek_est
-                    if not budget.try_acquire(est, force=not inflight):
+        # flight-recorder windows: one span for the whole exchange with
+        # the ShuffleStats peak-live gauges attached at close, child
+        # spans for the map/merge window and the reduce window
+        from ray_tpu._private import events
+        shuffle_span = events.start_span("data.shuffle", category="data",
+                                         kind=self.kind, partitions=P)
+        self._rec_span = shuffle_span
+        map_span = events.start_span(
+            "data.shuffle.map", category="data",
+            trace_id=shuffle_span.trace_id,
+            parent_span_id=shuffle_span.span_id, kind=self.kind)
+        reduce_span = None
+        try:
+            while True:
+                while not exhausted and len(inflight) < self.max_in_flight:
+                    est = 0
+                    if budget is not None:
+                        est = peek_est
+                        if not budget.try_acquire(est, force=not inflight):
+                            break
+                    nxt = next(upstream, None)
+                    if nxt is None:
+                        if budget is not None:
+                            budget.release(est)
+                        exhausted = True
                         break
-                nxt = next(upstream, None)
-                if nxt is None:
+                    ref, meta = nxt
+                    peek_est = getattr(meta, "size_bytes", 0) or 0
+                    part_fn, args = self._map_plan(P, map_idx, bounds)
+                    out = map_task.remote(ref, part_fn, args, P)
+                    sub_refs, sizes_ref = list(out[:P]), out[P]
+                    inflight[sizes_ref] = (map_idx, sub_refs, est)
+                    st.map_tasks += 1
+                    st.input_blocks += 1
+                    st.input_bytes += peek_est
+                    st._touch_inputs(1)
+                    map_idx += 1
+                    # the input ref is dropped HERE: the map task's arg
+                    # holds it until execution; the driver never re-holds it
+                    del ref, nxt
+                if not inflight:
+                    break
+                ready, _ = ray_tpu.wait(list(inflight.keys()),
+                                        num_returns=1)
+                for sizes_ref in ready:
+                    idx, sub_refs, est = inflight.pop(sizes_ref)
+                    st._touch_inputs(-1)
                     if budget is not None:
                         budget.release(est)
-                    exhausted = True
-                    break
-                ref, meta = nxt
-                peek_est = getattr(meta, "size_bytes", 0) or 0
-                part_fn, args = self._map_plan(P, map_idx, bounds)
-                out = map_task.remote(ref, part_fn, args, P)
-                sub_refs, sizes_ref = list(out[:P]), out[P]
-                inflight[sizes_ref] = (map_idx, sub_refs, est)
-                st.map_tasks += 1
-                st.input_blocks += 1
-                st.input_bytes += peek_est
-                st._touch_inputs(1)
-                map_idx += 1
-                # the input ref is dropped HERE: the map task's arg holds
-                # it until execution; the driver never re-holds it
-                del ref, nxt
-            if not inflight:
-                break
-            ready, _ = ray_tpu.wait(list(inflight.keys()), num_returns=1)
-            for sizes_ref in ready:
-                idx, sub_refs, est = inflight.pop(sizes_ref)
-                st._touch_inputs(-1)
-                if budget is not None:
-                    budget.release(est)
-                sizes = ray_tpu.get(sizes_ref)
-                for j, (sref, (rows, nb)) in enumerate(zip(sub_refs, sizes)):
-                    p = parts[j]
-                    p.arrived[idx] = (sref, rows, nb)
-                    p.rows += rows
-                    p.bytes += nb
-                    st._touch_partials(1)
-                self._fold_ready_runs(parts, idx, merge_task, merge_q)
+                    sizes = ray_tpu.get(sizes_ref)
+                    for j, (sref, (rows, nb)) in enumerate(
+                            zip(sub_refs, sizes)):
+                        p = parts[j]
+                        p.arrived[idx] = (sref, rows, nb)
+                        p.rows += rows
+                        p.bytes += nb
+                        st._touch_partials(1)
+                    self._fold_ready_runs(parts, idx, merge_task, merge_q)
 
-        yield from self._reduce_all(parts, P, budget)
-        _LAST_STATS = st
+            map_span.end(map_tasks=st.map_tasks,
+                         merge_tasks=st.merge_tasks,
+                         input_blocks=st.input_blocks,
+                         input_bytes=st.input_bytes,
+                         peak_live_inputs=st.peak_live_inputs)
+            reduce_span = events.start_span(
+                "data.shuffle.reduce", category="data",
+                trace_id=shuffle_span.trace_id,
+                parent_span_id=shuffle_span.span_id, kind=self.kind)
+            yield from self._reduce_all(parts, P, budget)
+            _LAST_STATS = st
+        finally:
+            map_span.end()      # no-op unless the map window aborted
+            if reduce_span is not None:
+                reduce_span.end(reduce_tasks=st.reduce_tasks,
+                                output_rows=st.output_rows,
+                                output_bytes=st.output_bytes,
+                                locality_hits=st.locality_hits)
+            shuffle_span.end(
+                map_tasks=st.map_tasks, merge_tasks=st.merge_tasks,
+                reduce_tasks=st.reduce_tasks,
+                input_bytes=st.input_bytes, output_bytes=st.output_bytes,
+                peak_live_inputs=st.peak_live_inputs,
+                peak_live_partials=st.peak_live_partials)
+            self._rec_span = None
 
     def _sample_bounds(self, upstream, P):
         """Buffer a bounded prefix, sample range boundaries from it
@@ -382,6 +420,14 @@ class ShuffleStage:
             p.runs[m] = (block_ref, rows, nb)
             st.merge_tasks += 1
             st._touch_partials(-F)
+            rec = getattr(self, "_rec_span", None)
+            if rec is not None:
+                from ray_tpu._private import events
+                events.record_instant(
+                    "data.shuffle.merge", category="data",
+                    trace_id=rec.trace_id, parent_span_id=rec.span_id,
+                    run=m, bytes=nb, rows=rows,
+                    locality=node is not None)
             merge_q.append(meta_ref)
             # bounded merge pipeline: beyond the cap, wait for the
             # oldest merge before launching more

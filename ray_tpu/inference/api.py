@@ -98,25 +98,47 @@ class LLMDeployment:
         with .options(stream=True) this rides the replica streaming
         path; the finally-cancel frees the slot when the client drops
         the iterator mid-generation (GeneratorExit lands here)."""
-        handle = self.engine.submit(prompt_tokens,
-                                    max_new_tokens=max_new_tokens,
-                                    temperature=temperature,
-                                    eos_id=eos_id, deadline_s=deadline_s)
+        from ray_tpu._private import events
+        # the request span chains under the replica task's propagated
+        # trace context (the generator body runs inside handle_stream's
+        # execution, which re-establishes it per resumption), and the
+        # engine parents this request's slot span under it via the
+        # trace_context around submit()
+        req_span = events.start_span(
+            "engine.request", category="serve",
+            prompt_tokens=len(prompt_tokens),
+            max_new_tokens=int(max_new_tokens))
+        with events.trace_context(req_span.trace_id, req_span.span_id):
+            handle = self.engine.submit(prompt_tokens,
+                                        max_new_tokens=max_new_tokens,
+                                        temperature=temperature,
+                                        eos_id=eos_id,
+                                        deadline_s=deadline_s)
         prev_t: Optional[float] = None
+        n_tokens = 0
         try:
             for tok in handle:
                 now = time.monotonic()
                 if prev_t is None:
-                    self._metrics.first_token(now - handle.submitted_t)
+                    ttft = now - handle.submitted_t
+                    self._metrics.first_token(ttft)
+                    events.record_instant(
+                        "engine.first_token", category="serve",
+                        trace_id=req_span.trace_id,
+                        parent_span_id=req_span.span_id,
+                        ttft_ms=round(ttft * 1e3, 3))
                 else:
                     self._metrics.next_token(now - prev_t)
                 prev_t = now
+                n_tokens += 1
                 yield tok
         finally:
             # client walked away OR stream completed; cancel is a no-op
             # on a finished request
             handle.cancel()
-            self._metrics.finished(handle.finish_reason or "cancelled")
+            reason = handle.finish_reason or "cancelled"
+            self._metrics.finished(reason)
+            req_span.end(finish_reason=reason, tokens=n_tokens)
 
     def generate(self, prompt_tokens, **kw):
         """Non-streaming convenience: returns the full token list."""

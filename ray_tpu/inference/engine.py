@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ray_tpu._private import events
 from ray_tpu.inference.scheduler import (FINISH_LENGTH, PrefillChunk,
                                          Request, RequestHandle,
                                          RequestState, Scheduler)
@@ -138,6 +139,9 @@ class InferenceEngine:
         self.steps = 0
         self.tokens_generated = 0
         self.on_step: Optional[Callable[[Dict], None]] = None
+        # flight-recorder root for engine-owned work that belongs to no
+        # single request (multi-request decode batches)
+        self._trace_id = events.new_trace_id()
         self._build_fns()
 
     # ------------------------------------------------------------ device fns
@@ -233,7 +237,8 @@ class InferenceEngine:
         req = Request(tokens=tokens, max_new_tokens=int(max_new_tokens),
                       temperature=temperature, eos_id=eos_id,
                       deadline_s=(time.monotonic() + deadline_s
-                                  if deadline_s is not None else None))
+                                  if deadline_s is not None else None),
+                      trace_ctx=events.current_context())
         with self._work:
             if self._stop:
                 raise RuntimeError("engine is stopped")
@@ -301,6 +306,28 @@ class InferenceEngine:
                     self.sched.evict(st, FINISH_LENGTH)
             active = self.sched.active_states()
             if active:
+                # decode is a BATCH phase: when one request occupies the
+                # engine its span adopts that request's trace (the
+                # acceptance path — one Serve call renders its decode
+                # windows inline); with several co-resident traces the
+                # span records under the engine's own root trace with
+                # slot attribution instead of picking a favorite
+                traces = {st.span.trace_id for st in active
+                          if st.span is not None}
+                if len(active) == 1 and active[0].span is not None:
+                    d_trace = active[0].span.trace_id
+                    d_parent = active[0].span.span_id
+                elif len(traces) == 1:
+                    d_trace, d_parent = next(iter(traces)), None
+                else:
+                    d_trace, d_parent = self._trace_id, None
+                dspan = events.start_span(
+                    "engine.decode", category="engine",
+                    trace_id=d_trace, parent_span_id=d_parent,
+                    step=self.steps, slots_active=len(active),
+                    slots_occupied=self.sched.occupancy(),
+                    queue_depth=self.sched.queue_depth())
+                compiles0 = self.decode_compile_count
                 with self._mesh_ctx():
                     toks, self._pool_k, self._pool_v, self._rng = \
                         self._decode_fn(
@@ -315,6 +342,15 @@ class InferenceEngine:
                     self._last_tok[slot] = toks_host[slot]
                     self.tokens_generated += 1
                     self.sched.decode_emit(st, int(toks_host[slot]), now)
+                if self.decode_compile_count > compiles0:
+                    # a decode retrace is THE perf cliff this engine is
+                    # built to avoid — make every occurrence a first-class
+                    # timeline event (tests assert the count stays at 1)
+                    events.record_instant(
+                        "engine.compile", category="engine",
+                        trace_id=d_trace, parent_span_id=dspan.span_id,
+                        fn="decode", compile_count=self.decode_compile_count)
+                dspan.end(tokens=len(active))
                 did = True
             self.steps += 1
             if self.on_step is not None:
@@ -330,6 +366,21 @@ class InferenceEngine:
 
         cfg = self.config
         st = ch.state
+        if st.span is None:
+            # first chunk == admission: open the engine-slot span. It
+            # parents under the submitting request's propagated context
+            # (Serve path) or roots its own trace (direct engine use),
+            # and carries the queue-wait the built-in scheduler-latency
+            # metric is derived from.
+            ctx = st.request.trace_ctx
+            st.span = events.start_span(
+                "engine.slot", category="engine",
+                trace_id=ctx[0] if ctx else None,
+                parent_span_id=ctx[1] if ctx else None,
+                rid=st.rid, slot=st.slot,
+                prompt_tokens=len(st.request.tokens),
+                queue_wait_ms=round(
+                    (now - st.handle.submitted_t) * 1e3, 3))
         sk_sv = self._scratch.get(st.rid)
         if sk_sv is None:
             sk_sv = (self._zeros(self._scratch_shape, self._cache_dtype),
@@ -339,11 +390,25 @@ class InferenceEngine:
         chunk = np.zeros((1, cfg.prefill_chunk), np.int32)
         chunk[0, :ch.length] = prompt[ch.start:ch.start + ch.length]
         self._rng, k = jax.random.split(self._rng)
+        pspan = events.start_span(
+            "engine.prefill", category="engine",
+            trace_id=st.span.trace_id, parent_span_id=st.span.span_id,
+            rid=st.rid, slot=st.slot, offset=ch.start, length=ch.length,
+            is_last=ch.is_last,
+            slots_occupied=self.sched.occupancy())
+        compiles0 = self.prefill_compile_count
         with self._mesh_ctx():
             tok, sk, sv = self._prefill_fn(
                 self.params, sk, sv, jnp.asarray(chunk),
                 np.int32(ch.start), np.int32(ch.length), k,
                 np.float32(st.temperature))
+        if self.prefill_compile_count > compiles0:
+            events.record_instant(
+                "engine.compile", category="engine",
+                trace_id=st.span.trace_id,
+                parent_span_id=pspan.span_id, fn="prefill",
+                compile_count=self.prefill_compile_count)
+        pspan.end()
         if ch.is_last:
             slot = st.slot
             with self._mesh_ctx():

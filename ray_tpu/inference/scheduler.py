@@ -43,6 +43,11 @@ class Request:
     # request past it fails with FINISH_DEADLINE instead of occupying a
     # slot it can no longer use
     deadline_s: Optional[float] = None
+    # (trace_id, span_id) captured at submit: the flight recorder
+    # parents this request's engine-slot span under the submitting
+    # task/request span, so a Serve call renders proxy -> replica ->
+    # engine-slot as one trace
+    trace_ctx: Optional[Any] = None
 
 
 class RequestHandle:
@@ -126,6 +131,7 @@ class RequestState:
     prefill_pos: int = 0          # prompt tokens already prefilled
     generated: int = 0
     last_token: int = 0
+    span: Optional[Any] = None    # flight-recorder engine.slot span
 
 
 @dataclasses.dataclass
@@ -232,12 +238,26 @@ class Scheduler:
     def _release(self, st: RequestState, reason: str, now: float,
                  error: Optional[BaseException] = None):
         st.status = "FINISHED"
+        freed_slot = st.slot
         if st.slot is not None:
             self._active.pop(st.slot, None)
             self._free_slots.append(st.slot)
             self._free_slots.sort()
             st.slot = None
         st.handle._finish(reason, now, error)
+        if st.span is not None:
+            # the engine-slot span covers admission -> eviction; the
+            # finish reason and token count ride as attributes, and an
+            # eviction instant marks the exact slot-release point
+            from ray_tpu._private import events
+            events.record_instant(
+                "engine.evict", category="engine",
+                trace_id=st.span.trace_id,
+                parent_span_id=st.span.span_id,
+                slot=freed_slot, reason=reason)
+            st.span.end(finish_reason=reason,
+                        tokens_generated=st.generated)
+            st.span = None
 
     # --------------------------------------------------------- admission
     def plan_prefill(self) -> List[PrefillChunk]:

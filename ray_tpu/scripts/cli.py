@@ -195,7 +195,13 @@ def cmd_stack(args):
     import ray_tpu
     from ray_tpu.util.tracing import cluster_stacks, format_cluster_stacks
     ray_tpu.init(address=_load_address(args), ignore_reinit_error=True)
-    print(format_cluster_stacks(cluster_stacks()))
+    text = format_cluster_stacks(cluster_stacks())
+    if getattr(args, "output", None):
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+        print(f"wrote {args.output}")
+    else:
+        print(text)
 
 
 def cmd_export_traces(args):
@@ -314,6 +320,8 @@ def main(argv=None):
     pstack = sub.add_parser("stack",
                             help="dump live Python stacks cluster-wide")
     pstack.add_argument("--address", default=None)
+    pstack.add_argument("--output", "-o", default=None,
+                        help="write the dump to a file instead of stdout")
     pstack.set_defaults(fn=cmd_stack)
 
     ptr = sub.add_parser("export-traces",

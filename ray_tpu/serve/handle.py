@@ -79,6 +79,7 @@ class DeploymentResponseGenerator:
         self._gen = ref_gen
         self._router = router
         self._idx = replica_idx
+        self._got_first = False
 
     def __iter__(self):
         return self
@@ -95,10 +96,18 @@ class DeploymentResponseGenerator:
             self._settle()
             raise
         try:
-            return ray_tpu.get(ref, timeout=60)
+            value = ray_tpu.get(ref, timeout=60)
         except Exception:
             self._settle()
             raise
+        if not self._got_first:
+            # client-observed first chunk (TTFT as the CALLER saw it,
+            # network + queueing included — the engine-side first-token
+            # instant measures the same moment from the other end)
+            self._got_first = True
+            from ray_tpu._private import events
+            events.record_instant("serve.first_chunk", category="serve")
+        return value
 
     def _settle(self):
         if self._router is not None:
@@ -288,8 +297,13 @@ class DeploymentHandle:
             kwargs = {**kwargs, "__serve_model_id": model_id}
         stream = getattr(self, "_stream", False)
         last_err = None
+        from ray_tpu._private import events
         for _ in range(retry + 1):
-            idx, replica = self._router.pick(model_id)
+            with events.record_span("serve.route", category="serve",
+                                    deployment=self.deployment_name,
+                                    app=self.app_name) as route_span:
+                idx, replica = self._router.pick(model_id)
+                route_span.set(replica=idx)
             try:
                 if stream:
                     ref_gen = replica.handle_stream.options(

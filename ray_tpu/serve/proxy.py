@@ -83,8 +83,20 @@ class HttpProxy:
             self._handles[app_name] = h
         return h
 
+    @staticmethod
+    def _incoming_trace(request):
+        """W3C traceparent (`00-<trace32>-<span16>-<flags>`): an
+        upstream client's trace continues through the proxy instead of
+        rooting a fresh one."""
+        parts = request.headers.get("traceparent", "").split("-")
+        if len(parts) == 4 and len(parts[1]) == 32 and len(parts[2]) == 16:
+            return parts[1], parts[2]
+        return None, None
+
     async def _handle(self, request):
         from aiohttp import web
+
+        from ray_tpu._private import events
 
         path = "/" + request.match_info["tail"]
         app_name = None
@@ -103,13 +115,102 @@ class HttpProxy:
         else:
             payload = await request.text()
         handle = self._handle_for(app_name)
+        # the request's root span: every downstream phase (replica task,
+        # engine slot, first token) parents under it because the handle
+        # call below submits inside its trace context
+        trace_id, parent = self._incoming_trace(request)
+        span = events.start_span("proxy.request", category="serve",
+                                 trace_id=trace_id, parent_span_id=parent,
+                                 method=request.method, path=path,
+                                 app=app_name)
+        if (request.headers.get("X-RayTPU-Stream") == "1"
+                or "text/event-stream" in request.headers.get("Accept", "")):
+            return await self._handle_streaming(request, handle, payload,
+                                                span)
         loop = asyncio.get_event_loop()
+
+        def _call():
+            # routing + submit use the sync API; keep them off this loop.
+            # trace_context makes the replica task a child of this span.
+            with events.trace_context(span.trace_id, span.span_id):
+                return handle.remote(payload).result(timeout=60)
+
         try:
-            # routing + submit use the sync API; keep them off this loop
-            result = await loop.run_in_executor(
-                None, lambda: handle.remote(payload).result(timeout=60))
+            result = await loop.run_in_executor(None, _call)
         except Exception as e:
+            span.end(status=500, error=type(e).__name__)
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        span.end(status=200)
         if isinstance(result, (dict, list)):
             return web.json_response(result)
         return web.Response(text=str(result))
+
+    async def _handle_streaming(self, request, handle, payload, span):
+        """Streaming ingress: drive the deployment's streaming handle on
+        an executor thread and relay each chunk as one NDJSON line. A
+        client that disconnects mid-stream closes the replica-side
+        generator (its finally runs — engine slots free immediately)."""
+        import threading
+
+        from aiohttp import web
+
+        from ray_tpu._private import events
+
+        loop = asyncio.get_event_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        cancelled = threading.Event()
+
+        def _produce():
+            gen = None
+            try:
+                with events.trace_context(span.trace_id, span.span_id):
+                    gen = handle.options(stream=True).remote(payload)
+                n = 0
+                for chunk in gen:
+                    if cancelled.is_set():
+                        gen.close()
+                        loop.call_soon_threadsafe(q.put_nowait,
+                                                  ("end", n))
+                        return
+                    loop.call_soon_threadsafe(q.put_nowait,
+                                              ("item", chunk))
+                    n += 1
+                loop.call_soon_threadsafe(q.put_nowait, ("end", n))
+            except Exception as e:
+                if gen is not None:
+                    try:
+                        gen.close()
+                    except Exception:
+                        pass
+                loop.call_soon_threadsafe(q.put_nowait, ("error", e))
+
+        resp = web.StreamResponse()
+        resp.content_type = "application/x-ndjson"
+        await resp.prepare(request)
+        producer = loop.run_in_executor(None, _produce)
+        try:
+            while True:
+                kind, item = await q.get()
+                if kind == "item":
+                    await resp.write(
+                        (json.dumps(item, default=str) + "\n").encode())
+                elif kind == "error":
+                    span.end(status=500, error=type(item).__name__)
+                    await resp.write(
+                        (json.dumps({"error": f"{type(item).__name__}: "
+                                              f"{item}"}) + "\n").encode())
+                    break
+                else:
+                    span.end(status=200, chunks=item)
+                    break
+        except (ConnectionResetError, ConnectionError):
+            cancelled.set()
+            span.end(status=499, error="client_disconnected")
+        finally:
+            cancelled.set()
+            await producer
+        try:
+            await resp.write_eof()
+        except (ConnectionResetError, ConnectionError):
+            pass
+        return resp

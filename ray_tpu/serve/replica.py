@@ -34,6 +34,7 @@ class Replica:
         kwargs = {k: (ray_tpu.get(v) if isinstance(v, ObjectRef) else v)
                   for k, v in kwargs.items()}
         model_id = kwargs.pop("__serve_model_id", "")
+        from ray_tpu._private import events
         with self._lock:
             self._ongoing += 1
         try:
@@ -45,6 +46,11 @@ class Replica:
             import inspect
 
             from ray_tpu.serve import multiplex
+            # replica phase span: parents under this actor task's
+            # propagated trace context (set by the executing worker), so
+            # user-code time separates from arg-resolution time above
+            rspan = events.start_span("replica.call", category="serve",
+                                      method=method, ongoing=self._ongoing)
             if inspect.iscoroutinefunction(fn):
                 # we're on an executor thread; hop onto the worker loop —
                 # the model-id contextvar is set inside the coroutine so
@@ -56,12 +62,16 @@ class Replica:
                     finally:
                         multiplex._current_model_id.reset(tok)
                 from ray_tpu._private.worker import global_worker
-                return asyncio.run_coroutine_threadsafe(
-                    _call(), global_worker.core.loop).result()
+                try:
+                    return asyncio.run_coroutine_threadsafe(
+                        _call(), global_worker.core.loop).result()
+                finally:
+                    rspan.end()
             tok = multiplex._set_model_id(model_id)
             try:
                 return fn(*args, **kwargs)
             finally:
+                rspan.end()
                 multiplex._current_model_id.reset(tok)
         finally:
             with self._lock:
@@ -75,10 +85,17 @@ class Replica:
         (round-5; replaces the round-4 bespoke start_stream/stream_next
         polling. Reference: streaming DeploymentResponseGenerator over
         ObjectRefGenerator, serve/handle.py)."""
+        from ray_tpu._private import events
         from ray_tpu.serve import multiplex
         model_id = kwargs.pop("__serve_model_id", "")
         with self._lock:
             self._ongoing += 1
+        # the body's first resumption runs under the streaming task's
+        # trace context, so this span parents under the replica task —
+        # ended in the outer finally (which also runs on close())
+        sspan = events.start_span("replica.stream", category="serve",
+                                  method=method)
+        chunks = 0
         try:
             fn = self._callable if self._is_function \
                 else getattr(self._callable, method)
@@ -101,6 +118,7 @@ class Replica:
                         break
                     finally:
                         multiplex._current_model_id.reset(tok)
+                    chunks += 1
                     yield chunk
             finally:
                 # consumer walked away (GeneratorExit lands on the yield
@@ -115,6 +133,7 @@ class Replica:
                     except Exception:
                         pass
         finally:
+            sspan.end(chunks=chunks)
             with self._lock:
                 self._ongoing -= 1
 

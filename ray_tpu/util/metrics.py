@@ -27,6 +27,13 @@ def _ensure_pusher():
     t.start()
 
 
+def registry_snapshot() -> List[Dict]:
+    """Snapshot every registered metric (the push payload). Shared by
+    the 2s pusher and the worker's shutdown flush."""
+    with _registry_lock:
+        return [m._snapshot() for m in _registry.values()]
+
+
 def _push_loop():
     while True:
         time.sleep(2.0)
@@ -34,12 +41,13 @@ def _push_loop():
             import ray_tpu
             if not ray_tpu.is_initialized():
                 continue
-            with _registry_lock:
-                payload = [m._snapshot() for m in _registry.values()]
+            payload = registry_snapshot()
             if payload:
+                core = ray_tpu._get_worker().core
                 ray_tpu._get_worker().gcs_call(
                     "report_metrics",
-                    worker_id=ray_tpu._get_worker().core.worker_id,
+                    worker_id=core.worker_id,
+                    node_id=getattr(core, "node_id", None),
                     metrics=payload)
         except Exception:
             pass
@@ -128,6 +136,29 @@ class Histogram(Metric):
                                 for k in self._counts]}
 
 
+def _escape_label_value(value) -> str:
+    """Prometheus text-format label escaping: inside double quotes,
+    backslash, the quote itself and newlines must be escaped — a raw
+    tag value like 'us-central1\\n' would otherwise emit unparsable
+    exposition text (reference: prometheus text_format spec; the
+    reference escapes in its OpenCensus->Prometheus exporter)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP lines escape backslash and newline (no quotes involved)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _format_tags(key: Tuple) -> str:
+    """(k, v) pairs -> escaped label body. The formatter variable is
+    deliberately NOT named `v` — earlier revisions shadowed the
+    enclosing sample-value loop variable here, emitting the tag value
+    where the sample value belonged."""
+    return ",".join(f'{k}="{_escape_label_value(tv)}"' for k, tv in key)
+
+
 def render_prometheus(all_metrics: Dict[str, List[Dict]]) -> str:
     """GCS-aggregated {worker_id: [snapshots]} -> Prometheus text."""
     by_name: Dict[str, List[Dict]] = {}
@@ -138,7 +169,7 @@ def render_prometheus(all_metrics: Dict[str, List[Dict]]) -> str:
     for name, ms in sorted(by_name.items()):
         m0 = ms[0]
         if m0.get("help"):
-            out.append(f"# HELP {name} {m0['help']}")
+            out.append(f"# HELP {name} {_escape_help(m0['help'])}")
         out.append(f"# TYPE {name} {m0['type']}")
         if m0["type"] == "histogram":
             agg: Dict[Tuple, List] = {}
@@ -152,7 +183,7 @@ def render_prometheus(all_metrics: Dict[str, List[Dict]]) -> str:
                     else:
                         agg[key] = [list(counts), total]
             for key, (counts, total) in agg.items():
-                tag_s = ",".join(f'{k}="{v}"' for k, v in key)
+                tag_s = _format_tags(key)
                 cum = 0
                 for b, c in zip(m0["boundaries"], counts):
                     cum += c
@@ -172,7 +203,7 @@ def render_prometheus(all_metrics: Dict[str, List[Dict]]) -> str:
                     agg2[key] = agg2.get(key, 0.0) + v \
                         if m["type"] == "counter" else v
             for key, v in agg2.items():
-                tag_s = ",".join(f'{k}="{v2}"' for k, v2 in key)
+                tag_s = _format_tags(key)
                 brace = f"{{{tag_s}}}" if tag_s else ""
                 out.append(f"{name}{brace} {v}")
     return "\n".join(out) + "\n"

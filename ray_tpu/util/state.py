@@ -30,7 +30,38 @@ def list_placement_groups() -> List[Dict]:
 
 
 def list_tasks(limit: int = 1000, job_id: Optional[int] = None) -> List[Dict]:
-    return _w().gcs_call("list_task_events", limit=limit, job_id=job_id)
+    # task rows only: the flight recorder's runtime events share the GCS
+    # sink but are not tasks (see list_runtime_events)
+    return _w().gcs_call("list_task_events", limit=limit, job_id=job_id,
+                         kind="task")
+
+
+def list_runtime_events(limit: int = 1000,
+                        category: Optional[str] = None) -> List[Dict]:
+    """Flight-recorder rows (`ray_tpu/_private/events.py`): spans and
+    instants recorded inside tasks/daemons — engine step phases, object
+    store spill/restore/transfer, data stage/shuffle windows, serve
+    request phases. category filters by subsystem ("engine", "store",
+    "data", "serve")."""
+    return _w().gcs_call("list_task_events", limit=limit,
+                         kind="runtime_event", category=category)
+
+
+def summarize_runtime_events(limit: int = 10000) -> Dict[str, Dict]:
+    """{event_name: {count, total_ms}} over the retained window."""
+    out: Dict[str, Dict] = {}
+    for r in list_runtime_events(limit=limit):
+        times = r.get("state_times", {})
+        start = times.get("RUNNING")
+        end = times.get("FINISHED", start)
+        agg = out.setdefault(r.get("name", "?"),
+                             {"count": 0, "total_ms": 0.0})
+        agg["count"] += 1
+        if start is not None and end is not None:
+            agg["total_ms"] += max(0.0, (end - start) * 1e3)
+    for agg in out.values():
+        agg["total_ms"] = round(agg["total_ms"], 3)
+    return out
 
 
 def list_named_actors(namespace: Optional[str] = None) -> List[Dict]:

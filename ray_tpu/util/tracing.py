@@ -37,7 +37,13 @@ def _attr(key: str, value) -> Dict:
 
 def task_events_to_otlp(rows: List[Dict],
                         service_name: str = "ray_tpu") -> Dict:
-    """GCS task-event rows -> one OTLP/JSON ExportTraceServiceRequest."""
+    """GCS task-event rows -> one OTLP/JSON ExportTraceServiceRequest.
+
+    Both row kinds export: task rows become one span per execution;
+    flight-recorder rows (``kind == "runtime_event"``) become child
+    spans with their recorded parent links intact, so an engine-slot
+    span nests under its Serve request span in Jaeger/Tempo. Runtime
+    attrs ride as ``ray_tpu.attr.*`` attributes."""
     spans = []
     for row in rows:
         times = row.get("state_times", {})
@@ -47,6 +53,21 @@ def task_events_to_otlp(rows: List[Dict],
         end = times.get("FINISHED") or times.get("FAILED") or start
         end = max(end, start)
         failed = "FAILED" in times
+        runtime = row.get("kind") == "runtime_event"
+        attributes = [
+            _attr("ray_tpu.task_id", row.get("task_id")),
+            _attr("ray_tpu.type", row.get("type")),
+            _attr("ray_tpu.node_id", row.get("node_id")),
+            _attr("ray_tpu.worker_id", row.get("worker_id")),
+            _attr("ray_tpu.state", row.get("state")),
+        ]
+        if runtime:
+            attributes.append(_attr("ray_tpu.category",
+                                    row.get("category")))
+            attributes.append(_attr("ray_tpu.event_kind",
+                                    row.get("event_kind")))
+            for k, v in sorted((row.get("attrs") or {}).items()):
+                attributes.append(_attr(f"ray_tpu.attr.{k}", v))
         span = {
             "traceId": _hex_id(row.get("trace_id") or row.get("task_id"),
                                16),
@@ -55,13 +76,7 @@ def task_events_to_otlp(rows: List[Dict],
             "kind": 1,   # SPAN_KIND_INTERNAL
             "startTimeUnixNano": str(int(start * 1e9)),
             "endTimeUnixNano": str(int(end * 1e9)),
-            "attributes": [
-                _attr("ray_tpu.task_id", row.get("task_id")),
-                _attr("ray_tpu.type", row.get("type")),
-                _attr("ray_tpu.node_id", row.get("node_id")),
-                _attr("ray_tpu.worker_id", row.get("worker_id")),
-                _attr("ray_tpu.state", row.get("state")),
-            ],
+            "attributes": attributes,
             "status": {"code": 2 if failed else 1},
         }
         parent = row.get("parent_span_id")
@@ -78,6 +93,60 @@ def task_events_to_otlp(rows: List[Dict],
             }],
         }],
     }
+
+
+def task_events_to_chrome(rows: List[Dict]) -> List[Dict]:
+    """GCS task-event rows -> chrome://tracing / Perfetto event list.
+
+    Task rows keep the classic layout (pid = node, tid = worker).
+    Flight-recorder rows render as per-subsystem tracks (pid =
+    ``runtime:<category>``) so engine/store/data/serve phases line up
+    under the tasks that caused them; instants emit as ``ph: "i"``.
+    Events are sorted by ts and every duration event has dur >= 1us —
+    the output loads in either viewer without sanitizing."""
+    events: List[Dict] = []
+    for row in rows:
+        times = row.get("state_times", {})
+        start = times.get("RUNNING")
+        if start is None:
+            continue
+        end = times.get("FINISHED") or times.get("FAILED")
+        end = end if end and end >= start else start
+        runtime = row.get("kind") == "runtime_event"
+        args = {"task_id": row.get("task_id"), "state": row.get("state"),
+                "trace_id": row.get("trace_id"),
+                "span_id": row.get("span_id"),
+                "parent_span_id": row.get("parent_span_id")}
+        if runtime:
+            args.update(row.get("attrs") or {})
+            ev = {
+                "name": row.get("name", "event"),
+                "cat": row.get("category", "runtime"),
+                "pid": f"runtime:{row.get('category', 'runtime')}",
+                "tid": (row.get("worker_id") or "worker")[:8],
+                "ts": start * 1e6,
+                "args": args,
+            }
+            if row.get("event_kind") == "instant":
+                ev["ph"] = "i"
+                ev["s"] = "p"       # process-scoped instant marker
+            else:
+                ev["ph"] = "X"
+                ev["dur"] = max(1.0, (end - start) * 1e6)
+        else:
+            ev = {
+                "name": row.get("name", "task"),
+                "cat": row.get("type", "task"),
+                "ph": "X",
+                "ts": start * 1e6,
+                "dur": max(1.0, (end - start) * 1e6),
+                "pid": (row.get("node_id") or "node")[:8],
+                "tid": (row.get("worker_id") or "worker")[:8],
+                "args": args,
+            }
+        events.append(ev)
+    events.sort(key=lambda e: e["ts"])
+    return events
 
 
 def export_otlp(filename: Optional[str] = None,
