@@ -110,6 +110,20 @@ _flag("push_window_chunks", int, 4,
       "Chunks in flight per push stream: pipelines the wire without "
       "unbounded receiver buffering (reference: PushManager per-push "
       "in-flight cap, push_manager.h:30).")
+_flag("data_plane_enabled", bool, True,
+      "Advertise and use the raw-socket binary data plane for cross-node "
+      "object transfer (sender writes arena memoryviews, receiver "
+      "recv_into()s straight into store.create regions). Off = legacy "
+      "msgpack chunks on the control-plane RPC connection.")
+_flag("transfer_streams", int, 2,
+      "Parallel data-plane connections a large object push is striped "
+      "across (per-stripe contiguous offset ranges). More streams help "
+      "multi-core nodes overlap kernel copies; each stream keeps its own "
+      "push_window_chunks flow-control window.")
+_flag("transfer_stripe_min_bytes", int, 8 * 1024 * 1024,
+      "Minimum bytes per stripe before a push fans out across an "
+      "additional data-plane connection (small objects stay on one "
+      "stream; striping overhead would dominate).")
 _flag("pull_inflight_bytes", int, 256 * 1024 * 1024,
       "Admission budget for concurrent inbound object transfers on one "
       "node; pulls past it queue FIFO (reference: PullManager "

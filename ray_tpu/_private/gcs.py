@@ -315,13 +315,18 @@ class GcsServer:
     # ---------------------------------------------------------------- nodes
     def h_register_node(self, conn, node_id: str, address: str,
                         object_store_address: str, resources: Dict[str, float],
-                        labels: Dict[str, str], node_ip: str):
+                        labels: Dict[str, str], node_ip: str,
+                        data_plane_address: Optional[str] = None):
         conn.peer_info["node_id"] = node_id
         self.node_conns[node_id] = conn
         self.nodes[node_id] = {
             "node_id": node_id,
             "address": address,
             "object_store_address": object_store_address,
+            # raw-stream socket for bulk object chunks; None for nodes
+            # that predate (or disabled) the binary data plane — peers
+            # then fall back to msgpack chunks on `address`
+            "data_plane_address": data_plane_address,
             "node_ip": node_ip,
             "total": dict(resources),
             "available": dict(resources),
@@ -841,6 +846,7 @@ def _node_view(n: Dict) -> Dict:
             "alive": n["alive"], "draining": n["draining"],
             "address": n["address"],
             "object_store_address": n["object_store_address"],
+            "data_plane_address": n.get("data_plane_address"),
             "node_ip": n["node_ip"], "labels": n["labels"]}
 
 
@@ -848,6 +854,7 @@ def _node_public(n: Dict) -> Dict:
     out = {k: n[k] for k in ("node_id", "address", "object_store_address",
                              "node_ip", "total", "available", "labels",
                              "alive")}
+    out["data_plane_address"] = n.get("data_plane_address")
     out["pending_demand"] = n.get("pending_demand", [])
     return out
 

@@ -12,9 +12,15 @@ transfer against the shared-memory arena (object_store.py). Differences:
   executing worker pulls its own args through this daemon's pull_object).
 - Spillback is an explicit redirect reply carrying the chosen node's
   address (reference: spillback in local_task_manager.cc).
-- Object transfer is whole-object-chunked RPC between node managers; the
-  store arena is mapped by every local process so serving bytes is a
-  zero-copy read (reference: chunked gRPC Push/Pull, pull_manager.h:52).
+- Object transfer negotiates over control RPCs (request_push/push_begin)
+  but chunk bytes move on a dedicated binary data plane — a second raw
+  socket per node manager (data_plane.py) that streams pinned-arena
+  memoryviews into recv_into() regions, striped across
+  cfg.transfer_streams connections, with a msgpack-chunk fallback for
+  peers that advertise no data plane. The store arena is mapped by every
+  local process so serving bytes is a zero-copy read (reference: chunked
+  gRPC Push/Pull distinct from control RPCs, pull_manager.h:52,
+  push_manager.h:30).
 """
 
 from __future__ import annotations
@@ -30,7 +36,7 @@ from typing import Dict, List, Optional
 
 from ray_tpu._private import rpc, scheduling
 from ray_tpu._private.config import cfg
-from ray_tpu._private.object_store import ObjectStoreClient
+from ray_tpu._private.object_store import ObjectStoreClient, parallel_write
 
 logger = logging.getLogger(__name__)
 
@@ -116,6 +122,11 @@ class NodeManager:
         self.unix_address: Optional[str] = None
         self.store: Optional[ObjectStoreClient] = None
         self.pool = rpc.ConnectionPool(name=f"nm-{self.node_id[:8]}")
+        # binary data plane (data_plane.py): second raw-stream socket for
+        # bulk object chunks, advertised next to the RPC address
+        self.data_plane_address: Optional[str] = None
+        self._data_server = None
+        self._data_client = None
 
         self.workers: Dict[str, WorkerProc] = {}
         self._idle: List[WorkerProc] = []
@@ -163,6 +174,7 @@ class NodeManager:
             "request_push": self.h_request_push,
             "push_begin": self.h_push_begin,
             "push_chunk": self.h_push_chunk,
+            "push_abort": self.h_push_abort,
             "broadcast_object": self.h_broadcast_object,
             "restore_object": self.h_restore_object,
             "spill_now": self.h_spill_now,
@@ -180,6 +192,13 @@ class NodeManager:
         self.address = await self.server.listen_tcp("0.0.0.0", self.port)
         self.unix_address = await self.server.listen_unix(
             f"/tmp/raytpu/{self.session_name}/nm_{self.node_id[:12]}.sock")
+        if cfg.data_plane_enabled:
+            from ray_tpu._private.data_plane import (DataPlaneClient,
+                                                     DataPlaneServer)
+            self._data_server = DataPlaneServer(self)
+            self.data_plane_address = await self._data_server.start("0.0.0.0")
+            self._data_client = DataPlaneClient(
+                name=f"nm-{self.node_id[:8]}")
         self.gcs = await rpc.connect(
             self.gcs_address, handlers={
                 "create_actor": self.h_create_actor,
@@ -192,6 +211,7 @@ class NodeManager:
         resp = await self.gcs.call(
             "register_node", node_id=self.node_id, address=self.address,
             object_store_address=self.store_path,
+            data_plane_address=self.data_plane_address,
             resources=self.total, labels=self.labels,
             node_ip=rpc.node_ip_address())
         self.cluster_view = resp["cluster_view"]
@@ -250,6 +270,10 @@ class NodeManager:
         for w in self.workers.values():
             self._kill_proc(w)
         await self.server.close()
+        if self._data_server is not None:
+            await self._data_server.close()
+        if self._data_client is not None:
+            self._data_client.close()
         if self.gcs:
             await self.gcs.close()
         await self.pool.close()
@@ -335,6 +359,7 @@ class NodeManager:
                             "register_node", node_id=self.node_id,
                             address=self.address,
                             object_store_address=self.store_path,
+                            data_plane_address=self.data_plane_address,
                             resources=self.total, labels=self.labels,
                             node_ip=rpc.node_ip_address())
                         await conn.call("subscribe", channel="NODE")
@@ -415,18 +440,24 @@ class NodeManager:
             now = time.monotonic()
             for oid, rst in list(self._receiving.items()):
                 if now - rst["t"] > 60.0:
-                    self._receiving.pop(oid, None)
-                    try:
-                        self.store.abort(oid)
-                    except Exception:
-                        pass
+                    if rst.get("writers"):
+                        # a data-plane handler is parked inside a
+                        # recv_into on this object (half-open pusher):
+                        # never store.abort under an active writer — the
+                        # arena region could be re-allocated while stale
+                        # bytes still land in it. Close the feeding
+                        # sockets instead; the woken handler aborts.
+                        rst["aborted"] = True
+                        for s in list(rst.get("conns") or ()):
+                            try:
+                                s.close()
+                            except OSError:
+                                pass
+                        continue
                     # fail pulls parked on this receive so they retry
                     # immediately instead of waiting out their 300s cap
-                    done = self._recv_done.get(oid)
-                    if done is not None and not done.done():
-                        done.set_exception(RuntimeError(
-                            f"push of {oid.hex()[:16]} stalled >60s "
-                            "(pusher died?); receive aborted"))
+                    self._abort_receive(
+                        oid, "stalled >60s (pusher died?); receive aborted")
 
     async def _reap_children_loop(self):
         while True:
@@ -634,6 +665,7 @@ class NodeManager:
                     "available": payload["available"],
                     "alive": True, "address": payload["address"],
                     "object_store_address": payload["object_store_address"],
+                    "data_plane_address": payload.get("data_plane_address"),
                     "node_ip": payload["node_ip"],
                     "labels": payload.get("labels", {})}
                 self._wake_lease_waiters()
@@ -747,6 +779,16 @@ class NodeManager:
         return {"node_id": self.node_id}
 
     def _on_disconnect(self, conn: rpc.Connection):
+        # a pusher node that died mid-transfer drops its control
+        # connection: reap every receive it was feeding right away so
+        # parked pulls fail over to a surviving holder (the 60s idle
+        # sweep only backstops silent stalls)
+        for oid, st in list(self._receiving.items()):
+            if st.get("ctrl") is conn:
+                st["aborted"] = True
+                if not st.get("writers"):
+                    self._abort_receive(
+                        oid, "pusher control connection lost mid-stream")
         wid = conn.peer_info.get("worker_id")
         if wid is None:
             return
@@ -1315,7 +1357,13 @@ class NodeManager:
                              relay: Optional[List[str]] = None):
         """Holder side: stream `oid` to `to_node` with a bounded chunk
         window. `relay` rides along for tree broadcast — the receiver
-        re-broadcasts to its half of the target list after sealing."""
+        re-broadcasts to its half of the target list after sealing.
+
+        Control plane (`push_begin`) negotiates over the RPC connection;
+        chunk bytes move on the binary data plane when the peer
+        advertises one (striped across `cfg.transfer_streams` raw
+        connections), falling back to msgpack chunks on the RPC
+        connection for peers that predate the data-plane advertisement."""
         buf = self.store.get(oid)
         if buf is None and oid in self.spilled:
             await self.h_restore_object(conn, oid)
@@ -1324,6 +1372,8 @@ class NodeManager:
             raise RuntimeError(f"{oid.hex()[:16]} not on this node")
         try:
             addr = await self._node_addr(to_node)
+            view = self.cluster_view.get(to_node) or {}
+            dp_addr = view.get("data_plane_address")
             peer = await self.pool.get(addr)
             size = len(buf.data)
             status = await peer.call("push_begin", oid=oid, data_size=size,
@@ -1335,35 +1385,69 @@ class NodeManager:
                     f"{oid.hex()[:16]} ({size} bytes)")
             if status != "ok":
                 return True     # receiver already has it (or is receiving)
+            use_dp = (self._data_client is not None and dp_addr
+                      and cfg.data_plane_enabled and size > 0)
             from ray_tpu._private import events
-            with events.record_span("store.transfer", category="store",
-                                    object_id=oid.hex()[:16], bytes=size,
-                                    to_node=to_node[:12],
-                                    relay=len(relay or [])):
-                chunk = cfg.transfer_chunk_bytes
-                window = __import__("collections").deque()
-                off = 0
-
-                def _check(accepted):
-                    if accepted is False:
-                        raise RuntimeError(
-                            f"receiver {to_node[:12]} aborted transfer of "
-                            f"{oid.hex()[:16]} mid-stream")
-
-                while off < size:
-                    n = min(chunk, size - off)
-                    f = peer.call_start_nowait(
-                        "push_chunk", {"oid": oid, "offset": off,
-                                       "data": bytes(buf.data[off:off + n])})
-                    window.append(f)
-                    off += n
-                    if len(window) >= cfg.push_window_chunks:
-                        _check(await window.popleft())
-                for f in window:
-                    _check(await f)
+            from ray_tpu._private.data_plane import (DataPlaneError,
+                                                     DataPlaneUnavailable)
+            with events.record_span(
+                    "store.transfer", category="store",
+                    object_id=oid.hex()[:16], bytes=size,
+                    to_node=to_node[:12], relay=len(relay or [])) as span:
+                if use_dp:
+                    try:
+                        stripes = await self._data_client.push(
+                            dp_addr, oid, buf.data, size)
+                        span.set(path="data_plane", streams=len(stripes),
+                                 stripe_bytes=stripes)
+                        return True
+                    except DataPlaneUnavailable as e:
+                        # nothing moved; the negotiated receive state is
+                        # still clean — downgrade to the msgpack path
+                        logger.warning(
+                            "data plane to %s unavailable (%s); falling "
+                            "back to msgpack chunks", to_node[:12], e)
+                        use_dp = False
+                    except DataPlaneError:
+                        # half-delivered: tell the receiver to reap its
+                        # poisoned state NOW so parked pulls retry fast
+                        try:
+                            await peer.notify("push_abort", oid=oid)
+                        except (rpc.ConnectionLost, rpc.RpcError):
+                            pass
+                        raise
+                span.set(path="msgpack", streams=1, stripe_bytes=[size])
+                await self._push_msgpack(peer, oid, buf, size, to_node)
             return True
         finally:
             buf.close()
+
+    async def _push_msgpack(self, peer, oid: bytes, buf, size: int,
+                            to_node: str):
+        """Legacy chunk path: msgpack-framed chunks on the control-plane
+        RPC connection (kept as the negotiation fallback for peers that
+        advertise no data plane)."""
+        chunk = cfg.transfer_chunk_bytes
+        window = __import__("collections").deque()
+        off = 0
+
+        def _check(accepted):
+            if accepted is False:
+                raise RuntimeError(
+                    f"receiver {to_node[:12]} aborted transfer of "
+                    f"{oid.hex()[:16]} mid-stream")
+
+        while off < size:
+            n = min(chunk, size - off)
+            f = peer.call_start_nowait(
+                "push_chunk", {"oid": oid, "offset": off,
+                               "data": bytes(buf.data[off:off + n])})
+            window.append(f)
+            off += n
+            if len(window) >= cfg.push_window_chunks:
+                _check(await window.popleft())
+        for f in window:
+            _check(await f)
 
     def h_push_begin(self, conn, oid: bytes, data_size: int, meta: bytes,
                      relay: Optional[List[str]] = None):
@@ -1377,19 +1461,27 @@ class NodeManager:
             return "full"
         data, meta_view = bufs
         meta_view[:] = meta
+        # `ctrl` is the pusher's control connection: if the pusher node
+        # dies mid-stream, its disconnect reaps this receive immediately
+        # (the 60s idle sweep stays as the backstop for silent stalls)
         self._receiving[oid] = {"data": data, "remaining": data_size,
                                 "relay": list(relay or []),
-                                "t": time.monotonic()}
+                                "ctrl": conn, "t": time.monotonic()}
         if data_size == 0:
             self._finish_receive(oid)
         return "ok"
 
     def h_push_chunk(self, conn, oid: bytes, offset: int, data: bytes):
         st = self._receiving.get(oid)
-        if st is None:
+        if st is None or st.get("aborted"):
             return False
         st["t"] = time.monotonic()
-        st["data"][offset:offset + len(data)] = data
+        view = st["data"][offset:offset + len(data)]
+        # big chunks land through the GIL-free native copy pool
+        # (RAY_TPU_PUT_COPY_THREADS) instead of a GIL-held slice assign
+        if len(data) < (1 << 20) or not parallel_write(view,
+                                                       memoryview(data)):
+            view[:] = data
         st["remaining"] -= len(data)
         if st["remaining"] <= 0:
             # the LAST chunk's response resolves only after this node's
@@ -1397,6 +1489,30 @@ class NodeManager:
             # the whole tree, and a subtree failure surfaces at the root
             return self._finish_receive(oid)
         return True
+
+    def h_push_abort(self, conn, oid: bytes):
+        """Pusher-initiated abort (its data-plane stream died half-way):
+        reap the poisoned receive state so parked pulls retry at once."""
+        st = self._receiving.get(oid)
+        if st is None:
+            return True
+        st["aborted"] = True
+        if not st.get("writers"):
+            self._abort_receive(oid, "pusher aborted transfer mid-stream")
+        return True
+
+    def _abort_receive(self, oid: bytes, reason: str):
+        """Drop a half-received object: free its unsealed arena buffer
+        and fail pulls parked on it so they retry immediately."""
+        self._receiving.pop(oid, None)
+        try:
+            self.store.abort(oid)
+        except Exception:
+            pass
+        done = self._recv_done.get(oid)
+        if done is not None and not done.done():
+            done.set_exception(RuntimeError(
+                f"push of {oid.hex()[:16]} failed: {reason}"))
 
     def _finish_receive(self, oid: bytes):
         st = self._receiving.pop(oid)
@@ -1624,6 +1740,15 @@ class NodeManager:
             st = self.store.stats()
             info["store"] = {"bytes_in_use": st["bytes_in_use"],
                              "num_objects": st.get("num_objects")}
+        if self._data_server is not None:
+            info["data_plane"] = {
+                "address": self.data_plane_address,
+                "bytes_in": self._data_server.bytes_in,
+                "chunks_in": self._data_server.chunks_in,
+                "bytes_out": self._data_client.bytes_out,
+                "chunks_out": self._data_client.chunks_out,
+                "active_conns": self._data_server.active_conns,
+                "receiving": len(self._receiving)}
         return info
 
 

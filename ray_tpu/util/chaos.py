@@ -2,7 +2,13 @@
 python/ray/_private/test_utils.py:1433,1536 used by the chaos suites —
 kill random nodes during workloads and assert completion; RPC-level
 failure injection lives in _private/rpc.py behind
-RAY_TPU_TESTING_RPC_FAILURE)."""
+RAY_TPU_TESTING_RPC_FAILURE).
+
+The ``push_chunk`` spec key covers BOTH object-transfer transports: the
+legacy msgpack chunk RPCs and the binary data plane (data_plane.py runs
+the same injection hook before every raw chunk send, so
+``RAY_TPU_TESTING_RPC_FAILURE="push_chunk=0.05"`` keeps exercising
+mid-stream transfer aborts after the zero-copy path landed)."""
 
 from __future__ import annotations
 

@@ -1,0 +1,95 @@
+"""Cross-node object-transfer bandwidth probe (bench.py subprocess).
+
+Measures node-manager -> node-manager push throughput over loopback for
+a single large object, twice: once on the binary data plane and once on
+the legacy msgpack chunk path (RAY_TPU_DATA_PLANE_ENABLED=0 for the
+whole daemon tree — the toggle must be in the environment BEFORE the
+GCS spawns so its config snapshot propagates one consistent setting).
+The ratio is the bench entry's `vs_msgpack_path` ratchet.
+
+Usage: python transfer_probe.py --one '{"size_mb": 256, "runs": 3}'
+Prints one line: RESULT {json}
+"""
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _measure(size_mb: int, runs: int, data_plane: bool):
+    """One fresh two-node cluster; returns (rates_gb_per_s, info)."""
+    os.environ["RAY_TPU_DATA_PLANE_ENABLED"] = "1" if data_plane else "0"
+    import numpy as np
+
+    import ray_tpu
+    import ray_tpu.experimental
+    from ray_tpu.cluster_utils import Cluster
+
+    store = max(3 * size_mb, 256) * 1024 * 1024
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1,
+                                      "object_store_memory": store})
+    target = cluster.add_node(num_cpus=1, object_store_memory=store)
+    ray_tpu.init(address=cluster.address)
+    rates, info = [], {}
+    try:
+        cluster.wait_for_nodes()
+        import ray_tpu._private.worker as wm
+        blob = np.ones(size_mb * 1024 * 1024, dtype=np.uint8)
+        ref = ray_tpu.put(blob)
+        view = wm.global_worker.gcs_call("get_cluster_view")
+        head_view = view[cluster.nodes[0].node_id]
+        info["advertised_data_plane"] = bool(
+            head_view.get("data_plane_address"))
+        for rep in range(runs + 1):     # +1 warmup (connections, JIT)
+            t0 = time.perf_counter()
+            ray_tpu.experimental.broadcast_object(ref, [target.node_id])
+            dt = time.perf_counter() - t0
+            if rep:
+                rates.append(blob.nbytes / dt / 1e9)
+            # free the remote copy so the next rep re-transfers
+            wm.global_worker._run(wm.global_worker.core.node_conn.call(
+                "free_remote_object", oid=ref.id, node_id=target.node_id))
+            time.sleep(0.1)
+        tgt_info = wm.global_worker._run(wm.global_worker.core.pool.call(
+            view[target.node_id]["address"], "get_node_info"))
+        info["receiver_data_plane"] = tgt_info.get("data_plane")
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        os.environ.pop("RAY_TPU_DATA_PLANE_ENABLED", None)
+    return rates, info
+
+
+def run(spec):
+    size_mb = int(spec.get("size_mb", 256))
+    runs = int(spec.get("runs", 3))
+    dp_rates, dp_info = _measure(size_mb, runs, data_plane=True)
+    mp_rates, _mp_info = _measure(size_mb, runs, data_plane=False)
+    if not dp_rates or not mp_rates:
+        raise RuntimeError(f"no samples (dp={dp_rates}, mp={mp_rates})")
+    dp_rates.sort()
+    mp_rates.sort()
+    dp_med = dp_rates[len(dp_rates) // 2]
+    mp_med = mp_rates[len(mp_rates) // 2]
+    spread = (dp_rates[-1] - dp_rates[0]) / dp_med if dp_med else 0.0
+    recv = dp_info.get("receiver_data_plane") or {}
+    return {"transfer_gb_per_s": round(dp_med, 3),
+            "msgpack_gb_per_s": round(mp_med, 3),
+            "vs_msgpack_path": round(dp_med / mp_med, 3) if mp_med else 0.0,
+            "size_mb": size_mb,
+            "spread": round(spread, 3),
+            "runs": [round(r, 3) for r in dp_rates],
+            "msgpack_runs": [round(r, 3) for r in mp_rates],
+            "receiver_chunks_in": recv.get("chunks_in"),
+            "receiver_bytes_in": recv.get("bytes_in")}
+
+
+if __name__ == "__main__":
+    spec = json.loads(sys.argv[sys.argv.index("--one") + 1])
+    print("RESULT " + json.dumps(run(spec)), flush=True)

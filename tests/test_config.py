@@ -18,9 +18,21 @@ def test_defaults_and_registry():
     assert cfg.lease_idle_timeout_s == 1.0
     assert cfg.task_max_retries == 3
     assert cfg.transfer_chunk_bytes == 8 * 1024 * 1024
+    # binary data plane tunables (data_plane.py)
+    assert cfg.data_plane_enabled is True
+    assert cfg.transfer_streams >= 1
+    assert cfg.transfer_stripe_min_bytes > 0
     assert len(flags()) >= 20
     with pytest.raises(AttributeError):
         cfg.no_such_flag
+
+
+def test_data_plane_env_toggles(monkeypatch):
+    c = Config()
+    monkeypatch.setenv("RAY_TPU_DATA_PLANE_ENABLED", "0")
+    monkeypatch.setenv("RAY_TPU_TRANSFER_STREAMS", "4")
+    assert c.data_plane_enabled is False
+    assert c.transfer_streams == 4
 
 
 def test_env_override(monkeypatch):

@@ -63,6 +63,26 @@ def test_broadcast_object_tree(cluster):
         assert meta is not None and meta["data_size"] == blob.nbytes
 
 
+def test_transfers_rode_the_data_plane(cluster):
+    """The transfers the earlier tests performed moved their chunk bytes
+    on the binary data plane, not the control RPC connection: every node
+    advertises a data-plane address and the receivers' data-plane
+    counters account for at least one full object's bytes."""
+    c, workers = cluster
+    import ray_tpu._private.worker as wm
+    w = wm.global_worker
+    view = w.gcs_call("get_cluster_view")
+    assert all(v.get("data_plane_address") for v in view.values())
+    infos = [w._run(w.core.pool.call(v["address"], "get_node_info"))
+             for v in view.values()]
+    stats = [i.get("data_plane") for i in infos]
+    assert all(s is not None for s in stats)
+    # test_broadcast_object_tree alone pushed a 32 MB object to 3 nodes
+    assert sum(s["bytes_in"] for s in stats) >= 32_000_000
+    assert sum(s["chunks_in"] for s in stats) > 0
+    assert all(s["receiving"] == 0 for s in stats)
+
+
 def test_pull_admission_bounds_inflight(cluster):
     """With a tiny admission budget, many concurrent pulls of distinct
     objects still complete (queued, not deadlocked) and memory stays
