@@ -69,7 +69,8 @@ ray_tpu.shutdown()
 
 def _multi_client(snippet, n_clients=4, duration=5.0):
     """Reference's multi-client rows run N driver processes against one
-    cluster (release/perf_metrics microbenchmark multi_client_*)."""
+    cluster (release/perf_metrics microbenchmark multi_client_*).
+    Returns the per-client rates (one per process that reported)."""
     import subprocess
     import ray_tpu
     addr = ray_tpu.get_gcs_address()
@@ -77,21 +78,32 @@ def _multi_client(snippet, n_clients=4, duration=5.0):
         [sys.executable, "-c", snippet, addr, str(duration)],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
         for _ in range(n_clients)]
-    total = 0.0
+    rates = []
     for p in procs:
         out, _ = p.communicate(timeout=duration * 10 + 120)
         for line in (out or "").splitlines():
             if line.startswith("RATE "):
-                total += float(line.split()[1])
-    return total
+                rates.append(float(line.split()[1]))
+    return rates
 
 
 def bench_multi_client_tasks_async(ray_tpu, duration=5.0):
-    return _multi_client(_CLIENT_TASKS_SNIPPET, duration=duration)
+    return sum(_multi_client(_CLIENT_TASKS_SNIPPET, duration=duration))
 
 
 def bench_multi_client_put_bandwidth(ray_tpu, duration=5.0):
-    return _multi_client(_CLIENT_PUT_SNIPPET, duration=duration)
+    """Aggregate same-node put bandwidth of 4 concurrent clients, with
+    the per-client rates and their spread — a contention regression must
+    be attributable to a slow client, not averaged away (the striped
+    arena's whole point is that these clients no longer share a lock)."""
+    rates = _multi_client(_CLIENT_PUT_SNIPPET, duration=duration)
+    srt = sorted(rates)
+    med = srt[len(srt) // 2] if srt else 0.0
+    return {"value": sum(rates),
+            "per_client": [round(r, 3) for r in rates],
+            "client_spread": round((srt[-1] - srt[0]) / med, 3)
+            if med else 0.0,
+            "n_clients": len(rates)}
 
 V5E_PEAK_FLOPS = 197e12     # bf16
 MFU_BASELINE = 0.40         # BASELINE.json north star: >=40% MFU
@@ -635,7 +647,15 @@ def run_phase(phase: str):
     try:
         for key, fn in battery:
             try:
-                values[key] = fn(ray_tpu)
+                v = fn(ray_tpu)
+                if isinstance(v, dict):
+                    # rich result: headline under the metric key, the
+                    # rest (per_client, spread, ...) rides along for the
+                    # summarizer to attach to the artifact
+                    values[key] = v.pop("value")
+                    values[key + "__detail"] = v
+                else:
+                    values[key] = v
                 log(f"  {key}: {values[key]:.1f}")
             except Exception as e:
                 log(f"  {key} FAILED: {e}")
@@ -681,9 +701,15 @@ def _phase_in_subprocess(phase: str, reps: int = 3):
 
 def _summarize(series: dict) -> dict:
     """Per-metric median + relative spread ((max-min)/median) so the
-    artifact carries its own reproducibility evidence."""
+    artifact carries its own reproducibility evidence. ``<key>__detail``
+    entries (per-client rates etc.) attach to their metric's result from
+    the rep closest to the median."""
     results = {}
+    details = {k[:-len("__detail")]: v for k, v in series.items()
+               if k.endswith("__detail")}
     for key, vals in series.items():
+        if key.endswith("__detail"):
+            continue
         vals = sorted(v for v in vals if v > 0)
         if not vals:
             results[key] = {"value": 0.0, "vs_baseline": 0.0,
@@ -697,6 +723,11 @@ def _summarize(series: dict) -> dict:
                         "runs": [round(v, 2) for v in vals]}
         if key in BASELINES:
             results[key]["vs_baseline"] = round(med / BASELINES[key], 3)
+        det = [d for d in details.get(key, []) if d]
+        if det:
+            best = min(det, key=lambda d: abs(
+                sum(d.get("per_client", [])) - med))
+            results[key].update(best)
         log(f"{key}: median {med:.1f} spread {spread:.1%} "
             f"({results[key].get('vs_baseline', '-')}x)")
     return results
@@ -793,6 +824,16 @@ def main():
                 round(ann / a11, 3)
         putv = results["single_client_put_gb_per_s"]["value"]
         ceil = results.get("memcpy_ceiling_gb_per_s", {}).get("value")
+        mput = results.get("multi_client_put_gb_per_s", {}).get("value")
+        if ceil and mput:
+            # aggregate multi-client puts against THIS box's one-copy
+            # ceiling: the striped-arena ratchet (ROADMAP item 4)
+            results["multi_client_put_gb_per_s"]["vs_box_ceiling"] = \
+                round(mput / ceil, 3)
+        if putv and mput:
+            # >= 1.0 means N clients actually scale past one client
+            results["multi_client_put_gb_per_s"]["vs_single_client"] = \
+                round(mput / putv, 3)
         if ceil:
             results["single_client_put_gb_per_s"]["vs_box_ceiling"] = \
                 round(putv / ceil, 3)
@@ -807,7 +848,11 @@ def main():
         log(f"box ceilings: n:n/1:1 async = "
             f"{results['actor_calls_async_n_n_per_s'].get('vs_box_ceiling')}"
             f", put/memcpy = "
-            f"{results['single_client_put_gb_per_s'].get('vs_box_ceiling')}")
+            f"{results['single_client_put_gb_per_s'].get('vs_box_ceiling')}"
+            f", multi_put/memcpy = "
+            f"{results.get('multi_client_put_gb_per_s', {}).get('vs_box_ceiling')}"
+            f" (vs_single "
+            f"{results.get('multi_client_put_gb_per_s', {}).get('vs_single_client')})")
         log(f"put_efficiency: "
             f"{results.get('put_efficiency', {}).get('value')}")
     except (KeyError, TypeError) as e:

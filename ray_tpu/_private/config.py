@@ -176,6 +176,16 @@ _flag("health_check_interval_s", float, 0.5,
 # Object store
 _flag("object_store_memory", int, 0,
       "Default per-node object store arena size in bytes (0 = auto).")
+_flag("arena_stripes", int, 0,
+      "Number of independently locked sub-heaps the shared-memory arena "
+      "is striped into (0 = auto: RAY_TPU_ARENA_STRIPES env, else "
+      "size/128MiB capped at 8). More stripes let more same-node clients "
+      "put in parallel; the largest single object must fit one stripe.")
+_flag("spill_probe_interval_puts", int, 32,
+      "How many puts a worker may do between refreshes of its cached "
+      "store-usage snapshot for the spill-pressure check (the probe also "
+      "refreshes immediately on MemoryError; between refreshes the worker "
+      "accounts its own put bytes locally).")
 _flag("memory_monitor_interval_s", float, 1.0,
       "Period of the per-node worker memory monitor (0 disables).")
 _flag("memory_usage_threshold", float, 0.95,

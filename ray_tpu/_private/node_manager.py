@@ -159,7 +159,8 @@ class NodeManager:
     # -------------------------------------------------------------- lifecycle
     async def start(self) -> str:
         self.store = ObjectStoreClient(self.store_path, create=True,
-                                       size=self.store_bytes)
+                                       size=self.store_bytes,
+                                       stripes=cfg.arena_stripes)
         handlers = {
             "register_worker": self.h_register_worker,
             "request_lease": self.h_request_lease,
@@ -1567,10 +1568,14 @@ class NodeManager:
 
     # --------------------------------------------------------------- spilling
     async def _spill_loop(self):
-        """Spill LRU sealed objects to disk under memory pressure
-        (reference: LocalObjectManager spill through IO workers,
-        src/ray/raylet/local_object_manager.h:110; here the daemon itself
-        writes — the store is directly mapped, a read is a memcpy)."""
+        """The node-manager arena sweep: spill LRU sealed objects to disk
+        under memory pressure (reference: LocalObjectManager spill through
+        IO workers, src/ray/raylet/local_object_manager.h:110; here the
+        daemon itself writes — the store is directly mapped, a read is a
+        memcpy) and reap orphaned never-sealed creations. Pressure is
+        tracked PER STRIPE via the lock-free stripe snapshots, so one hot
+        stripe gets relieved before client creates are forced into inline
+        eviction — the sweep contends only with that stripe's clients."""
         loop = asyncio.get_event_loop()
         while True:
             await asyncio.sleep(cfg.spill_check_interval_s)
@@ -1581,6 +1586,8 @@ class NodeManager:
                 await loop.run_in_executor(
                     None, self._spill_pass,
                     cfg.spill_high_watermark, cfg.spill_low_watermark)
+                await loop.run_in_executor(
+                    None, self.store.gc_unsealed)
             except Exception:
                 logger.exception("spill iteration failed")
 
@@ -1597,59 +1604,84 @@ class NodeManager:
         import os as _os
         st = self.store.stats()
         cap = st["capacity"] or 1
-        if st["bytes_in_use"] < trigger_frac * cap:
-            return 0
-        if self._spill_remote:
-            from ray_tpu.util import storage as _storage
+        nstripes = int(st.get("num_stripes") or 1)
+        # Per-stripe accounting (lock-free snapshots): a single full
+        # stripe must be relieved even while aggregate usage looks
+        # healthy, or its clients' creates degrade into inline eviction.
+        global_hot = st["bytes_in_use"] >= trigger_frac * cap
+        if global_hot:
+            pressured = list(range(nstripes))
         else:
+            pressured = []
+            for i in range(nstripes):
+                ss = self.store.stripe_stats(i)
+                if ss["bytes_in_use"] >= trigger_frac * (ss["capacity"] or 1):
+                    pressured.append(i)
+        if not pressured:
+            return 0
+        if not self._spill_remote:
             _os.makedirs(self.spill_dir, exist_ok=True)
         n = 0
         spilled_bytes = 0
         t0 = time.time()
-        for oid in self.store.list_objects():
-            if oid in self.spilled:
-                # already on disk (a restored copy) — just drop the resident
-                # copy; the native store defers the delete if clients pin it
-                self.store.delete(oid)
+        for si in pressured:
+            for oid in self.store.list_stripe(si):
+                freed = self._spill_one(oid, _os)
+                if freed is None:
+                    continue
                 n += 1
+                spilled_bytes += freed
+                ss = self.store.stripe_stats(si)
+                if ss["bytes_in_use"] < target_frac * (ss["capacity"] or 1):
+                    break
+            if global_hot:
                 st = self.store.stats()
                 if st["bytes_in_use"] < target_frac * cap:
                     break
-                continue
-            buf = self.store.get(oid)
-            if buf is None:
-                continue
-            try:
-                meta = bytes(buf.metadata)
-                spilled_bytes += len(buf.data) + len(meta)
-                if self._spill_remote:
-                    path = _storage.join(self.spill_dir, oid.hex())
-                    _storage.write_bytes(
-                        path, len(meta).to_bytes(8, "little") + meta
-                        + bytes(buf.data))
-                else:
-                    path = _os.path.join(self.spill_dir, oid.hex())
-                    with open(path, "wb") as f:
-                        f.write(len(meta).to_bytes(8, "little"))
-                        f.write(meta)
-                        f.write(buf.data)
-            finally:
-                buf.close()
-            self.spilled[oid] = path
-            self.store.delete(oid)
-            n += 1
-            st = self.store.stats()
-            if st["bytes_in_use"] < target_frac * cap:
-                break
         if n:
             # the span is recorded only for passes that moved something
             # — the 1s poll's no-op passes would be pure timeline noise
             from ray_tpu._private import events
+            st = self.store.stats()
             events.record_complete(
                 "store.spill", t0, time.time(), category="store",
                 objects=n, bytes=spilled_bytes,
-                bytes_in_use=st["bytes_in_use"], capacity=cap)
+                bytes_in_use=st["bytes_in_use"], capacity=cap,
+                stripes=len(pressured))
         return n
+
+    def _spill_one(self, oid: bytes, _os) -> Optional[int]:
+        """Spill one sealed object (or drop the resident copy of an
+        already-spilled one). Returns bytes newly written to disk, or
+        None if the object was skipped."""
+        if oid in self.spilled:
+            # already on disk (a restored copy) — just drop the resident
+            # copy; the native store defers the delete if clients pin it
+            self.store.delete(oid)
+            return 0
+        buf = self.store.get(oid)
+        if buf is None:
+            return None
+        try:
+            meta = bytes(buf.metadata)
+            nbytes = len(buf.data) + len(meta)
+            if self._spill_remote:
+                from ray_tpu.util import storage as _storage
+                path = _storage.join(self.spill_dir, oid.hex())
+                _storage.write_bytes(
+                    path, len(meta).to_bytes(8, "little") + meta
+                    + bytes(buf.data))
+            else:
+                path = _os.path.join(self.spill_dir, oid.hex())
+                with open(path, "wb") as f:
+                    f.write(len(meta).to_bytes(8, "little"))
+                    f.write(meta)
+                    f.write(buf.data)
+        finally:
+            buf.close()
+        self.spilled[oid] = path
+        self.store.delete(oid)
+        return nbytes
 
     async def h_spill_now(self, conn):
         """Spill under client-side memory pressure: a worker about to

@@ -30,7 +30,8 @@ class _Lib:
         if cls._instance is None:
             lib = ctypes.CDLL(build("shm_store"))
             lib.rt_store_create.restype = ctypes.c_void_p
-            lib.rt_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+            lib.rt_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                            ctypes.c_int]
             lib.rt_store_open.restype = ctypes.c_void_p
             lib.rt_store_open.argtypes = [ctypes.c_char_p]
             lib.rt_store_close.argtypes = [ctypes.c_void_p]
@@ -57,11 +58,23 @@ class _Lib:
             ]
             lib.rt_evict.restype = ctypes.c_uint64
             lib.rt_evict.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.rt_evict_stripe.restype = ctypes.c_uint64
+            lib.rt_evict_stripe.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64]
             lib.rt_gc_unsealed.restype = ctypes.c_uint64
             lib.rt_gc_unsealed.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
             lib.rt_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+            lib.rt_stripe_stats.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_uint64)]
+            lib.rt_num_stripes.restype = ctypes.c_uint32
+            lib.rt_num_stripes.argtypes = [ctypes.c_void_p]
             lib.rt_list.restype = ctypes.c_uint64
             lib.rt_list.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+            lib.rt_list_stripe.restype = ctypes.c_uint64
+            lib.rt_list_stripe.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32, ctypes.c_char_p,
+                ctypes.c_uint64]
             lib.rt_write_parallel.restype = None
             lib.rt_write_parallel.argtypes = [
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
@@ -176,14 +189,21 @@ class SharedBuffer:
 
 
 class ObjectStoreClient:
-    """Maps the node's shared arena and exposes object operations."""
+    """Maps the node's shared arena and exposes object operations.
+
+    The arena is striped into independently locked sub-heaps (see
+    shm_store.cpp): ``stripes=0`` resolves via ``RAY_TPU_ARENA_STRIPES``
+    then size-based auto-striping, so small test arenas stay
+    single-stripe while production arenas spread same-node clients
+    across locks.
+    """
 
     def __init__(self, path: str, create: bool = False,
-                 size: int = DEFAULT_STORE_BYTES):
+                 size: int = DEFAULT_STORE_BYTES, stripes: int = 0):
         self._lib = _Lib().lib
         self.path = path
         if create:
-            self._h = self._lib.rt_store_create(path.encode(), size)
+            self._h = self._lib.rt_store_create(path.encode(), size, stripes)
         else:
             self._h = self._lib.rt_store_open(path.encode())
         if not self._h:
@@ -278,6 +298,11 @@ class ObjectStoreClient:
     def evict(self, nbytes: int) -> int:
         return self._lib.rt_evict(self._handle(), nbytes)
 
+    def evict_stripe(self, stripe: int, nbytes: int) -> int:
+        """Evict up to nbytes from ONE stripe (node-manager sweep path;
+        contends only with that stripe's clients)."""
+        return self._lib.rt_evict_stripe(self._handle(), stripe, nbytes)
+
     def gc_unsealed(self, max_age_sec: int = 300) -> int:
         """Reclaim orphaned never-sealed objects (writer died before seal)."""
         return self._lib.rt_gc_unsealed(self._handle(), max_age_sec)
@@ -300,16 +325,39 @@ class ObjectStoreClient:
         return True
 
     def stats(self) -> dict:
-        arr = (ctypes.c_uint64 * 9)()
+        """Aggregate store stats. Lock-free on the native side (seqlock
+        snapshots per stripe) — polling this never queues behind a
+        client's create."""
+        arr = (ctypes.c_uint64 * 13)()
         self._lib.rt_stats(self._handle(), arr)
         keys = ["bytes_in_use", "capacity", "num_objects", "num_evictions",
                 "bytes_evicted", "create_count", "get_hits", "get_misses",
-                "poisoned"]
+                "poisoned", "num_stripes", "stripe_repairs",
+                "create_fallbacks", "seal_count"]
+        return dict(zip(keys, arr))
+
+    def num_stripes(self) -> int:
+        return int(self._lib.rt_num_stripes(self._handle()))
+
+    def stripe_stats(self, stripe: int) -> dict:
+        """Lock-free per-stripe snapshot (sweep targeting, bench
+        attribution)."""
+        arr = (ctypes.c_uint64 * 8)()
+        self._lib.rt_stripe_stats(self._handle(), stripe, arr)
+        keys = ["bytes_in_use", "capacity", "num_objects", "num_evictions",
+                "bytes_evicted", "repairs", "poisoned", "seal_count"]
         return dict(zip(keys, arr))
 
     def list_objects(self, max_n: int = 65536) -> list:
         buf = ctypes.create_string_buffer(max_n * ID_LEN)
         n = self._lib.rt_list(self._handle(), buf, max_n)
+        raw = buf.raw
+        return [raw[i * ID_LEN:(i + 1) * ID_LEN] for i in range(n)]
+
+    def list_stripe(self, stripe: int, max_n: int = 65536) -> list:
+        """Sealed object ids resident in one stripe."""
+        buf = ctypes.create_string_buffer(max_n * ID_LEN)
+        n = self._lib.rt_list_stripe(self._handle(), stripe, buf, max_n)
         raw = buf.raw
         return [raw[i * ID_LEN:(i + 1) * ID_LEN] for i in range(n)]
 

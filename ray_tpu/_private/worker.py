@@ -173,6 +173,16 @@ class CoreWorker:
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self.address: Optional[str] = None
 
+        # Amortized spill-pressure probe state: capacity is cached at
+        # attach, bytes_in_use is refreshed every spill_probe_interval_puts
+        # puts (or on MemoryError); between refreshes this worker accounts
+        # its own put bytes locally so a burst of large puts still trips
+        # the check. Avoids a per-put cross-process store.stats() call.
+        self._spill_capacity: Optional[int] = None
+        self._spill_bytes_in_use = 0
+        self._spill_local_bytes = 0
+        self._spill_probe_left = 0
+
         self.gcs: Optional[rpc.Connection] = None
         self.node_conn: Optional[rpc.Connection] = None
         self.pool = rpc.ConnectionPool(name=f"w-{self.worker_id[:8]}")
@@ -583,17 +593,36 @@ class CoreWorker:
         self._store_serialized(oid, s)
         return ObjectRef(oid, self.address)
 
+    def _refresh_spill_probe(self) -> None:
+        """Re-read store usage for the spill-pressure check (the native
+        read is a lock-free seqlock snapshot, but even the ctypes hop is
+        too much per put — so it runs every N puts, not every put)."""
+        st = self.store.stats()
+        self._spill_capacity = st["capacity"]
+        self._spill_bytes_in_use = st["bytes_in_use"]
+        self._spill_local_bytes = 0
+        self._spill_probe_left = cfg.spill_probe_interval_puts
+
     def _needs_spill(self, s: serialization.SerializedObject) -> bool:
         """Under memory pressure, spill sealed objects to disk before this
         create LRU-evicts them irrecoverably (reference: plasma creates
-        wait on spilling, create_request_queue.h)."""
+        wait on spilling, create_request_queue.h). The probe is amortized:
+        capacity is cached at first use and bytes_in_use refreshed every
+        spill_probe_interval_puts puts, with this worker's own put bytes
+        accounted locally in between."""
         if s.is_inline() or self.store is None or self.node_conn is None:
             return False
         try:
-            st = self.store.stats()
-            cap = st["capacity"]
-            return bool(cap) and \
-                st["bytes_in_use"] + s.data_size() > 0.7 * cap
+            size = s.data_size()
+            cap = self._spill_capacity
+            if cap is None or self._spill_probe_left <= 0 or \
+                    self._spill_local_bytes > 0.1 * (cap or 1):
+                self._refresh_spill_probe()
+                cap = self._spill_capacity
+            self._spill_probe_left -= 1
+            self._spill_local_bytes += size
+            est = self._spill_bytes_in_use + self._spill_local_bytes
+            return bool(cap) and est + size > 0.7 * cap
         except Exception:
             return False
 
@@ -630,6 +659,11 @@ class CoreWorker:
         try:
             return self.store.create(oid, data_size, meta_size)
         except MemoryError:
+            # arena full: the cached pressure snapshot is clearly stale
+            try:
+                self._refresh_spill_probe()
+            except Exception:
+                pass
             if self.node_conn is not None:
                 try:
                     if threading.get_ident() == self._loop_thread_ident:

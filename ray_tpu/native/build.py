@@ -50,10 +50,13 @@ def build(name: str) -> str:
 # CI story, .bazelrc:104-125): each entry is a main() program compiled
 # WITH the component sources under -fsanitize and run as a subprocess by
 # tests/test_sanitizers.py. The suite runs asan+ubsan plus a
-# sanitize="thread" build of the shm store's concurrent sections (the
-# off-loop put path: allocator + rt_write_parallel copy pool). tsan runs
-# single-process multi-thread only — the cross-process robust-mutex
-# recovery path is beyond its model.
+# sanitize="thread" build of the shm store's concurrent sections: the
+# off-loop put path (per-stripe allocator + rt_write_parallel copy pool)
+# and the lock-striped arena's racy surfaces — lock-free seal CAS,
+# seqlock stats reads, and concurrent create/seal/get/evict across >=4
+# stripes. tsan runs single-process multi-thread only — the
+# cross-process robust-mutex EOWNERDEAD repair path is exercised by the
+# asan harness via a re-exec'd crash child.
 _SELFTESTS = {
     "shm_store_selftest": ["shm_store_selftest.cpp", "shm_store.cpp"],
     "mutable_channel_selftest": ["mutable_channel_selftest.cpp",

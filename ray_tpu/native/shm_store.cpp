@@ -1,33 +1,67 @@
-// shm_store.cpp — node-local shared-memory object store.
+// shm_store.cpp — node-local shared-memory object store (lock-striped).
 //
 // TPU-native re-design of the reference's plasma store
 // (reference: src/ray/object_manager/plasma/store.cc, plasma_allocator.h).
 // Unlike plasma (a store *server* that clients reach over a unix socket with
 // fd-passing), the entire store — allocator, object table, LRU — lives in one
 // file-backed shared-memory arena that every process on the node maps at a
-// known path. create/seal/get/release are direct shared-memory operations
-// under a robust process-shared mutex: no socket round trip, no fd passing.
-// The node daemon only coordinates eviction-to-remote and cross-node transfer.
+// known path. create/seal/get/release are direct shared-memory operations:
+// no socket round trip, no fd passing. The node daemon only coordinates
+// spill-to-disk, eviction sweeps and cross-node transfer.
+//
+// Concurrency model (v2): the arena is striped into independently locked
+// sub-heaps so N same-node clients putting in parallel never rendezvous on
+// one mutex:
+//
+//   - Each stripe owns a contiguous heap slice AND a contiguous segment of
+//     the object table, protected by its own robust process-shared mutex.
+//     An object's entry and its payload always live in the SAME stripe, so
+//     crash repair of one stripe never chases pointers into another.
+//   - Allocation hashes the object id to a home stripe; when the home heap
+//     is full the create falls back round-robin to the next stripe (the
+//     object is re-homed there entirely). The sealed-put fast path
+//     (create + copy + seal) takes exactly ONE stripe lock: the create.
+//   - rt_seal is a lock-free atomic entry-state transition
+//     (CREATED -> SEALED via CAS); the payload copy between create and
+//     seal never held a lock to begin with.
+//   - rt_stats / rt_stripe_stats read a seqlock-style per-stripe snapshot
+//     (lockseq is odd while a locked mutation is open) and acquire a mutex
+//     only if a writer appears stuck — which doubles as the robust-mutex
+//     recovery probe for holders that died mid-mutation.
+//   - LRU is a per-entry sequence stamp, not a linked list: eviction scans
+//     the stripe's table segment and frees lowest-seq sealed unpinned
+//     entries. Sweeps are driven by the node manager per stripe; the
+//     in-create eviction fallback only ever locks the one stripe it is
+//     allocating from, so one client's arena pressure cannot stall every
+//     other client's create.
+//   - A client killed while holding a stripe mutex poisons only that
+//     stripe: the next locker gets EOWNERDEAD, marks the mutex consistent
+//     and rebuilds the stripe (table segment + heap reset; resident objects
+//     there are lost, equivalent to eviction). The other stripes keep
+//     serving throughout.
 //
 // Layout:
-//   [Header | ObjectTable (open-addressed) | data arena (boundary-tag heap)]
+//   [Header incl. Stripe[] | ObjectTable (segmented) | striped data arena]
 //
 // Object lifecycle: CREATED (writer owns buffer) -> SEALED (immutable,
 // readable by all) -> deleted (deferred until pin_count drops to zero).
-// Eviction: LRU over sealed, unpinned, evictable objects.
 
+#include <algorithm>
 #include <atomic>
 #include <cerrno>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <mutex>
 #include <thread>
+#include <utility>
 #include <vector>
 
 #include <fcntl.h>
 #include <pthread.h>
+#include <signal.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <time.h>
@@ -36,59 +70,100 @@
 namespace {
 
 constexpr uint64_t kMagic = 0x5250555453544f52ULL;  // "RPUTSTOR"
-constexpr uint32_t kVersion = 1;
+constexpr uint32_t kVersion = 2;
 constexpr uint32_t kIdLen = 20;
-constexpr uint32_t kTableCapacity = 1 << 16;  // 65536 entries, power of two
+constexpr uint32_t kTableCapacity = 1 << 16;  // 65536 entries total
 constexpr uint64_t kAlign = 64;
 constexpr uint32_t kNil = 0xffffffffu;
+constexpr uint32_t kMaxStripes = 16;
+// Auto-striping floor: a stripe must comfortably hold the largest common
+// object (64 MiB bench blobs, multi-MB KV blocks) with room to recycle.
+constexpr uint64_t kMinStripeBytes = 128ULL << 20;
 
 // Object states.
 enum : uint32_t { kEmpty = 0, kCreated = 1, kSealed = 2, kTombstone = 3 };
 
+// --------------------------------------------------------------- atomics
+// Shared-memory fields are plain integers accessed through __atomic
+// builtins (std::atomic members are not guaranteed address-free across
+// processes by the standard; the builtins are, on this ABI, and tsan
+// models them).
+inline uint32_t ld32(const uint32_t* p, int mo = __ATOMIC_ACQUIRE) {
+  return __atomic_load_n(p, mo);
+}
+inline uint64_t ld64(const uint64_t* p, int mo = __ATOMIC_ACQUIRE) {
+  return __atomic_load_n(p, mo);
+}
+inline void st32(uint32_t* p, uint32_t v, int mo = __ATOMIC_RELEASE) {
+  __atomic_store_n(p, v, mo);
+}
+inline void st64(uint64_t* p, uint64_t v, int mo = __ATOMIC_RELEASE) {
+  __atomic_store_n(p, v, mo);
+}
+inline uint64_t add64(uint64_t* p, uint64_t v, int mo = __ATOMIC_ACQ_REL) {
+  return __atomic_fetch_add(p, v, mo);
+}
+inline bool cas32(uint32_t* p, uint32_t expected, uint32_t desired) {
+  return __atomic_compare_exchange_n(p, &expected, desired, false,
+                                     __ATOMIC_ACQ_REL, __ATOMIC_ACQUIRE);
+}
+
 struct Entry {
   uint8_t id[kIdLen];
-  uint32_t state;
-  uint64_t offset;     // offset of payload (data then metadata) from arena base
+  uint32_t state;      // atomic; publishes the entry (release on CREATED)
+  uint32_t stripe;     // owning stripe == segment holding this slot
+  uint64_t offset;     // payload offset, relative to the stripe's heap base
   uint64_t data_size;
   uint64_t meta_size;
-  uint32_t pin_count;
+  uint32_t pin_count;  // mutated under the stripe lock (seal resets it
+                       // lock-free BEFORE the SEALED transition publishes)
   uint32_t flags;      // bit0: delete-pending, bit1: not-evictable
-  uint64_t seq;        // LRU clock value at last touch
+  uint64_t seq;        // LRU stamp (stripe lru_clock value at last touch)
   uint64_t ctime_sec;  // CLOCK_MONOTONIC seconds at creation
-  uint32_t lru_prev, lru_next;  // doubly-linked LRU list (entry indices)
+};
+
+struct alignas(64) Stripe {
+  pthread_mutex_t mutex;     // robust, process-shared
+  uint32_t mutating;         // a locked mutation is in progress
+  uint32_t poisoned;         // set transiently when a holder died mid-mutation
+  uint64_t lockseq;          // seqlock: odd while a locked section is open
+  uint64_t arena_off;        // base-relative start of this stripe's heap
+  uint64_t arena_size;
+  uint64_t free_head;        // stripe-relative offset of first free block
+  uint64_t bytes_in_use;     // allocated bytes incl. block headers
+  uint64_t num_objects;
+  uint64_t lru_clock;        // atomic (lock-free seal stamps through it)
+  uint64_t num_evictions;
+  uint64_t bytes_evicted;
+  uint64_t create_count;
+  uint64_t seal_count;       // atomic (lock-free seal)
+  uint64_t get_hits;
+  uint64_t get_misses;       // atomic (lock-free miss path)
+  uint64_t repairs;          // robust-mutex crash repairs of this stripe
+  uint32_t seg_start, seg_len;  // entry-table segment [start, start+len)
 };
 
 struct Header {
   uint64_t magic;
   uint32_t version;
   uint32_t table_capacity;
-  uint64_t total_size;      // whole mapping size
-  uint64_t arena_offset;    // start of heap area
-  uint64_t arena_size;
-  pthread_mutex_t mutex;
-  // heap state
-  uint64_t free_head;       // offset of first free block (arena-relative), or ~0
-  uint64_t bytes_in_use;    // allocated payload bytes (incl. block headers)
-  uint64_t num_objects;
-  uint64_t lru_clock;
-  uint32_t lru_head, lru_tail;  // head = most recent
-  uint64_t num_evictions;
-  uint64_t bytes_evicted;
-  uint64_t create_count;
-  uint64_t seal_count;
-  uint64_t get_hits;
-  uint64_t get_misses;
-  uint32_t mutating;   // a mutation is in progress under the lock
-  uint32_t poisoned;   // a lock holder died mid-mutation; store is suspect
+  uint64_t total_size;       // whole mapping size
+  uint64_t arena_offset;     // start of heap area (base-relative)
+  uint64_t arena_size;       // raw heap area size (>= sum of stripe slices)
+  uint32_t num_stripes;
+  uint32_t _pad0;
+  uint64_t fallback_count;   // atomic: creates re-homed off their hash stripe
+  Stripe stripes[kMaxStripes];
 };
 
-// Boundary-tag heap block. Located in the arena. Size includes the header.
+// Boundary-tag heap block, located in a stripe's heap slice. Offsets in
+// the free list are stripe-relative. Size includes the header.
 struct Block {
   uint64_t size;       // total block size incl. header; low bit = free flag
   uint64_t prev_size;  // size of physically-previous block (0 if first)
   // free blocks only:
-  uint64_t next_free;  // arena offset or ~0
-  uint64_t prev_free;  // arena offset or ~0
+  uint64_t next_free;  // stripe-relative offset or ~0
+  uint64_t prev_free;  // stripe-relative offset or ~0
 };
 
 constexpr uint64_t kBlockHeader = 16;  // size + prev_size (used blocks)
@@ -98,7 +173,6 @@ constexpr uint64_t kNone = ~0ULL;
 struct Store {
   Header* hdr;
   uint8_t* base;     // mapping base
-  uint8_t* arena;    // heap base
   Entry* table;
   uint64_t map_size;
   int fd;
@@ -109,64 +183,65 @@ inline bool blk_free(Block* b) { return b->size & 1; }
 inline uint64_t blk_size(Block* b) { return b->size & ~1ULL; }
 inline void set_size(Block* b, uint64_t s, bool f) { b->size = s | (f ? 1 : 0); }
 
-inline Block* at(Store* s, uint64_t off) {
-  return reinterpret_cast<Block*>(s->arena + off);
+inline Block* at(Store* s, Stripe* sp, uint64_t off) {
+  return reinterpret_cast<Block*>(s->base + sp->arena_off + off);
 }
-inline uint64_t off_of(Store* s, Block* b) {
-  return reinterpret_cast<uint8_t*>(b) - s->arena;
+inline uint64_t off_of(Store* s, Stripe* sp, Block* b) {
+  return reinterpret_cast<uint8_t*>(b) - (s->base + sp->arena_off);
 }
 
-void free_list_push(Store* s, Block* b) {
-  uint64_t off = off_of(s, b);
-  b->next_free = s->hdr->free_head;
+void free_list_push(Store* s, Stripe* sp, Block* b) {
+  uint64_t off = off_of(s, sp, b);
+  b->next_free = sp->free_head;
   b->prev_free = kNone;
-  if (s->hdr->free_head != kNone) at(s, s->hdr->free_head)->prev_free = off;
-  s->hdr->free_head = off;
+  if (sp->free_head != kNone) at(s, sp, sp->free_head)->prev_free = off;
+  sp->free_head = off;
 }
 
-void free_list_remove(Store* s, Block* b) {
+void free_list_remove(Store* s, Stripe* sp, Block* b) {
   if (b->prev_free != kNone)
-    at(s, b->prev_free)->next_free = b->next_free;
+    at(s, sp, b->prev_free)->next_free = b->next_free;
   else
-    s->hdr->free_head = b->next_free;
-  if (b->next_free != kNone) at(s, b->next_free)->prev_free = b->prev_free;
+    sp->free_head = b->next_free;
+  if (b->next_free != kNone) at(s, sp, b->next_free)->prev_free = b->prev_free;
 }
 
-Block* phys_next(Store* s, Block* b) {
-  uint64_t off = off_of(s, b) + blk_size(b);
-  if (off >= s->hdr->arena_size) return nullptr;
-  return at(s, off);
+Block* phys_next(Store* s, Stripe* sp, Block* b) {
+  uint64_t off = off_of(s, sp, b) + blk_size(b);
+  if (off >= sp->arena_size) return nullptr;
+  return at(s, sp, off);
 }
 
-Block* phys_prev(Store* s, Block* b) {
+Block* phys_prev(Store* s, Stripe* sp, Block* b) {
   if (b->prev_size == 0) return nullptr;
-  return at(s, off_of(s, b) - b->prev_size);
+  return at(s, sp, off_of(s, sp, b) - b->prev_size);
 }
 
-// Allocate `need` payload bytes; returns arena offset of payload or kNone.
-uint64_t heap_alloc(Store* s, uint64_t need) {
+// Allocate `need` payload bytes from one stripe's heap; returns
+// stripe-relative offset of payload or kNone. Caller holds the stripe lock.
+uint64_t heap_alloc(Store* s, Stripe* sp, uint64_t need) {
   uint64_t want = align_up(need + kBlockHeader, kAlign);
   if (want < kMinBlock) want = kMinBlock;
   // first-fit
-  uint64_t off = s->hdr->free_head;
+  uint64_t off = sp->free_head;
   while (off != kNone) {
-    Block* b = at(s, off);
+    Block* b = at(s, sp, off);
     uint64_t bs = blk_size(b);
     if (bs >= want) {
-      free_list_remove(s, b);
+      free_list_remove(s, sp, b);
       if (bs - want >= kMinBlock) {
         // split
-        Block* rest = at(s, off + want);
+        Block* rest = at(s, sp, off + want);
         set_size(rest, bs - want, true);
         rest->prev_size = want;
-        Block* nxt = phys_next(s, rest);
+        Block* nxt = phys_next(s, sp, rest);
         if (nxt) nxt->prev_size = blk_size(rest);
-        free_list_push(s, rest);
+        free_list_push(s, sp, rest);
         set_size(b, want, false);
       } else {
         set_size(b, bs, false);
       }
-      s->hdr->bytes_in_use += blk_size(b);
+      sp->bytes_in_use += blk_size(b);
       return off + kBlockHeader;
     }
     off = b->next_free;
@@ -174,26 +249,26 @@ uint64_t heap_alloc(Store* s, uint64_t need) {
   return kNone;
 }
 
-void heap_free(Store* s, uint64_t payload_off) {
-  Block* b = at(s, payload_off - kBlockHeader);
-  s->hdr->bytes_in_use -= blk_size(b);
+void heap_free(Store* s, Stripe* sp, uint64_t payload_off) {
+  Block* b = at(s, sp, payload_off - kBlockHeader);
+  sp->bytes_in_use -= blk_size(b);
   set_size(b, blk_size(b), true);
   // coalesce with next
-  Block* n = phys_next(s, b);
+  Block* n = phys_next(s, sp, b);
   if (n && blk_free(n)) {
-    free_list_remove(s, n);
+    free_list_remove(s, sp, n);
     set_size(b, blk_size(b) + blk_size(n), true);
   }
   // coalesce with prev
-  Block* p = phys_prev(s, b);
+  Block* p = phys_prev(s, sp, b);
   if (p && blk_free(p)) {
-    free_list_remove(s, p);
+    free_list_remove(s, sp, p);
     set_size(p, blk_size(p) + blk_size(b), true);
     b = p;
   }
-  Block* after = phys_next(s, b);
+  Block* after = phys_next(s, sp, b);
   if (after) after->prev_size = blk_size(b);
-  free_list_push(s, b);
+  free_list_push(s, sp, b);
 }
 
 inline uint64_t hash_id(const uint8_t* id) {
@@ -209,108 +284,235 @@ inline uint64_t hash_id(const uint8_t* id) {
   return h;
 }
 
-// Find entry index for id; returns kNil if absent.
-uint32_t table_find(Store* s, const uint8_t* id) {
-  uint32_t mask = s->hdr->table_capacity - 1;
-  uint32_t i = static_cast<uint32_t>(hash_id(id)) & mask;
-  for (uint32_t probe = 0; probe <= mask; ++probe, i = (i + 1) & mask) {
+inline uint32_t stripe_of(Store* s, uint64_t h) {
+  // high bits pick the stripe; low bits pick the slot within the segment
+  return (uint32_t)((h >> 40) % s->hdr->num_stripes);
+}
+
+inline uint32_t segment_of(Store* s, uint32_t idx) {
+  return idx / s->hdr->stripes[0].seg_len;
+}
+
+// Probe one stripe's table segment for id. Safe WITHOUT the stripe lock:
+// entries publish via a release-store of state, ids are immutable while an
+// entry is live, and a concurrent tombstone compaction can at worst cause
+// a spurious miss (callers confirm misses under the lock). Returns entry
+// index or kNil.
+uint32_t probe_segment(Store* s, uint32_t si, const uint8_t* id, uint64_t h) {
+  Stripe* sp = &s->hdr->stripes[si];
+  uint32_t start = sp->seg_start, len = sp->seg_len;
+  uint32_t i = start + (uint32_t)h % len;
+  for (uint32_t probe = 0; probe < len; ++probe) {
     Entry* e = &s->table[i];
-    if (e->state == kEmpty) return kNil;
-    if (e->state != kTombstone && memcmp(e->id, id, kIdLen) == 0) return i;
+    uint32_t st = ld32(&e->state);
+    if (st == kEmpty) return kNil;
+    if (st != kTombstone && memcmp(e->id, id, kIdLen) == 0) return i;
+    if (++i == start + len) i = start;
   }
   return kNil;
 }
 
-// Find slot to insert id (assumes not present); kNil if table full.
-uint32_t table_slot(Store* s, const uint8_t* id) {
-  uint32_t mask = s->hdr->table_capacity - 1;
-  uint32_t i = static_cast<uint32_t>(hash_id(id)) & mask;
-  for (uint32_t probe = 0; probe <= mask; ++probe, i = (i + 1) & mask) {
-    Entry* e = &s->table[i];
-    if (e->state == kEmpty || e->state == kTombstone) return i;
+// Find a free slot in a stripe's segment for id (caller holds the stripe
+// lock and has verified id is absent). kNil if the segment is full.
+uint32_t segment_slot(Store* s, uint32_t si, uint64_t h) {
+  Stripe* sp = &s->hdr->stripes[si];
+  uint32_t start = sp->seg_start, len = sp->seg_len;
+  uint32_t i = start + (uint32_t)h % len;
+  for (uint32_t probe = 0; probe < len; ++probe) {
+    uint32_t st = ld32(&s->table[i].state, __ATOMIC_RELAXED);
+    if (st == kEmpty || st == kTombstone) return i;
+    if (++i == start + len) i = start;
   }
   return kNil;
 }
 
-void lru_unlink(Store* s, uint32_t i) {
-  Entry* e = &s->table[i];
-  if (e->lru_prev != kNil) s->table[e->lru_prev].lru_next = e->lru_next;
-  else if (s->hdr->lru_head == i) s->hdr->lru_head = e->lru_next;
-  if (e->lru_next != kNil) s->table[e->lru_next].lru_prev = e->lru_prev;
-  else if (s->hdr->lru_tail == i) s->hdr->lru_tail = e->lru_prev;
-  e->lru_prev = e->lru_next = kNil;
-}
-
-void lru_push_front(Store* s, uint32_t i) {
-  Entry* e = &s->table[i];
-  e->lru_prev = kNil;
-  e->lru_next = s->hdr->lru_head;
-  if (s->hdr->lru_head != kNil) s->table[s->hdr->lru_head].lru_prev = i;
-  s->hdr->lru_head = i;
-  if (s->hdr->lru_tail == kNil) s->hdr->lru_tail = i;
-  e->seq = ++s->hdr->lru_clock;
-}
-
-void entry_free(Store* s, uint32_t i) {
-  Entry* e = &s->table[i];
-  lru_unlink(s, i);
-  heap_free(s, e->offset);
-  e->state = kTombstone;
-  s->hdr->num_objects--;
-  // Anti-tombstone-exhaustion: if the next probe slot is empty, this
-  // tombstone (and any run of tombstones before it) can revert to empty
-  // without breaking probe chains.
-  uint32_t mask = s->hdr->table_capacity - 1;
-  if (s->table[(i + 1) & mask].state == kEmpty) {
-    uint32_t j = i;
-    while (s->table[j].state == kTombstone) {
-      s->table[j].state = kEmpty;
-      j = (j - 1) & mask;
-    }
+// Lock-free find across stripes: home segment first, then — only when any
+// create has ever been re-homed — the remaining segments in fallback order.
+uint32_t find_lockfree(Store* s, const uint8_t* id, uint64_t h,
+                       uint32_t home) {
+  uint32_t idx = probe_segment(s, home, id, h);
+  if (idx != kNil) return idx;
+  if (ld64(&s->hdr->fallback_count, __ATOMIC_RELAXED) == 0) return kNil;
+  uint32_t n = s->hdr->num_stripes;
+  for (uint32_t k = 1; k < n; ++k) {
+    idx = probe_segment(s, (home + k) % n, id, h);
+    if (idx != kNil) return idx;
   }
+  return kNil;
 }
 
-class Guard {
+// ----------------------------------------------------- stripe lock guard
+void repair_stripe_locked(Store* s, uint32_t si);
+class StripeGuard;
+template <typename F>
+int64_t with_entry_locked(Store* s, const uint8_t* id, F&& fn);
+
+class StripeGuard {
  public:
-  explicit Guard(Store* s) : h_(s->hdr), m_(&s->hdr->mutex) {
-    int rc = pthread_mutex_lock(m_);
-    if (rc == EOWNERDEAD) {
-      pthread_mutex_consistent(m_);
-      // If the dead holder was mid-mutation, heap/table invariants may be
-      // broken: poison the store instead of walking corrupt structures.
-      if (h_->mutating) h_->poisoned = 1;
+  StripeGuard(Store* s, uint32_t si) : sp_(&s->hdr->stripes[si]) {
+    int rc = pthread_mutex_lock(&sp_->mutex);
+    bool dead = rc == EOWNERDEAD;
+    if (dead) pthread_mutex_consistent(&sp_->mutex);
+    bool need_repair = dead && ld32(&sp_->mutating);
+    st32(&sp_->mutating, 1);
+    // open the seqlock window (odd) BEFORE any mutation — including the
+    // repair below — so seqlock readers can never accept a torn snapshot.
+    // A dead holder may have left lockseq odd already; don't double-bump.
+    if (!(ld64(&sp_->lockseq) & 1)) add64(&sp_->lockseq, 1);
+    if (need_repair) {
+      // the dead holder was mid-mutation: heap/table invariants for THIS
+      // stripe are suspect — rebuild it instead of walking corrupt
+      // structures. Other stripes are untouched.
+      st32(&sp_->poisoned, 1);
+      repair_stripe_locked(s, si);
+      st32(&sp_->poisoned, 0);
     }
-    h_->mutating = 1;
   }
-  ~Guard() {
-    h_->mutating = 0;
-    pthread_mutex_unlock(m_);
+  ~StripeGuard() {
+    st32(&sp_->mutating, 0);
+    add64(&sp_->lockseq, 1);  // even: snapshot stable
+    pthread_mutex_unlock(&sp_->mutex);
   }
-  bool poisoned() const { return h_->poisoned != 0; }
 
  private:
-  Header* h_;
-  pthread_mutex_t* m_;
+  Stripe* sp_;
 };
 
-// Evict LRU sealed+unpinned+evictable objects until `bytes` are reclaimable.
-// Called with lock held. Returns bytes freed.
-uint64_t evict_locked(Store* s, uint64_t bytes) {
-  uint64_t freed = 0;
-  uint32_t i = s->hdr->lru_tail;
-  while (freed < bytes && i != kNil) {
-    uint32_t prev = s->table[i].lru_prev;
-    Entry* e = &s->table[i];
-    if (e->state == kSealed && e->pin_count == 0 && !(e->flags & 2)) {
-      uint64_t sz = e->data_size + e->meta_size;
-      entry_free(s, i);
-      s->hdr->num_evictions++;
-      s->hdr->bytes_evicted += sz;
-      freed += sz;
+// Rebuild one stripe after its lock holder died mid-mutation: wipe the
+// table segment, reset the heap to a single free block. Objects resident
+// in the stripe are lost (survivors observe them as evicted — the same
+// contract as LRU eviction of an unspilled object). Caller holds the
+// (freshly made-consistent) stripe mutex.
+void repair_stripe_locked(Store* s, uint32_t si) {
+  Stripe* sp = &s->hdr->stripes[si];
+  memset(&s->table[sp->seg_start], 0, sizeof(Entry) * (uint64_t)sp->seg_len);
+  sp->free_head = kNone;
+  Block* b = at(s, sp, 0);
+  set_size(b, sp->arena_size, true);
+  b->prev_size = 0;
+  b->next_free = kNone;
+  b->prev_free = kNone;
+  sp->free_head = 0;
+  sp->bytes_in_use = 0;
+  sp->num_objects = 0;
+  sp->repairs++;
+}
+
+// Free an entry's heap block and tombstone its slot. Caller holds the
+// stripe lock and has already transitioned state to kTombstone.
+void finish_free(Store* s, uint32_t si, uint32_t idx) {
+  Stripe* sp = &s->hdr->stripes[si];
+  Entry* e = &s->table[idx];
+  // Sanity-gate the heap free: a lock-free seal racing a crash repair's
+  // segment wipe can leave a resurrected entry with a zeroed offset —
+  // freeing that would walk out of the stripe's heap. Such an entry owns
+  // no block (the repair rebuilt the heap), so only the slot dies.
+  if (e->offset >= kBlockHeader && e->offset < sp->arena_size)
+    heap_free(s, sp, e->offset);
+  if (sp->num_objects > 0) sp->num_objects--;  // resurrected entries (see
+                                               // above) aren't counted
+  // Anti-tombstone-exhaustion: if the next probe slot (within the
+  // segment) is empty, this tombstone and any run before it can revert
+  // to empty without breaking probe chains.
+  uint32_t start = sp->seg_start, len = sp->seg_len;
+  uint32_t nxt = idx + 1 == start + len ? start : idx + 1;
+  if (ld32(&s->table[nxt].state, __ATOMIC_RELAXED) == kEmpty) {
+    uint32_t j = idx;
+    while (ld32(&s->table[j].state, __ATOMIC_RELAXED) == kTombstone) {
+      st32(&s->table[j].state, kEmpty);
+      j = j == start ? start + len - 1 : j - 1;
     }
-    i = prev;
+  }
+}
+
+// CAS the entry out of `from` and free it. Returns false when the state
+// moved under us (e.g. a lock-free seal won the race against gc).
+bool entry_free_from(Store* s, uint32_t si, uint32_t idx, uint32_t from) {
+  if (!cas32(&s->table[idx].state, from, kTombstone)) return false;
+  finish_free(s, si, idx);
+  return true;
+}
+
+// Run `fn(si, idx)` under the owning stripe's lock for the live entry
+// matching id. The lock-free find is only a hint: a hit is re-verified
+// under the lock, and a miss is confirmed by locked probes — a lock-free
+// probe racing tombstone compaction must never make a mutation (release,
+// delete, abort, get-pin) silently no-op, or pins leak and objects turn
+// unevictable. Returns fn's result, or -ENOENT if the id is truly absent.
+template <typename F>
+int64_t with_entry_locked(Store* s, const uint8_t* id, F&& fn) {
+  uint64_t h = hash_id(id);
+  uint32_t home = stripe_of(s, h);
+  for (int attempt = 0; attempt < 4; ++attempt) {
+    uint32_t idx = find_lockfree(s, id, h, home);
+    if (idx == kNil) break;
+    uint32_t si = segment_of(s, idx);
+    StripeGuard g(s, si);
+    Entry* e = &s->table[idx];
+    uint32_t st = ld32(&e->state);
+    if ((st != kCreated && st != kSealed) || memcmp(e->id, id, kIdLen) != 0)
+      continue;  // entry was freed/reused between probe and lock — retry
+    return fn(si, idx);
+  }
+  // locked confirmation: probe each candidate segment under its lock
+  uint32_t n = s->hdr->num_stripes;
+  uint32_t scan = ld64(&s->hdr->fallback_count, __ATOMIC_RELAXED) ? n : 1;
+  for (uint32_t k = 0; k < scan; ++k) {
+    uint32_t si = (home + k) % n;
+    StripeGuard g(s, si);
+    uint32_t idx = probe_segment(s, si, id, h);
+    if (idx != kNil) return fn(si, idx);
+  }
+  return -ENOENT;
+}
+
+// Evict lowest-seq sealed+unpinned+evictable objects from ONE stripe until
+// `bytes` are reclaimable. Caller holds the stripe lock. Returns bytes
+// freed. Only this stripe's clients can contend with the sweep.
+uint64_t evict_stripe_locked(Store* s, uint32_t si, uint64_t bytes) {
+  Stripe* sp = &s->hdr->stripes[si];
+  std::vector<std::pair<uint64_t, uint32_t>> cands;  // (seq, idx)
+  for (uint32_t i = sp->seg_start; i < sp->seg_start + sp->seg_len; ++i) {
+    Entry* e = &s->table[i];
+    if (ld32(&e->state, __ATOMIC_RELAXED) == kSealed &&
+        ld32(&e->pin_count, __ATOMIC_RELAXED) == 0 && !(e->flags & 2))
+      cands.emplace_back(ld64(&e->seq, __ATOMIC_RELAXED), i);
+  }
+  std::sort(cands.begin(), cands.end());
+  uint64_t freed = 0;
+  for (auto& c : cands) {
+    if (freed >= bytes) break;
+    Entry* e = &s->table[c.second];
+    uint64_t sz = e->data_size + e->meta_size;
+    if (!entry_free_from(s, si, c.second, kSealed)) continue;
+    sp->num_evictions++;
+    sp->bytes_evicted += sz;
+    freed += sz;
   }
   return freed;
+}
+
+// -------------------------------------------------------- chaos injection
+// Deterministic crash hook for the robust-mutex recovery tests (the shm
+// analog of rpc.py's RAY_TPU_TESTING_RPC_FAILURE): spec
+// RAY_TPU_TESTING_SHM_FAILURE="shm_create=N" SIGKILLs this process inside
+// its Nth rt_create WHILE HOLDING the stripe mutex mid-mutation — the
+// worst-case death a survivor must repair from.
+long chaos_crash_create_after() {
+  static long n = [] {
+    const char* raw = getenv("RAY_TPU_TESTING_SHM_FAILURE");
+    if (!raw) return 0L;
+    const char* p = strstr(raw, "shm_create=");
+    return p ? atol(p + sizeof("shm_create=") - 1) : 0L;
+  }();
+  return n;
+}
+
+void chaos_maybe_crash_in_create() {
+  long after = chaos_crash_create_after();
+  if (after <= 0) return;
+  static std::atomic<long> creates{0};
+  if (creates.fetch_add(1) + 1 == after) kill(getpid(), SIGKILL);
 }
 
 // ------------------------------------------------------------ copy pool
@@ -403,6 +605,63 @@ class CopyPool {
   std::vector<std::thread> threads_;
 };
 
+// How many stripes a new store gets. Explicit request wins; otherwise the
+// RAY_TPU_ARENA_STRIPES env var; otherwise size/kMinStripeBytes capped at
+// 8 — so small test arenas stay single-stripe (exactly the v1 behavior)
+// and production-sized arenas stripe wide enough for node-local clients.
+uint32_t resolve_stripes(uint64_t arena_size, int requested) {
+  long n = requested;
+  if (n <= 0) {
+    const char* env = getenv("RAY_TPU_ARENA_STRIPES");
+    n = env ? atol(env) : 0;
+    if (n <= 0) {
+      n = (long)(arena_size / kMinStripeBytes);
+      if (n > 8) n = 8;
+    }
+  }
+  if (n < 1) n = 1;
+  if (n > (long)kMaxStripes) n = (long)kMaxStripes;
+  // hard floor: a stripe smaller than 1 MiB cannot hold real objects
+  while (n > 1 && arena_size / (uint64_t)n < (1ULL << 20)) n--;
+  return (uint32_t)n;
+}
+
+struct StripeSnap {
+  uint64_t bytes_in_use, capacity, num_objects, num_evictions,
+      bytes_evicted, create_count, get_hits, get_misses, repairs,
+      seal_count, poisoned;
+};
+
+void read_stripe_fields(Stripe* sp, StripeSnap* o) {
+  o->bytes_in_use = ld64(&sp->bytes_in_use, __ATOMIC_RELAXED);
+  o->capacity = sp->arena_size;
+  o->num_objects = ld64(&sp->num_objects, __ATOMIC_RELAXED);
+  o->num_evictions = ld64(&sp->num_evictions, __ATOMIC_RELAXED);
+  o->bytes_evicted = ld64(&sp->bytes_evicted, __ATOMIC_RELAXED);
+  o->create_count = ld64(&sp->create_count, __ATOMIC_RELAXED);
+  o->get_hits = ld64(&sp->get_hits, __ATOMIC_RELAXED);
+  o->get_misses = ld64(&sp->get_misses, __ATOMIC_RELAXED);
+  o->repairs = ld64(&sp->repairs, __ATOMIC_RELAXED);
+  o->seal_count = ld64(&sp->seal_count, __ATOMIC_RELAXED);
+  o->poisoned = ld32(&sp->poisoned, __ATOMIC_RELAXED);
+}
+
+// Seqlock read of one stripe's counters; never blocks on a healthy store.
+// Falls back to the mutex only when a writer looks stuck — which is
+// exactly the robust-recovery probe needed if that writer is dead.
+void snapshot_stripe(Store* s, uint32_t si, StripeSnap* o) {
+  Stripe* sp = &s->hdr->stripes[si];
+  for (int spin = 0; spin < 4096; ++spin) {
+    uint64_t s0 = ld64(&sp->lockseq);
+    if (s0 & 1) continue;
+    read_stripe_fields(sp, o);
+    __atomic_thread_fence(__ATOMIC_ACQUIRE);
+    if (ld64(&sp->lockseq) == s0) return;
+  }
+  StripeGuard g(s, si);
+  read_stripe_fields(sp, o);
+}
+
 }  // namespace
 
 extern "C" {
@@ -421,7 +680,9 @@ void rt_write_parallel(void* dst, const void* src, uint64_t n, int threads) {
                            static_cast<const uint8_t*>(src), n, threads);
 }
 
-void* rt_store_create(const char* path, uint64_t size) {
+// Create a fresh store. `stripes` <= 0 resolves via RAY_TPU_ARENA_STRIPES
+// then size-based auto-striping.
+void* rt_store_create(const char* path, uint64_t size, int stripes) {
   // Always create a fresh inode (O_EXCL after unlink): truncating an
   // existing path would SIGBUS any process still mapping the old store.
   unlink(path);
@@ -442,7 +703,6 @@ void* rt_store_create(const char* path, uint64_t size) {
   s->base = static_cast<uint8_t*>(mem);
   s->hdr = reinterpret_cast<Header*>(mem);
   s->table = reinterpret_cast<Entry*>(s->base + header_bytes);
-  s->arena = s->base + header_bytes + table_bytes;
   s->map_size = total;
   s->fd = fd;
 
@@ -454,21 +714,31 @@ void* rt_store_create(const char* path, uint64_t size) {
   h->total_size = total;
   h->arena_offset = header_bytes + table_bytes;
   h->arena_size = total - h->arena_offset;
-  h->free_head = kNone;
-  h->lru_head = h->lru_tail = kNil;
+  h->num_stripes = resolve_stripes(h->arena_size, stripes);
 
   pthread_mutexattr_t attr;
   pthread_mutexattr_init(&attr);
   pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
   pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
-  pthread_mutex_init(&h->mutex, &attr);
-  pthread_mutexattr_destroy(&attr);
 
-  // one giant free block
-  Block* b = at(s, 0);
-  set_size(b, h->arena_size, true);
-  b->prev_size = 0;
-  free_list_push(s, b);
+  uint64_t stripe_sz = (h->arena_size / h->num_stripes) & ~(kAlign - 1);
+  uint32_t seg_len = kTableCapacity / h->num_stripes;
+  for (uint32_t i = 0; i < h->num_stripes; ++i) {
+    Stripe* sp = &h->stripes[i];
+    pthread_mutex_init(&sp->mutex, &attr);
+    sp->arena_off = h->arena_offset + (uint64_t)i * stripe_sz;
+    sp->arena_size = stripe_sz;
+    sp->seg_start = i * seg_len;
+    sp->seg_len = seg_len;
+    sp->free_head = kNone;
+    Block* b = at(s, sp, 0);
+    set_size(b, sp->arena_size, true);
+    b->prev_size = 0;
+    b->next_free = kNone;
+    b->prev_free = kNone;
+    sp->free_head = 0;
+  }
+  pthread_mutexattr_destroy(&attr);
 
   std::atomic_thread_fence(std::memory_order_seq_cst);
   h->magic = kMagic;  // publish last
@@ -494,7 +764,6 @@ void* rt_store_open(const char* path) {
   s->hdr = h;
   uint64_t header_bytes = align_up(sizeof(Header), 4096);
   s->table = reinterpret_cast<Entry*>(s->base + header_bytes);
-  s->arena = s->base + h->arena_offset;
   s->map_size = h->total_size;
   s->fd = fd;
   return s;
@@ -508,179 +777,316 @@ void rt_store_close(void* hs) {
 }
 
 uint8_t* rt_store_base(void* hs) { return static_cast<Store*>(hs)->base; }
-uint64_t rt_store_capacity(void* hs) { return static_cast<Store*>(hs)->hdr->arena_size; }
+
+uint32_t rt_num_stripes(void* hs) {
+  return static_cast<Store*>(hs)->hdr->num_stripes;
+}
+
+uint64_t rt_store_capacity(void* hs) {
+  // usable capacity = sum of stripe slices (alignment slack excluded)
+  Store* s = static_cast<Store*>(hs);
+  return (uint64_t)s->hdr->num_stripes * s->hdr->stripes[0].arena_size;
+}
+
 uint64_t rt_store_total_size(void* hs) { return static_cast<Store*>(hs)->hdr->total_size; }
 
 // Create an object buffer. Returns base-relative offset of the payload
 // (data followed by metadata), or a negative errno-style code:
-//   -EEXIST already exists, -ENOMEM no space even after eviction,
-//   -ENFILE table full.
+//   -EEXIST already exists, -ENOMEM no space even after per-stripe
+//   eviction, -ENFILE table full.
+//
+// Lock discipline: the fast path (home stripe has room) takes exactly one
+// stripe lock. Under pressure the create walks the other stripes
+// round-robin — sequentially, never holding two locks at once — first
+// without eviction, then with per-stripe eviction as a last resort (the
+// node manager's sweep keeps stripes below watermark so this stays rare).
 int64_t rt_create(void* hs, const uint8_t* id, uint64_t data_size,
                   uint64_t meta_size, int evictable) {
   Store* s = static_cast<Store*>(hs);
   uint64_t need = data_size + meta_size;
-  Guard g(s);
-  if (g.poisoned()) return -EIO;
-  if (table_find(s, id) != kNil) return -EEXIST;
-  uint32_t slot = table_slot(s, id);
-  if (slot == kNil) return -ENFILE;
-  uint64_t off = heap_alloc(s, need);
-  if (off == kNone) {
-    evict_locked(s, need);
-    off = heap_alloc(s, need);
-    if (off == kNone) return -ENOMEM;
+  uint64_t h = hash_id(id);
+  uint32_t nstripes = s->hdr->num_stripes;
+  uint32_t home = stripe_of(s, h);
+
+  // duplicate check for re-homed objects: best-effort lock-free (exact
+  // within the home stripe below; a concurrent same-id double-create is
+  // caller misuse and at worst wastes one block until delete)
+  if (ld64(&s->hdr->fallback_count, __ATOMIC_RELAXED) != 0 &&
+      find_lockfree(s, id, h, home) != kNil)
+    return -EEXIST;
+
+  int64_t soft_rc = -ENOMEM;
+  for (int pass = 0; pass < 2; ++pass) {       // pass 0: no evict; 1: evict
+    for (uint32_t k = 0; k < nstripes; ++k) {
+      uint32_t si = (home + k) % nstripes;
+      Stripe* sp = &s->hdr->stripes[si];
+      StripeGuard g(s, si);
+      if (probe_segment(s, si, id, h) != kNil) return -EEXIST;
+      uint32_t slot = segment_slot(s, si, h);
+      if (slot == kNil) { soft_rc = -ENFILE; continue; }
+      uint64_t off = heap_alloc(s, sp, need);
+      if (off == kNone && pass == 1) {
+        evict_stripe_locked(s, si, need);
+        off = heap_alloc(s, sp, need);
+      }
+      if (off == kNone) continue;
+      Entry* e = &s->table[slot];
+      memcpy(e->id, id, kIdLen);
+      // chaos hook: die HERE — lock held, heap mutated, entry half-written
+      chaos_maybe_crash_in_create();
+      e->stripe = si;
+      e->offset = off;
+      e->data_size = data_size;
+      e->meta_size = meta_size;
+      st32(&e->pin_count, 1, __ATOMIC_RELAXED);  // creator pin until seal
+      e->flags = evictable ? 0 : 2;
+      struct timespec ts;
+      clock_gettime(CLOCK_MONOTONIC, &ts);
+      e->ctime_sec = (uint64_t)ts.tv_sec;
+      st64(&e->seq, add64(&sp->lru_clock, 1) + 1, __ATOMIC_RELAXED);
+      st32(&e->state, kCreated);  // release: publishes the entry
+      sp->num_objects++;
+      sp->create_count++;
+      if (si != home) add64(&s->hdr->fallback_count, 1);
+      return (int64_t)(sp->arena_off + off);
+    }
   }
-  Entry* e = &s->table[slot];
-  memcpy(e->id, id, kIdLen);
-  e->state = kCreated;
-  e->offset = off;
-  e->data_size = data_size;
-  e->meta_size = meta_size;
-  e->pin_count = 1;  // creator holds a pin until seal+release
-  e->flags = evictable ? 0 : 2;
-  struct timespec ts;
-  clock_gettime(CLOCK_MONOTONIC, &ts);
-  e->ctime_sec = (uint64_t)ts.tv_sec;
-  e->lru_prev = e->lru_next = kNil;
-  s->hdr->num_objects++;
-  s->hdr->create_count++;
-  return (int64_t)(s->hdr->arena_offset + off);
+  return soft_rc;
 }
 
+// Seal: lock-free CREATED -> SEALED transition. Takes no heap lock on the
+// fast path; the locked fallback only runs when a concurrent tombstone
+// compaction hid the entry from the lock-free probe (vanishingly rare).
 int rt_seal(void* hs, const uint8_t* id) {
   Store* s = static_cast<Store*>(hs);
-  Guard g(s);
-  uint32_t i = table_find(s, id);
-  if (i == kNil) return -ENOENT;
-  Entry* e = &s->table[i];
-  if (e->state != kCreated) return -EINVAL;
-  e->state = kSealed;
-  e->pin_count = 0;
-  lru_push_front(s, i);
-  s->hdr->seal_count++;
+  uint64_t h = hash_id(id);
+  uint32_t home = stripe_of(s, h);
+  uint32_t idx = find_lockfree(s, id, h, home);
+  if (idx == kNil) {
+    // confirm the miss under the locks before failing
+    uint32_t n = s->hdr->num_stripes;
+    for (uint32_t k = 0; k < n && idx == kNil; ++k) {
+      StripeGuard g(s, (home + k) % n);
+      idx = probe_segment(s, (home + k) % n, id, h);
+    }
+    if (idx == kNil) return -ENOENT;
+  }
+  Entry* e = &s->table[idx];
+  Stripe* sp = &s->hdr->stripes[segment_of(s, idx)];
+  // Order matters: the creator pin must read 0 and the LRU stamp must be
+  // set BEFORE the release-CAS publishes SEALED — a get() that observes
+  // SEALED (acquire) then sees a consistent entry. Only the creator can
+  // legally seal, so the entry cannot be freed+reused under us (gc only
+  // reaps CREATED entries minutes old).
+  st32(&e->pin_count, 0, __ATOMIC_RELAXED);
+  st64(&e->seq, add64(&sp->lru_clock, 1) + 1, __ATOMIC_RELAXED);
+  if (!cas32(&e->state, kCreated, kSealed)) {
+    uint32_t now = ld32(&e->state);
+    return (now == kEmpty || now == kTombstone) ? -ENOENT : -EINVAL;
+  }
+  add64(&sp->seal_count, 1, __ATOMIC_RELAXED);
   return 0;
 }
 
 // Look up a sealed object. On hit fills sizes, pins if pin!=0, returns
-// base-relative payload offset. -ENOENT if absent or not sealed.
+// base-relative payload offset. -ENOENT if absent or not sealed. Takes
+// exactly one stripe lock on a hit; a miss confirms under the locks (a
+// lock-free probe can race tombstone compaction).
 int64_t rt_get(void* hs, const uint8_t* id, uint64_t* data_size,
                uint64_t* meta_size, int pin) {
   Store* s = static_cast<Store*>(hs);
-  Guard g(s);
-  if (g.poisoned()) return -EIO;
-  uint32_t i = table_find(s, id);
-  if (i == kNil || s->table[i].state != kSealed) {
-    s->hdr->get_misses++;
-    return -ENOENT;
+  int64_t rc = with_entry_locked(s, id, [&](uint32_t si, uint32_t idx) {
+    Stripe* sp = &s->hdr->stripes[si];
+    Entry* e = &s->table[idx];
+    if (ld32(&e->state) != kSealed) return (int64_t)-ENOENT;  // unsealed
+    *data_size = e->data_size;
+    *meta_size = e->meta_size;
+    if (pin) st32(&e->pin_count, ld32(&e->pin_count) + 1, __ATOMIC_RELAXED);
+    st64(&e->seq, add64(&sp->lru_clock, 1) + 1, __ATOMIC_RELAXED);
+    sp->get_hits++;
+    return (int64_t)(sp->arena_off + e->offset);
+  });
+  if (rc < 0) {
+    uint32_t home = stripe_of(s, hash_id(id));
+    add64(&s->hdr->stripes[home].get_misses, 1, __ATOMIC_RELAXED);
   }
-  Entry* e = &s->table[i];
-  *data_size = e->data_size;
-  *meta_size = e->meta_size;
-  if (pin) e->pin_count++;
-  // touch LRU
-  lru_unlink(s, i);
-  lru_push_front(s, i);
-  s->hdr->get_hits++;
-  return (int64_t)(s->hdr->arena_offset + e->offset);
+  return rc;
 }
 
 int rt_release(void* hs, const uint8_t* id) {
   Store* s = static_cast<Store*>(hs);
-  Guard g(s);
-  uint32_t i = table_find(s, id);
-  if (i == kNil) return -ENOENT;
-  Entry* e = &s->table[i];
-  if (e->pin_count > 0) e->pin_count--;
-  if ((e->flags & 1) && e->pin_count == 0) entry_free(s, i);
-  return 0;
+  return (int)with_entry_locked(s, id, [&](uint32_t si, uint32_t idx) {
+    Entry* e = &s->table[idx];
+    uint32_t st = ld32(&e->state);
+    uint32_t pins = ld32(&e->pin_count, __ATOMIC_RELAXED);
+    if (pins > 0) st32(&e->pin_count, pins - 1, __ATOMIC_RELAXED);
+    if ((e->flags & 1) && pins <= 1) entry_free_from(s, si, idx, st);
+    return (int64_t)0;
+  });
 }
 
 int rt_contains(void* hs, const uint8_t* id) {
   Store* s = static_cast<Store*>(hs);
-  Guard g(s);
-  uint32_t i = table_find(s, id);
-  return (i != kNil && s->table[i].state == kSealed) ? 1 : 0;
+  uint64_t h = hash_id(id);
+  uint32_t home = stripe_of(s, h);
+  uint32_t idx = find_lockfree(s, id, h, home);
+  if (idx != kNil)
+    return ld32(&s->table[idx].state) == kSealed &&
+                   memcmp(s->table[idx].id, id, kIdLen) == 0
+               ? 1
+               : 0;
+  // lock-free probes can race tombstone compaction: confirm the miss
+  uint32_t n = s->hdr->num_stripes;
+  uint32_t scan = ld64(&s->hdr->fallback_count, __ATOMIC_RELAXED) ? n : 1;
+  for (uint32_t k = 0; k < scan; ++k) {
+    uint32_t si = (home + k) % n;
+    StripeGuard g(s, si);
+    idx = probe_segment(s, si, id, h);
+    if (idx != kNil) return ld32(&s->table[idx].state) == kSealed ? 1 : 0;
+  }
+  return 0;
 }
 
 // Delete (deferred if pinned). -ENOENT if absent.
 int rt_delete(void* hs, const uint8_t* id) {
   Store* s = static_cast<Store*>(hs);
-  Guard g(s);
-  uint32_t i = table_find(s, id);
-  if (i == kNil) return -ENOENT;
-  Entry* e = &s->table[i];
-  if (e->pin_count > 0) {
-    e->flags |= 1;  // delete-pending
-    return 0;
-  }
-  entry_free(s, i);
-  return 0;
+  return (int)with_entry_locked(s, id, [&](uint32_t si, uint32_t idx) {
+    Entry* e = &s->table[idx];
+    uint32_t st = ld32(&e->state);
+    if (ld32(&e->pin_count, __ATOMIC_RELAXED) > 0) {
+      e->flags |= 1;  // delete-pending
+      return (int64_t)0;
+    }
+    if (entry_free_from(s, si, idx, st)) return (int64_t)0;
+    // a lock-free seal raced the CAS: retry from the (now SEALED) state
+    if (entry_free_from(s, si, idx, kSealed)) return (int64_t)0;
+    return (int64_t)-ENOENT;
+  });
 }
 
 // Abort an in-progress creation (writer failed before seal).
 int rt_abort(void* hs, const uint8_t* id) {
   Store* s = static_cast<Store*>(hs);
-  Guard g(s);
-  uint32_t i = table_find(s, id);
-  if (i == kNil) return -ENOENT;
-  if (s->table[i].state != kCreated) return -EINVAL;
-  entry_free(s, i);
-  return 0;
+  return (int)with_entry_locked(s, id, [&](uint32_t si, uint32_t idx) {
+    if (ld32(&s->table[idx].state) != kCreated) return (int64_t)-EINVAL;
+    return entry_free_from(s, si, idx, kCreated) ? (int64_t)0
+                                                 : (int64_t)-EINVAL;
+  });
 }
 
 // Reclaim CREATED-but-never-sealed objects older than max_age_sec — their
 // writer likely died before sealing. Returns number reclaimed. Called
-// periodically by the node daemon.
+// periodically by the node daemon's sweep.
 uint64_t rt_gc_unsealed(void* hs, uint64_t max_age_sec) {
   Store* s = static_cast<Store*>(hs);
-  Guard g(s);
   struct timespec ts;
   clock_gettime(CLOCK_MONOTONIC, &ts);
   uint64_t now = (uint64_t)ts.tv_sec;
   uint64_t n = 0;
-  for (uint32_t i = 0; i < s->hdr->table_capacity; ++i) {
+  for (uint32_t si = 0; si < s->hdr->num_stripes; ++si) {
+    Stripe* sp = &s->hdr->stripes[si];
+    StripeGuard g(s, si);
+    for (uint32_t i = sp->seg_start; i < sp->seg_start + sp->seg_len; ++i) {
+      Entry* e = &s->table[i];
+      if (ld32(&e->state, __ATOMIC_RELAXED) == kCreated &&
+          now - e->ctime_sec >= max_age_sec &&
+          entry_free_from(s, si, i, kCreated))  // CAS guards racing seals
+        ++n;
+    }
+  }
+  return n;
+}
+
+// Evict up to `bytes` from one stripe (node-manager sweep entry point).
+uint64_t rt_evict_stripe(void* hs, uint32_t stripe, uint64_t bytes) {
+  Store* s = static_cast<Store*>(hs);
+  if (stripe >= s->hdr->num_stripes) return 0;
+  StripeGuard g(s, stripe);
+  return evict_stripe_locked(s, stripe, bytes);
+}
+
+uint64_t rt_evict(void* hs, uint64_t bytes) {
+  Store* s = static_cast<Store*>(hs);
+  uint64_t freed = 0;
+  for (uint32_t si = 0; si < s->hdr->num_stripes && freed < bytes; ++si) {
+    StripeGuard g(s, si);
+    freed += evict_stripe_locked(s, si, bytes - freed);
+  }
+  return freed;
+}
+
+// Aggregate store stats, served lock-free from per-stripe seqlock
+// snapshots — a stats poll never queues behind a client's create.
+// out[13]: bytes_in_use, capacity, num_objects, num_evictions,
+// bytes_evicted, create_count, get_hits, get_misses, poisoned,
+// num_stripes, stripe_repairs, create_fallbacks, seal_count.
+void rt_stats(void* hs, uint64_t* out) {
+  Store* s = static_cast<Store*>(hs);
+  memset(out, 0, 13 * sizeof(uint64_t));
+  for (uint32_t si = 0; si < s->hdr->num_stripes; ++si) {
+    StripeSnap sn;
+    snapshot_stripe(s, si, &sn);
+    out[0] += sn.bytes_in_use;
+    out[1] += sn.capacity;
+    out[2] += sn.num_objects;
+    out[3] += sn.num_evictions;
+    out[4] += sn.bytes_evicted;
+    out[5] += sn.create_count;
+    out[6] += sn.get_hits;
+    out[7] += sn.get_misses;
+    out[8] += sn.poisoned;
+    out[10] += sn.repairs;
+    out[12] += sn.seal_count;
+  }
+  out[9] = s->hdr->num_stripes;
+  out[11] = ld64(&s->hdr->fallback_count, __ATOMIC_RELAXED);
+}
+
+// Per-stripe stats (lock-free snapshot) for sweep targeting and bench
+// attribution. out[8]: bytes_in_use, capacity, num_objects,
+// num_evictions, bytes_evicted, repairs, poisoned, seal_count.
+void rt_stripe_stats(void* hs, uint32_t stripe, uint64_t* out) {
+  Store* s = static_cast<Store*>(hs);
+  memset(out, 0, 8 * sizeof(uint64_t));
+  if (stripe >= s->hdr->num_stripes) return;
+  StripeSnap sn;
+  snapshot_stripe(s, stripe, &sn);
+  out[0] = sn.bytes_in_use;
+  out[1] = sn.capacity;
+  out[2] = sn.num_objects;
+  out[3] = sn.num_evictions;
+  out[4] = sn.bytes_evicted;
+  out[5] = sn.repairs;
+  out[6] = sn.poisoned;
+  out[7] = sn.seal_count;
+}
+
+// List up to max_n sealed object ids of ONE stripe into out.
+uint64_t rt_list_stripe(void* hs, uint32_t stripe, uint8_t* out,
+                        uint64_t max_n) {
+  Store* s = static_cast<Store*>(hs);
+  if (stripe >= s->hdr->num_stripes) return 0;
+  Stripe* sp = &s->hdr->stripes[stripe];
+  StripeGuard g(s, stripe);
+  uint64_t n = 0;
+  for (uint32_t i = sp->seg_start; i < sp->seg_start + sp->seg_len && n < max_n;
+       ++i) {
     Entry* e = &s->table[i];
-    if (e->state == kCreated && now - e->ctime_sec >= max_age_sec) {
-      entry_free(s, i);
+    if (ld32(&e->state, __ATOMIC_RELAXED) == kSealed) {
+      memcpy(out + n * kIdLen, e->id, kIdLen);
       ++n;
     }
   }
   return n;
 }
 
-uint64_t rt_evict(void* hs, uint64_t bytes) {
-  Store* s = static_cast<Store*>(hs);
-  Guard g(s);
-  return evict_locked(s, bytes);
-}
-
-void rt_stats(void* hs, uint64_t* out) {
-  Store* s = static_cast<Store*>(hs);
-  Guard g(s);
-  Header* h = s->hdr;
-  out[0] = h->bytes_in_use;
-  out[1] = h->arena_size;
-  out[2] = h->num_objects;
-  out[3] = h->num_evictions;
-  out[4] = h->bytes_evicted;
-  out[5] = h->create_count;
-  out[6] = h->get_hits;
-  out[7] = h->get_misses;
-  out[8] = h->poisoned;
-}
-
 // List up to max_n sealed object ids into out (max_n * kIdLen bytes).
+// Locks stripes one at a time — never the whole store.
 uint64_t rt_list(void* hs, uint8_t* out, uint64_t max_n) {
   Store* s = static_cast<Store*>(hs);
-  Guard g(s);
   uint64_t n = 0;
-  for (uint32_t i = 0; i < s->hdr->table_capacity && n < max_n; ++i) {
-    Entry* e = &s->table[i];
-    if (e->state == kSealed) {
-      memcpy(out + n * kIdLen, e->id, kIdLen);
-      ++n;
-    }
-  }
+  for (uint32_t si = 0; si < s->hdr->num_stripes && n < max_n; ++si)
+    n += rt_list_stripe(hs, si, out + n * kIdLen, max_n - n);
   return n;
 }
 

@@ -7,22 +7,32 @@
 // process with a nonzero exit.
 //
 // Exercises: create/seal/get/release/delete round trips, abort of
-// unsealed objects, LRU eviction under pressure, cross-handle open, and
-// multi-threaded hammering of one arena (the robust-mutex path).
+// unsealed objects, LRU eviction under pressure, cross-handle open,
+// multi-threaded hammering of a single-stripe arena (the v1 regime),
+// concurrent create/seal/get/evict/stats across >=4 stripes (the
+// lock-striped regime: lock-free seal + seqlock stats under fire),
+// round-robin fallback when a home stripe is pinned full, and — when
+// invoked as its own crash child — SIGKILL mid-rt_create while holding a
+// stripe mutex, which the parent must repair via EOWNERDEAD.
 
 #include <atomic>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <thread>
 #include <vector>
 
+#include <sys/wait.h>
+#include <unistd.h>
+
 extern "C" {
-void* rt_store_create(const char* path, uint64_t size);
+void* rt_store_create(const char* path, uint64_t size, int stripes);
 void* rt_store_open(const char* path);
 void rt_store_close(void* hs);
 uint8_t* rt_store_base(void* hs);
+uint32_t rt_num_stripes(void* hs);
 int64_t rt_create(void* hs, const uint8_t* id, uint64_t data_size,
                   uint64_t meta_size, int evictable);
 int rt_seal(void* hs, const uint8_t* id);
@@ -33,7 +43,11 @@ int rt_contains(void* hs, const uint8_t* id);
 int rt_delete(void* hs, const uint8_t* id);
 int rt_abort(void* hs, const uint8_t* id);
 uint64_t rt_evict(void* hs, uint64_t bytes);
+uint64_t rt_evict_stripe(void* hs, uint32_t stripe, uint64_t bytes);
 void rt_stats(void* hs, uint64_t* out);
+void rt_stripe_stats(void* hs, uint32_t stripe, uint64_t* out);
+uint64_t rt_list_stripe(void* hs, uint32_t stripe, uint8_t* out,
+                        uint64_t max_n);
 void rt_write_parallel(void* dst, const void* src, uint64_t n, int threads);
 }
 
@@ -53,11 +67,34 @@ static void make_id(uint8_t* id, uint64_t n) {
   memcpy(id, &n, sizeof(n));
 }
 
+// Crash-child mode: open the store and put objects until the chaos hook
+// (RAY_TPU_TESTING_SHM_FAILURE=shm_create=N, armed by the parent) SIGKILLs
+// this process inside rt_create with the stripe mutex held.
+static int crash_child(const char* path) {
+  void* h = rt_store_open(path);
+  if (!h) return 7;
+  uint8_t* b = rt_store_base(h);
+  for (uint64_t n = 0; n < 1000; n++) {
+    uint8_t id[kIdLen];
+    make_id(id, 900000 + n);
+    int64_t o = rt_create(h, id, 4096, 0, 1);
+    if (o > 0) {
+      memset(b + o, 0x5a, 4096);
+      rt_seal(h, id);
+    }
+  }
+  return 8;  // survived 1000 creates: the chaos hook never fired
+}
+
 int main(int argc, char** argv) {
   std::string path = argc > 1 ? argv[1] : "/dev/shm/rt_selftest";
+  if (argc > 2 && strcmp(argv[2], "crashchild") == 0)
+    return crash_child(path.c_str());
+
   const uint64_t kArena = 4 << 20;  // 4 MiB
-  void* s = rt_store_create(path.c_str(), kArena);
+  void* s = rt_store_create(path.c_str(), kArena, 1);  // v1 regime
   CHECK(s != nullptr);
+  CHECK(rt_num_stripes(s) == 1);
 
   // --- round trip -------------------------------------------------------
   uint8_t id[kIdLen];
@@ -101,17 +138,18 @@ int main(int argc, char** argv) {
     memset(base + o, (int)(n & 0xff), 64 << 10);
     CHECK(rt_seal(s, eid) == 0);
   }
-  uint64_t st[9];
+  uint64_t st[13];
   rt_stats(s, st);
   CHECK(st[3] > 0);       // evictions happened
   CHECK(st[8] == 0);      // not poisoned
+  CHECK(st[9] == 1);      // single stripe
 
   // --- cross-handle open -------------------------------------------------
   void* s2 = rt_store_open(path.c_str());
   CHECK(s2 != nullptr);
   CHECK(rt_contains(s2, id) == rt_contains(s, id));
 
-  // --- concurrent hammering ---------------------------------------------
+  // --- concurrent hammering (single stripe) ------------------------------
   std::atomic<int> failures{0};
   auto worker = [&](int tid) {
     void* h = rt_store_open(path.c_str());
@@ -162,7 +200,7 @@ int main(int argc, char** argv) {
     // a separate 32 MiB arena keeps this from thrashing the tiny store
     // the eviction section above sized deliberately small
     std::string cpath = path + ".copy";
-    void* cs = rt_store_create(cpath.c_str(), 32 << 20);
+    void* cs = rt_store_create(cpath.c_str(), 32 << 20, 0);
     CHECK(cs != nullptr);
     std::atomic<int> copy_failures{0};
     auto copier = [&](int tid) {
@@ -203,6 +241,156 @@ int main(int argc, char** argv) {
   rt_store_close(s2);
   rt_store_close(s);
   remove(path.c_str());
+
+  // ===================== lock-striped arena sections =====================
+  std::string mpath = path + ".striped";
+  const uint64_t kStripedArena = 16 << 20;  // 4 MiB per stripe
+  void* ms = rt_store_create(mpath.c_str(), kStripedArena, 4);
+  CHECK(ms != nullptr);
+  CHECK(rt_num_stripes(ms) == 4);
+
+  // --- concurrent create/seal/get/evict/stats across 4 stripes ----------
+  // 4 writer threads + an evictor hammering rt_evict_stripe + a lock-free
+  // stats poller. The sealed-put path (create+copy+seal) runs against
+  // concurrent eviction sweeps: zero seal/create/readback errors allowed.
+  {
+    std::atomic<int> mfail{0};
+    std::atomic<bool> stop{false};
+    auto mworker = [&](int tid) {
+      void* h = rt_store_open(mpath.c_str());
+      if (!h) { mfail++; return; }
+      uint8_t* b = rt_store_base(h);
+      for (uint64_t n = 0; n < 300; n++) {
+        uint8_t wid[kIdLen];
+        make_id(wid, 100000 + tid * 10000 + n);
+        int64_t o = rt_create(h, wid, 32 << 10, 8, 1);
+        if (o <= 0) continue;  // ENOMEM under pressure is legal
+        memset(b + o, tid + 1, (32 << 10) + 8);
+        if (rt_seal(h, wid) != 0) { mfail++; continue; }
+        uint64_t d, m;
+        int64_t g = rt_get(h, wid, &d, &m, 1);
+        if (g > 0) {
+          if (b[g] != (uint8_t)(tid + 1) || d != (32 << 10) || m != 8)
+            mfail++;
+          rt_release(h, wid);
+        }
+        if (n % 5 == 0) rt_delete(h, wid);
+      }
+      rt_store_close(h);
+    };
+    auto evictor = [&] {
+      void* h = rt_store_open(mpath.c_str());
+      if (!h) { mfail++; return; }
+      uint32_t nstripes = rt_num_stripes(h);
+      uint64_t sst[8];
+      while (!stop.load()) {
+        for (uint32_t i = 0; i < nstripes; i++) {
+          rt_stripe_stats(h, i, sst);
+          if (sst[0] > sst[1] / 2) rt_evict_stripe(h, i, sst[1] / 4);
+        }
+      }
+      rt_store_close(h);
+    };
+    auto poller = [&] {
+      void* h = rt_store_open(mpath.c_str());
+      if (!h) { mfail++; return; }
+      uint64_t pst[13];
+      uint64_t polls = 0;
+      while (!stop.load()) {
+        rt_stats(h, pst);
+        if (pst[8] != 0) mfail++;         // never poisoned
+        if (pst[0] > pst[1]) mfail++;     // in_use can't exceed capacity
+        polls++;
+      }
+      if (polls == 0) mfail++;
+      rt_store_close(h);
+    };
+    std::vector<std::thread> mts;
+    for (int t = 0; t < 4; t++) mts.emplace_back(mworker, t);
+    std::thread ev(evictor), po(poller);
+    for (auto& t : mts) t.join();
+    stop.store(true);
+    ev.join();
+    po.join();
+    CHECK(mfail.load() == 0);
+  }
+
+  // --- round-robin fallback when the home stripe is pinned full ----------
+  // ids 200001 and 200002 hash to the SAME home stripe (deterministic:
+  // fixed ids, fixed hash). Pinning the first at 0.7x stripe size leaves
+  // no room for the second in its home, so its create must re-home to the
+  // next stripe — and still succeed without evicting the pinned object.
+  {
+    uint64_t big = (kStripedArena / 4) * 7 / 10;
+    for (uint64_t n = 200001; n <= 200002; n++) {
+      uint8_t bid[kIdLen];
+      make_id(bid, n);
+      int64_t o = rt_create(ms, bid, big, 0, 1);
+      CHECK(o > 0);
+      CHECK(rt_seal(ms, bid) == 0);
+      CHECK(rt_get(ms, bid, &dsz, &msz, 1) > 0);  // hold the pin
+    }
+    uint64_t fst[13];
+    rt_stats(ms, fst);
+    CHECK(fst[11] >= 1);   // create_fallbacks
+    CHECK(fst[8] == 0);
+    for (uint64_t n = 200001; n <= 200002; n++) {
+      uint8_t bid[kIdLen];
+      make_id(bid, n);
+      CHECK(rt_contains(ms, bid) == 1);
+      CHECK(rt_release(ms, bid) == 0);
+      CHECK(rt_delete(ms, bid) == 0);
+    }
+  }
+
+  // --- robust-mutex crash repair (EOWNERDEAD mid-create) -----------------
+  // re-exec ourselves as a crash child armed to SIGKILL itself inside its
+  // 3rd rt_create while holding a stripe mutex; survivors must observe
+  // EOWNERDEAD, repair the poisoned stripe, and keep serving puts.
+  // (fork+exec, not fork: the chaos env is parsed once per process.)
+  {
+    pid_t pid = fork();
+    if (pid == 0) {
+      setenv("RAY_TPU_TESTING_SHM_FAILURE", "shm_create=3", 1);
+      execl(argv[0], argv[0], mpath.c_str(), "crashchild", (char*)nullptr);
+      _exit(9);
+    }
+    CHECK(pid > 0);
+    int wstatus = 0;
+    CHECK(waitpid(pid, &wstatus, 0) == pid);
+    CHECK(WIFSIGNALED(wstatus) && WTERMSIG(wstatus) == SIGKILL);
+
+    // survivors keep serving puts on every stripe
+    uint8_t* mb = rt_store_base(ms);
+    for (uint64_t n = 0; n < 64; n++) {
+      uint8_t rid[kIdLen];
+      make_id(rid, 300000 + n);
+      int64_t o = rt_create(ms, rid, 4096, 0, 1);
+      CHECK(o > 0);
+      memset(mb + o, 0x77, 4096);
+      CHECK(rt_seal(ms, rid) == 0);
+      int64_t g = rt_get(ms, rid, &dsz, &msz, 0);
+      CHECK(g > 0 && mb[g] == 0x77);
+    }
+    uint64_t rst[13];
+    rt_stats(ms, rst);
+    CHECK(rst[10] >= 1);   // the poisoned stripe was repaired
+    CHECK(rst[8] == 0);    // and is healthy again
+  }
+
+  // --- per-stripe list + aggregate coherence ----------------------------
+  {
+    uint64_t total = 0;
+    std::vector<uint8_t> ids(4096 * kIdLen);
+    for (uint32_t i = 0; i < rt_num_stripes(ms); i++)
+      total += rt_list_stripe(ms, i, ids.data(), 4096);
+    uint64_t lst[13];
+    rt_stats(ms, lst);
+    CHECK(total <= lst[2]);  // sealed <= all live objects
+  }
+
+  rt_store_close(ms);
+  remove(mpath.c_str());
   printf("shm_store_selftest: OK\n");
   return 0;
 }

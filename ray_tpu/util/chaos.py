@@ -8,14 +8,22 @@ The ``push_chunk`` spec key covers BOTH object-transfer transports: the
 legacy msgpack chunk RPCs and the binary data plane (data_plane.py runs
 the same injection hook before every raw chunk send, so
 ``RAY_TPU_TESTING_RPC_FAILURE="push_chunk=0.05"`` keeps exercising
-mid-stream transfer aborts after the zero-copy path landed)."""
+mid-stream transfer aborts after the zero-copy path landed).
+
+Shared-memory chaos lives in its own spec because the failure mode is a
+process DEATH, not an exception: ``RAY_TPU_TESTING_SHM_FAILURE=
+"shm_create=N"`` makes the armed process SIGKILL itself inside its Nth
+``rt_create`` while it HOLDS a stripe mutex mid-mutation (the hook is in
+shm_store.cpp) — the worst-case death the robust-mutex recovery path
+must repair from. Arm child client processes via ``ShmCreateKiller``."""
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 
 class NodeKiller:
@@ -59,6 +67,51 @@ class NodeKiller:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+
+
+class ShmCreateKiller:
+    """Arms a (child) process to SIGKILL itself mid-``rt_create`` while
+    holding a shared-arena stripe mutex — the object-store analog of
+    NodeKiller. The kill happens INSIDE the native create, after the
+    stripe's heap has been mutated but before the entry is published, so
+    survivors must hit ``EOWNERDEAD`` on that stripe's robust mutex,
+    repair it, and keep serving puts.
+
+    Usage::
+
+        killer = ShmCreateKiller(nth_create=3)
+        proc = ctx.Process(target=..., env-injected via killer.env())
+        # or: subprocess.Popen(..., env=killer.env())
+        killer.assert_killed(proc)   # died by SIGKILL, not cleanly
+    """
+
+    SPEC_ENV = "RAY_TPU_TESTING_SHM_FAILURE"
+
+    def __init__(self, nth_create: int = 1):
+        if nth_create < 1:
+            raise ValueError("nth_create must be >= 1")
+        self.nth_create = nth_create
+
+    def spec(self) -> str:
+        return f"shm_create={self.nth_create}"
+
+    def env(self, base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+        """Environment for the victim process (a copy; the arming env var
+        must never leak into the parent — the spec is parsed once per
+        process at first create)."""
+        e = dict(base if base is not None else os.environ)
+        e[self.SPEC_ENV] = self.spec()
+        return e
+
+    @staticmethod
+    def assert_killed(proc, timeout_s: float = 30.0) -> None:
+        """Join a multiprocessing.Process victim and assert it died by
+        SIGKILL (exitcode -9), i.e. the injection actually fired."""
+        proc.join(timeout_s)
+        if proc.exitcode != -9:
+            raise AssertionError(
+                f"victim exitcode {proc.exitcode!r}; expected -9 (SIGKILL "
+                "from the shm_create injection)")
 
 
 class ServeReplicaKiller:

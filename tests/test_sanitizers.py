@@ -38,13 +38,18 @@ def test_native_asan_selftest(name, shm):
 
 @pytest.mark.slow
 def test_native_tsan_concurrent_puts():
-    """The off-loop put path's native surface under ThreadSanitizer: the
+    """The put path's native surface under ThreadSanitizer: the
     selftest's concurrent sections run 4 caller threads through
     create/rt_write_parallel/seal/get on one arena plus the shared copy
-    pool (queue + per-batch completion handshake). Single-process
+    pool (queue + per-batch completion handshake), then hammer the
+    lock-striped arena — concurrent create/seal/get against a
+    per-stripe evictor and a lock-free rt_stats poller on a 4-stripe
+    store (the lock-free seal CAS and seqlock snapshot reads are the
+    racy surfaces this build exists to watch). Single-process
     multi-thread is the regime tsan models well; cross-process
-    robust-mutex recovery stays with the asan harness above. Any data
-    race on the allocator or pool aborts with a nonzero exit."""
+    robust-mutex EOWNERDEAD repair stays with the asan harness above
+    (re-exec'd crash child). Any data race aborts with a nonzero
+    exit."""
     from ray_tpu.native.build import build_selftest
     binary = build_selftest("shm_store_selftest", sanitize="thread")
     r = subprocess.run([binary, "/dev/shm/rt_selftest_tsan_pytest"],
