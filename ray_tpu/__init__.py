@@ -196,6 +196,10 @@ def _init_locked(address, num_cpus, resources, object_store_memory,
         worker.core.job_id = job_id
         worker_mod.global_worker = worker
         _context = _Context(worker, node, owns_node, job_id)
+        # a prior shutdown() in this process retired the metrics pusher;
+        # metrics registered back then must resume pushing now
+        from ray_tpu.util import metrics as _metrics
+        _metrics.resume_pusher()
         atexit.register(shutdown)
         return _context
 
@@ -216,6 +220,10 @@ def shutdown():
             ctx.node.kill()
         from ray_tpu._private import worker as worker_mod
         worker_mod.global_worker = None
+        # retire the registry pusher: without a worker it would spin on
+        # is_initialized() forever (resume_pusher on the next init)
+        from ray_tpu.util import metrics as _metrics
+        _metrics.stop_pusher()
         # undo _system_config exports so a later init (or unrelated
         # tooling spawned from this process) doesn't inherit stale values
         _drain_config_exports()
@@ -279,16 +287,24 @@ def cancel(ref: ObjectRef, *, force: bool = False):
 def timeline(filename: Optional[str] = None):
     """Export the unified timeline — task executions PLUS the flight
     recorder's runtime events (engine steps, spills, shuffle windows,
-    serve phases as per-subsystem tracks) — as a chrome://tracing JSON
-    (reference: `ray timeline`, python/ray/_private/state.py chrome
+    serve phases as per-subsystem tracks) PLUS gauge time-series as
+    counter tracks (slot occupancy, queue depth) — as a chrome://tracing
+    JSON (reference: `ray timeline`, python/ray/_private/state.py chrome
     trace export)."""
     import json
 
     from ray_tpu._private import events as _events
+    from ray_tpu.util.metrics import push_once as _push_metrics
     from ray_tpu.util.tracing import task_events_to_chrome
     _events.flush()     # this process's buffered spans make the export
+    _push_metrics()     # ...and its freshest gauge samples
     rows = _get_worker().gcs_call("list_task_events", limit=20000)
-    events = task_events_to_chrome(rows)
+    try:
+        series = _get_worker().gcs_call("dump_metric_series",
+                                        kinds=["gauge"])
+    except Exception:
+        series = None   # older GCS without the time-series plane
+    events = task_events_to_chrome(rows, gauge_series=series)
     if filename:
         with open(filename, "w") as f:
             json.dump(events, f)

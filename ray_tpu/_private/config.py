@@ -200,6 +200,20 @@ _flag("slice_wait_timeout_s", float, 60.0,
       "failing the attempt.")
 _flag("spill_low_watermark", float, 0.6,
       "Spilling stops once arena utilization falls below this fraction.")
+# Observability: time-series metrics plane (GCS) + registry pusher
+_flag("metrics_push_interval_s", float, 2.0,
+      "Base cadence of the per-process metrics registry push to the GCS "
+      "(each push is jittered +/-25% so a fleet of workers doesn't "
+      "synchronize on the control plane).")
+_flag("metrics_ts_retention_s", float, 600.0,
+      "How far back the GCS time-series plane keeps metric samples; "
+      "windowed query_metrics() calls can look back at most this far.")
+_flag("metrics_ts_max_samples", int, 600,
+      "Per-series ring capacity in the GCS time-series plane (at the "
+      "2s push cadence, 600 samples ~= 20 minutes per pushing process).")
+_flag("metrics_ts_max_series", int, 4096,
+      "Total (metric, tags, worker) series the GCS time-series plane "
+      "retains; new series past the cap are counted and dropped.")
 # NOTE: RPC chaos injection is configured through rpc.py's own
 # RAY_TPU_TESTING_RPC_FAILURE spec string ("method=prob"), not a flag here.
 

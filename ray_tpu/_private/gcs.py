@@ -80,6 +80,14 @@ class GcsServer:
         # rows) share this ring with task rows; sized so a burst of
         # engine-step spans can't evict the whole task timeline
         self.max_task_events = 20000
+        # time-series plane over report_metrics pushes (metrics_ts.py):
+        # bounded per-series rings answering windowed queries (rate /
+        # percentiles) that the latest-snapshot table cannot
+        from ray_tpu._private.metrics_ts import MetricsTimeSeries
+        self.metrics_ts = MetricsTimeSeries(
+            retention_s=cfg.metrics_ts_retention_s,
+            max_samples=cfg.metrics_ts_max_samples,
+            max_series=cfg.metrics_ts_max_series)
         self.server = None
 
     # ------------------------------------------------------------- lifecycle
@@ -115,6 +123,9 @@ class GcsServer:
             "report_metrics": self.h_report_metrics,
             "get_metrics": self.h_get_metrics,
             "drop_worker_metrics": self.h_drop_worker_metrics,
+            "query_metrics": self.h_query_metrics,
+            "list_metric_series": self.h_list_metric_series,
+            "dump_metric_series": self.h_dump_metric_series,
             "list_task_events": self.h_list_task_events,
             "ping": lambda conn: "pong",
         }
@@ -682,35 +693,70 @@ class GcsServer:
 
     # --------------------------------------------------------------- pubsub
     def h_report_metrics(self, conn, worker_id: str, metrics: list,
-                         node_id: Optional[str] = None):
+                         node_id: Optional[str] = None,
+                         ts: Optional[float] = None):
         """Per-process metric snapshots (reference: the per-node metrics
         agent collecting OpenCensus exports, metrics_agent.py:483).
         node_id tags the snapshot's host so a node death can retire it
         — a dead worker's gauges would otherwise sit in /metrics
         forever. Counters flushed by a CLEAN worker shutdown survive
-        (the node is still alive then)."""
+        (the node is still alive then). Each push also feeds the
+        time-series plane (ts overrides the sample timestamp — tests
+        drive deterministic windows with it)."""
         if not hasattr(self, "metrics"):
             self.metrics = {}
             self.metrics_node: Dict[str, Optional[str]] = {}
         self.metrics[worker_id] = metrics
         self.metrics_node[worker_id] = node_id
+        try:
+            self.metrics_ts.ingest(worker_id, metrics, ts=ts)
+        except Exception:
+            logger.exception("metrics time-series ingest failed")
         return True
 
     def h_get_metrics(self, conn):
         return getattr(self, "metrics", {})
+
+    def h_query_metrics(self, conn, name: str, window: float = 60.0,
+                        agg: str = "avg",
+                        tags: Optional[Dict[str, str]] = None,
+                        threshold: Optional[float] = None,
+                        now: Optional[float] = None):
+        """Windowed aggregate over the time-series plane. agg: rate /
+        sum / avg / max / min / latest, p50 / p90 / p95 / p99 /
+        frac_over (histograms, reconstructed from bucket deltas),
+        buckets (raw merged window), series (raw samples)."""
+        return self.metrics_ts.query(name, window_s=window, agg=agg,
+                                     tags=tags, threshold=threshold,
+                                     now=now)
+
+    def h_list_metric_series(self, conn):
+        return self.metrics_ts.list_series()
+
+    def h_dump_metric_series(self, conn, window: float = 600.0,
+                             names: Optional[List[str]] = None,
+                             kinds: Optional[List[str]] = None,
+                             now: Optional[float] = None):
+        return self.metrics_ts.dump_series(window_s=window, names=names,
+                                           kinds=kinds, now=now)
 
     def _drop_node_metrics(self, node_id: str):
         node_of = getattr(self, "metrics_node", {})
         for wid in [w for w, n in node_of.items() if n == node_id]:
             getattr(self, "metrics", {}).pop(wid, None)
             node_of.pop(wid, None)
+            self.metrics_ts.drop_worker(wid)
 
     def h_drop_worker_metrics(self, conn, worker_id: str):
         """Node managers report crashed/killed workers here so their
         gauges don't sit in /metrics forever. Clean DRIVER shutdowns
-        never route through this — their final counter flush persists."""
+        never route through this — their final counter flush persists.
+        The worker's time-series HISTORY stays (it is history; retention
+        ages it out) but its delta baselines go, so a reused worker id
+        can't fake a counter reset."""
         getattr(self, "metrics", {}).pop(worker_id, None)
         getattr(self, "metrics_node", {}).pop(worker_id, None)
+        self.metrics_ts.drop_worker(worker_id)
         return True
 
     def h_subscribe(self, conn, channel: str):

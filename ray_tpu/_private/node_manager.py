@@ -259,6 +259,7 @@ class NodeManager:
             asyncio.ensure_future(self._reap_children_loop()),
             asyncio.ensure_future(self._memory_monitor_loop()),
             asyncio.ensure_future(self._spill_loop()),
+            asyncio.ensure_future(self._metrics_push_loop()),
         ]
         logger.info("node manager %s at %s (store %s, %s)",
                     self.node_id[:12], self.address, self.store_path,
@@ -404,6 +405,67 @@ class NodeManager:
             avail["object_store_memory"] = max(
                 0.0, float(self.store_bytes - st["bytes_in_use"]))
         return avail
+
+    def _observability_metrics(self) -> list:
+        """The node manager's own registry-shaped snapshots. Data-plane
+        byte/chunk/connection counters were only visible via
+        get_node_info; exporting them here lands them in /metrics AND
+        the GCS time-series plane (so `query_metrics(
+        "data_plane_bytes_in_total", 30, "rate")` reads live transfer
+        bandwidth). Counters are cumulative — the TS ingest diffs them."""
+        from ray_tpu.util.metrics import counter_snapshot, gauge_snapshot
+        tags = {"node": self.node_id[:12]}
+        rows = [gauge_snapshot("node_workers", len(self.workers),
+                               "live worker processes", tags)]
+        if self.store is not None:
+            try:
+                st = self.store.stats()
+                rows.append(gauge_snapshot(
+                    "store_bytes_in_use", st["bytes_in_use"],
+                    "shared-memory arena bytes in use", tags))
+            except Exception:
+                pass
+        if self._data_server is not None:
+            ds, dc = self._data_server, self._data_client
+            rows += [
+                counter_snapshot("data_plane_bytes_in_total", ds.bytes_in,
+                                 "data-plane payload bytes received",
+                                 tags),
+                counter_snapshot("data_plane_chunks_in_total",
+                                 ds.chunks_in,
+                                 "data-plane chunks received", tags),
+                counter_snapshot("data_plane_bytes_out_total",
+                                 dc.bytes_out,
+                                 "data-plane payload bytes sent", tags),
+                counter_snapshot("data_plane_chunks_out_total",
+                                 dc.chunks_out,
+                                 "data-plane chunks sent", tags),
+                gauge_snapshot("data_plane_active_conns", ds.active_conns,
+                               "live inbound data-plane connections",
+                               tags),
+                gauge_snapshot("data_plane_receiving",
+                               len(self._receiving),
+                               "objects with an in-progress receive",
+                               tags),
+            ]
+        return rows
+
+    async def _metrics_push_loop(self):
+        """The node manager is a daemon, not a worker — the registry
+        pusher in util/metrics.py can't carry its counters. Push them
+        through its own GCS connection on the same jittered cadence."""
+        import random
+        while True:
+            await asyncio.sleep(
+                cfg.metrics_push_interval_s * random.uniform(0.75, 1.25))
+            try:
+                await self.gcs.notify(
+                    "report_metrics",
+                    worker_id=f"nm:{self.node_id[:12]}",
+                    node_id=self.node_id,
+                    metrics=self._observability_metrics())
+            except Exception:
+                pass        # reconnect handled by the heartbeat loop
 
     async def _view_refresh_loop(self):
         # versioned delta pull with a periodic full resync as drift guard;

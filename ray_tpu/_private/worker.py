@@ -2914,6 +2914,13 @@ class CoreWorker:
                                         metrics=payload), 1.0)
             except Exception:
                 pass
+        # retire the registry pusher thread — a stopped worker must not
+        # leave it spinning on is_initialized() forever
+        try:
+            from ray_tpu.util import metrics as _metrics
+            _metrics.stop_pusher()
+        except Exception:
+            pass
         # cancel-and-await every background task (senders, dispatchers,
         # flushers, probes) BEFORE closing connections: nothing may outlive
         # shutdown (no "Task was destroyed but it is pending!")

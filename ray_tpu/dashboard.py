@@ -37,6 +37,8 @@ class DashboardServer:
         r.add_get("/api/jobs/{job_id}/logs", self._job_logs)
         r.add_post("/api/jobs/{job_id}/stop", self._job_stop)
         r.add_get("/api/version", self._version)
+        r.add_get("/api/metrics/query", self._metrics_query)
+        r.add_get("/api/metrics/series", self._metrics_series)
         r.add_get("/metrics", self._metrics)
         r.add_get("/healthz", self._healthz)
         r.add_get("/", self._index)
@@ -77,6 +79,36 @@ class DashboardServer:
         all_metrics = await self._in_thread(fetch)
         return web.Response(text=render_prometheus(all_metrics),
                             content_type="text/plain")
+
+    async def _metrics_query(self, request):
+        """Windowed time-series query: ?name=serve_llm_ttft_ms&window=30
+        &agg=p95[&threshold=...][&tags={"k":"v"}] — the HTTP face of the
+        GCS query_metrics call (util/state.query_metrics)."""
+        from aiohttp import web
+        from ray_tpu.util import state
+        name = request.query.get("name")
+        if not name:
+            return web.json_response({"error": "name is required"},
+                                     status=400)
+        try:
+            window = float(request.query.get("window", 60.0))
+            agg = request.query.get("agg", "avg")
+            threshold = request.query.get("threshold")
+            threshold = float(threshold) if threshold is not None else None
+            tags = request.query.get("tags")
+            tags = json.loads(tags) if tags else None
+        except (ValueError, json.JSONDecodeError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        out = await self._in_thread(
+            lambda: state.query_metrics(name, window=window, agg=agg,
+                                        tags=tags, threshold=threshold))
+        return web.json_response(out)
+
+    async def _metrics_series(self, request):
+        from aiohttp import web
+        from ray_tpu.util import state
+        return web.json_response(
+            await self._in_thread(state.list_metric_series))
 
     async def _version(self, request):
         from aiohttp import web

@@ -70,6 +70,10 @@ class EngineConfig:
     top_k: int = 0
     top_p: float = 1.0
     cache_dtype: Any = None       # default: model activation dtype
+    # per-step time/FLOP attribution (util/profiling.py): emits
+    # runtime_decode_step_mfu + compute/host-gap/data-wait phase gauges;
+    # the observability-overhead bench toggles this off for its baseline
+    step_profile: bool = True
 
 
 class InferenceEngine:
@@ -142,6 +146,19 @@ class InferenceEngine:
         # flight-recorder root for engine-owned work that belongs to no
         # single request (multi-request decode batches)
         self._trace_id = events.new_trace_id()
+        # step attribution: decode FLOPs are computed analytically
+        # (re-lowering the decode program for cost_analysis would trip
+        # the compile-once invariant the tests assert on)
+        self.profiler = None
+        if cfg.step_profile:
+            from ray_tpu.util import profiling
+            leaves = jax.tree_util.tree_leaves(params)
+            self._n_params = int(sum(x.size for x in leaves))
+            self._param_bytes = float(sum(
+                x.size * getattr(x.dtype, "itemsize", 4) for x in leaves))
+            self._kv_elt_bytes = float(jnp.dtype(dtype).itemsize)
+            self.profiler = profiling.StepProfiler(
+                "decode_step", emit_span=False)
         self._build_fns()
 
     # ------------------------------------------------------------ device fns
@@ -290,6 +307,7 @@ class InferenceEngine:
         import jax
 
         with self._lock:
+            t_iter0 = time.perf_counter()
             now = time.monotonic()
             for st in self.sched.reap(now):
                 self._scratch.pop(st.rid, None)
@@ -298,6 +316,7 @@ class InferenceEngine:
             for ch in chunks:
                 self._run_prefill_chunk(ch, now)
                 did = True
+            t_admit = time.perf_counter()
 
             # capacity eviction BEFORE the step: a full slot has nowhere
             # to write its next token
@@ -328,6 +347,7 @@ class InferenceEngine:
                     slots_occupied=self.sched.occupancy(),
                     queue_depth=self.sched.queue_depth())
                 compiles0 = self.decode_compile_count
+                t_dec0 = time.perf_counter()
                 with self._mesh_ctx():
                     toks, self._pool_k, self._pool_v, self._rng = \
                         self._decode_fn(
@@ -335,6 +355,10 @@ class InferenceEngine:
                             self._lengths, self._last_tok, self._rng,
                             self._temps)
                 toks_host = np.asarray(toks)
+                t_dec1 = time.perf_counter()
+                # capture before decode_emit: an evicted state's slot is
+                # None by the time the profiler reads it
+                slots = [st.slot for st in active]
                 now = time.monotonic()
                 for st in active:
                     slot = st.slot
@@ -350,7 +374,12 @@ class InferenceEngine:
                         "engine.compile", category="engine",
                         trace_id=d_trace, parent_span_id=dspan.span_id,
                         fn="decode", compile_count=self.decode_compile_count)
-                dspan.end(tokens=len(active))
+                attribution = {}
+                if self.profiler is not None:
+                    attribution = self._profile_decode(
+                        [int(self._lengths[s]) for s in slots],
+                        t_iter0, t_admit, t_dec0, t_dec1)
+                dspan.end(tokens=len(active), **attribution)
                 did = True
             self.steps += 1
             if self.on_step is not None:
@@ -359,6 +388,28 @@ class InferenceEngine:
                 except Exception:
                     pass
             return did
+
+    def _profile_decode(self, kv_lens, t_iter0, t_admit, t_dec0, t_dec1):
+        """Per-step attribution: decode compute vs prefill/admission work
+        ("data wait" — tokens can't advance while it runs) vs host gap
+        (scheduler bookkeeping + idle between steps). Returns the attrs
+        attached to the engine.decode span (mfu + phase ms) so the
+        timeline answers the stuck-MFU question inline."""
+        from ray_tpu.util import profiling
+        mcfg = self.model.cfg
+        flops = profiling.decode_step_flops(
+            self._n_params, mcfg.n_layers, mcfg.n_heads, mcfg.head_dim,
+            kv_lens)
+        nbytes = profiling.decode_step_bytes(
+            self._param_bytes, mcfg.n_layers, mcfg.n_kv_heads,
+            mcfg.head_dim, kv_lens, self._kv_elt_bytes)
+        rec = self.profiler.observe(
+            compute_s=t_dec1 - t_dec0, data_s=t_admit - t_iter0,
+            begin_t=t_iter0, end_t=t_dec1, tokens=len(kv_lens),
+            flops=flops, bytes_accessed=nbytes)
+        return {k: rec[k] for k in ("mfu", "mfu_compute", "compute_ms",
+                                    "host_gap_ms", "data_wait_ms",
+                                    "roofline_bound") if k in rec}
 
     def _run_prefill_chunk(self, ch: PrefillChunk, now: float):
         import jax

@@ -192,12 +192,19 @@ def make_train_fns(model: nn.Module, optimizer,
                    mesh: Mesh, rules=None,
                    batch_shape: Tuple[int, int] = (8, 512),
                    loss_chunk: Optional[int] = None,
+                   profiler=None,
                    ) -> Tuple[Callable, Callable, Any]:
     """Returns (init_fn(rng) -> TrainState, step_fn(state, batch) ->
     (state, metrics), state_sharding_tree). Both are jitted with explicit
     shardings over `mesh`. loss_chunk enables the chunked cross-entropy
     (compute logits `loss_chunk` positions at a time — see
-    chunked_cross_entropy; required to fit the larger registry rungs)."""
+    chunked_cross_entropy; required to fit the larger registry rungs).
+
+    profiler: an optional util.profiling.StepProfiler; the returned
+    step_fn then AOT-compiles once per shape (cost_analysis FLOPs feed
+    the profiler) and each call is attributed compute-vs-host-gap and
+    blocked on the loss, emitting runtime_<name>_mfu gauges + timeline
+    spans (the in-runtime answer to the stuck train_step_mfu ratchet)."""
     rules = rules or sharding_lib.DEFAULT_RULES
     tokens0 = jnp.zeros(batch_shape, jnp.int32)
 
@@ -279,9 +286,19 @@ def make_train_fns(model: nn.Module, optimizer,
     # jit(step) traces the model outside use_mesh; wrap so tracing also sees
     # the mesh context (shard_map islands need the concrete mesh at trace
     # time, and trace happens at first call)
+    profiled_step = profiler.wrap_jit(jit_step) if profiler is not None \
+        else None
+
     def step_with_mesh(state, tokens, mask=None):
-        with use_mesh(mesh):
-            return jit_step(state, tokens, mask)
+        if profiler is None:
+            with use_mesh(mesh):
+                return jit_step(state, tokens, mask)
+        with profiler.step(tokens=int(tokens.size)) as sc:
+            sc.data_ready()
+            with use_mesh(mesh):
+                out = profiled_step(state, tokens, mask)
+            sc.block(out[1]["loss"])
+        return out
 
     def init_with_mesh(rng):
         with use_mesh(mesh):

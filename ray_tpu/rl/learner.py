@@ -82,6 +82,15 @@ class JaxLearner:
         self._update = update
         self._grads_only = grads_only
         self._apply_grads = apply_grads
+        # per-minibatch time/FLOP attribution: wrap_jit AOT-compiles each
+        # minibatch shape once (cost_analysis comes free) and the update
+        # loop marks data-build vs compute, so the learner emits
+        # runtime_rl_update_mfu + phase gauges into /metrics and the GCS
+        # time-series plane (util/profiling.py)
+        from ray_tpu.util.profiling import StepProfiler
+        self.profiler = StepProfiler("rl_update", emit_span=False,
+                                     emit_every=8)
+        self._update_profiled = self.profiler.wrap_jit(self._update)
 
     def update_from_batch(self, batch: Dict[str, np.ndarray]) -> Dict:
         import jax.numpy as jnp
@@ -91,10 +100,15 @@ class JaxLearner:
         for _ in range(self.cfg["num_epochs"]):
             idx = rng.permutation(n)
             for start in range(0, n, mb):
-                sel = idx[start:start + mb]
-                mini = {k: jnp.asarray(v[sel]) for k, v in batch.items()}
-                self.module.params, self.opt_state, loss, aux = \
-                    self._update(self.module.params, self.opt_state, mini)
+                with self.profiler.step(tokens=min(mb, n - start)) as sc:
+                    sel = idx[start:start + mb]
+                    mini = {k: jnp.asarray(v[sel])
+                            for k, v in batch.items()}
+                    sc.data_ready()
+                    self.module.params, self.opt_state, loss, aux = \
+                        self._update_profiled(self.module.params,
+                                              self.opt_state, mini)
+                    sc.block(loss)
                 self.num_updates += 1
         metrics = {k: float(v) for k, v in aux.items()}
         metrics["total_loss"] = float(loss)

@@ -87,10 +87,7 @@ def cmd_stop(args):
         pass
 
 
-def cmd_status(args):
-    import ray_tpu
-    from ray_tpu.util import state
-    ray_tpu.init(address=_load_address(args))
+def _status_summary(ray_tpu, state):
     summary = state.cluster_summary()
     # autoscaler view: aggregate queued lease demand per resource shape
     # (reference: `ray status` resource demand section)
@@ -101,7 +98,75 @@ def cmd_status(args):
             demand[key] = demand.get(key, 0) + 1
     summary["pending_demand"] = [
         {"shape": json.loads(k), "count": v} for k, v in demand.items()]
-    print(json.dumps(summary, indent=2, default=str))
+    return summary
+
+
+def _fmt_metric(v):
+    if v is None:
+        return "-"
+    if abs(v) >= 1e6:
+        return f"{v:,.0f}"
+    if abs(v) >= 100:
+        return f"{v:.1f}"
+    return f"{v:.3f}"
+
+
+def _metrics_table(state, window: float, max_rows: int = 40) -> str:
+    """One line per live metric: counters show rate, gauges latest+avg,
+    histograms p50/p95 + observation rate — all windowed over the GCS
+    time-series plane."""
+    lines = [f"{'METRIC':<40} {'KIND':<10} {'WINDOW':>7}  VALUES"]
+    for row in state.list_metric_series()[:max_rows]:
+        name, kind = row["name"], row["kind"]
+        try:
+            if kind == "counter":
+                rate = state.query_metrics(name, window, "rate")["value"]
+                vals = f"rate/s={_fmt_metric(rate)}"
+            elif kind == "histogram":
+                p50 = state.query_metrics(name, window, "p50")["value"]
+                p95 = state.query_metrics(name, window, "p95")["value"]
+                rate = state.query_metrics(name, window, "rate")["value"]
+                vals = (f"p50={_fmt_metric(p50)} p95={_fmt_metric(p95)} "
+                        f"obs/s={_fmt_metric(rate)}")
+            else:
+                cur = state.query_metrics(name, window, "latest")["value"]
+                avg = state.query_metrics(name, window, "avg")["value"]
+                vals = f"latest={_fmt_metric(cur)} avg={_fmt_metric(avg)}"
+        except Exception as e:
+            vals = f"<query failed: {e}>"
+        lines.append(f"{name:<40} {kind:<10} {window:>6.0f}s  {vals}")
+    if len(lines) == 1:
+        lines.append("  (no metrics pushed yet)")
+    return "\n".join(lines)
+
+
+def cmd_status(args):
+    import ray_tpu
+    from ray_tpu.util import state
+    ray_tpu.init(address=_load_address(args))
+    if not getattr(args, "watch", False):
+        print(json.dumps(_status_summary(ray_tpu, state), indent=2,
+                         default=str))
+        return
+    # --watch: live terminal view over the time-series plane (reference:
+    # `ray status` is point-in-time; the TS plane makes a refresh loop
+    # show windowed rates/percentiles instead of instants)
+    interval = max(0.5, float(getattr(args, "interval", 2.0)))
+    window = float(getattr(args, "window", 30.0))
+    try:
+        while True:
+            summary = _status_summary(ray_tpu, state)
+            table = _metrics_table(state, window)
+            sys.stdout.write("\x1b[2J\x1b[H")   # clear + home
+            print(f"ray_tpu status --watch  (refresh {interval:.1f}s, "
+                  f"window {window:.0f}s, ctrl-c to exit)\n")
+            print(json.dumps(summary, default=str))
+            print()
+            print(table)
+            sys.stdout.flush()
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        pass
 
 
 def cmd_up(args):
@@ -235,6 +300,12 @@ def cmd_serve_status(args):
     from ray_tpu import serve
     ray_tpu.init(address=_load_address(args), ignore_reinit_error=True)
     out = {"applications": serve.status(), "proxies": serve.proxies()}
+    try:
+        slo = serve.slo_status()
+        if any(slo.values()):
+            out["slo"] = slo
+    except Exception:
+        pass
     print(json.dumps(out, indent=2, default=str))
 
 
@@ -293,6 +364,13 @@ def main(argv=None):
 
     pst = sub.add_parser("status")
     pst.add_argument("--address", default=None)
+    pst.add_argument("--watch", "-w", action="store_true",
+                     help="live view: refresh cluster summary + windowed "
+                          "metrics (rates / p50 / p95) until ctrl-c")
+    pst.add_argument("--interval", type=float, default=2.0,
+                     help="--watch refresh cadence in seconds")
+    pst.add_argument("--window", type=float, default=30.0,
+                     help="--watch metric aggregation window in seconds")
     pst.set_defaults(fn=cmd_status)
 
     pl = sub.add_parser("list")

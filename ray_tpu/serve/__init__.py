@@ -1,5 +1,6 @@
 from ray_tpu.serve.api import (batch, delete, deployment, get_app_handle,
-                               proxies, run, shutdown, start, status)
+                               proxies, run, shutdown, slo_status, start,
+                               status)
 from ray_tpu.serve.grpc_proxy import grpc_call
 from ray_tpu.serve.schema import deploy_from_config
 from ray_tpu.serve.deployment import Application, Deployment
@@ -10,4 +11,4 @@ __all__ = ["deployment", "run", "shutdown", "status", "batch", "delete",
            "get_app_handle", "Deployment", "Application",
            "DeploymentHandle", "DeploymentResponse", "multiplexed",
            "get_multiplexed_model_id", "start", "proxies", "grpc_call",
-           "deploy_from_config"]
+           "deploy_from_config", "slo_status"]

@@ -21,7 +21,8 @@ _controller_handle = None
 def deployment(_func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 16,
                ray_actor_options: Optional[Dict] = None,
-               autoscaling_config=None, num_hosts: int = 1,
+               autoscaling_config=None, slo_config=None,
+               num_hosts: int = 1,
                topology: Optional[str] = None, **_ignored):
     def wrap(target):
         cfg = DeploymentConfig(
@@ -34,6 +35,9 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                 AutoscalingConfig(**autoscaling_config)
                 if isinstance(autoscaling_config, dict)
                 else autoscaling_config)
+        if slo_config is not None:
+            from ray_tpu.serve.deployment import _coerce_slo
+            cfg.slo_config = _coerce_slo(slo_config)
         return Deployment(target, name or target.__name__, cfg)
 
     if _func_or_class is not None:
@@ -168,6 +172,14 @@ def get_app_handle(name: str = "default") -> DeploymentHandle:
 
 def status() -> Dict:
     return ray_tpu.get(_get_controller().get_status.remote(), timeout=30)
+
+
+def slo_status() -> Dict:
+    """Latest burn-rate evaluation per declared SLO objective:
+    {app: {deployment: [{objective, burn_fast, burn_slow, violating,
+    ...}]}}."""
+    return ray_tpu.get(_get_controller().get_slo_status.remote(),
+                       timeout=30)
 
 
 def delete(name: str = "default"):

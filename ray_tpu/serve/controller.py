@@ -79,6 +79,10 @@ class ServeController:
         # listeners (reference: LongPollHost, _private/long_poll.py:177 —
         # config push instead of client polling)
         self._versions: Dict[str, int] = {"routes": 0}
+        # SLO burn-rate engine (serve/slo.py): evaluated each reconcile
+        # tick against the GCS time-series plane for deployments that
+        # declared slo_config
+        self._slo_tracker = None
         self._longpoll = threading.Condition()
         self._proxy_reconcile_lock = threading.Lock()
         self._thread = threading.Thread(target=self._reconcile_loop,
@@ -491,6 +495,7 @@ class ServeController:
         for r in dead:
             self._kill_replica(dep, r)
         self._publish_loads(dep, lens)
+        self._evaluate_slo(app_name, name, dep)
         # slow construction (sharded gangs: pg wait + jax.distributed
         # init + model load) runs on its own thread so ONE rebuilding
         # deployment never stalls the others' health checks — the
@@ -590,6 +595,43 @@ class ServeController:
             if lens != dep.get("loads"):
                 dep["loads"] = lens
                 self._bump_dep(dep)
+
+    def _evaluate_slo(self, app_name: str, name: str, dep: Dict):
+        """Burn-rate evaluation over the GCS time-series plane: exports
+        slo_burn_rate/slo_violating gauges and emits slo.violation /
+        slo.recovered runtime events on transitions (the signal ROADMAP
+        item 2's autoscaling loop consumes)."""
+        slo = (dep["spec"]["config"] or {}).get("slo_config")
+        if not slo:
+            return
+        if self._slo_tracker is None:
+            from ray_tpu.serve.slo import SloTracker
+            self._slo_tracker = SloTracker()
+        import ray_tpu
+
+        def query(metric, window=60.0, agg="avg", tags=None,
+                  threshold=None):
+            return ray_tpu._get_worker().gcs_call(
+                "query_metrics", name=metric, window=window, agg=agg,
+                tags=tags, threshold=threshold)
+
+        try:
+            rows = self._slo_tracker.update(app_name, name, slo, query)
+            with self._lock:
+                dep["slo_status"] = rows
+        except Exception:
+            logger.exception("SLO evaluation failed for %s/%s",
+                             app_name, name)
+
+    def get_slo_status(self) -> Dict:
+        """{app: {deployment: [objective rows]}} for declared SLOs."""
+        with self._lock:
+            return {
+                app_name: {
+                    name: list(dep.get("slo_status") or [])
+                    for name, dep in app.items()
+                    if (dep["spec"]["config"] or {}).get("slo_config")}
+                for app_name, app in self.apps.items()}
 
     def get_deployment_info(self, app_name: str, name: str) -> Dict:
         with self._lock:

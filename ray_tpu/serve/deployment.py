@@ -29,6 +29,10 @@ class DeploymentConfig:
     max_ongoing_requests: int = 16
     ray_actor_options: Optional[Dict[str, Any]] = None
     autoscaling_config: Optional[AutoscalingConfig] = None
+    # SLO objectives (serve/slo.py SloConfig): the controller evaluates
+    # fast/slow-window burn rates against the GCS time-series plane and
+    # exports slo_burn_rate gauges + slo.violation timeline events
+    slo_config: Optional["Any"] = None
     health_check_period_s: float = 5.0
     # multi-host (slice-sharded) replicas: num_hosts > 1 makes each
     # replica a gang of ReplicaShard actors joined into one
@@ -37,6 +41,14 @@ class DeploymentConfig:
     # (serve/sharded_replica.py; SURVEY §7.2-10)
     num_hosts: int = 1
     topology: Optional[str] = None
+
+
+def _coerce_slo(slo):
+    """Accept an SloConfig or a plain dict (YAML configs)."""
+    if isinstance(slo, dict):
+        from ray_tpu.serve.slo import SloConfig
+        return SloConfig(**slo)
+    return slo
 
 
 class Deployment:
@@ -49,10 +61,12 @@ class Deployment:
                 num_replicas: Optional[int] = None,
                 max_ongoing_requests: Optional[int] = None,
                 ray_actor_options: Optional[Dict] = None,
-                autoscaling_config=None,
+                autoscaling_config=None, slo_config=None,
                 num_hosts: Optional[int] = None,
                 topology: Optional[str] = None) -> "Deployment":
         cfg = dataclasses.replace(self.config)
+        if slo_config is not None:
+            cfg.slo_config = _coerce_slo(slo_config)
         if num_replicas is not None:
             cfg.num_replicas = num_replicas
         if num_hosts is not None:

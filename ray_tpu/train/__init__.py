@@ -3,9 +3,11 @@ from ray_tpu.train.config import (CheckpointConfig, DataConfig,
                                   FailureConfig, RunConfig,
                                   ScalingConfig)
 from ray_tpu.train.session import (get_checkpoint, get_context,
-                                   get_dataset_shard, report)
+                                   get_dataset_shard, report,
+                                   step_profiler)
 from ray_tpu.train.trainer import JaxTrainer, Result
 
 __all__ = ["JaxTrainer", "Result", "ScalingConfig", "RunConfig",
            "FailureConfig", "CheckpointConfig", "DataConfig", "Checkpoint",
-           "report", "get_context", "get_checkpoint", "get_dataset_shard"]
+           "report", "get_context", "get_checkpoint", "get_dataset_shard",
+           "step_profiler"]

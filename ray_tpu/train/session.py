@@ -95,6 +95,33 @@ def get_checkpoint() -> Optional[Checkpoint]:
     return s.latest_checkpoint if s else None
 
 
+_step_profiler = None
+
+
+def step_profiler():
+    """The training loop's StepProfiler (util/profiling.py), named
+    ``train_step`` so its gauges land as ``runtime_train_step_mfu`` +
+    phase attribution. One per process: inside a training worker every
+    epoch shares it; outside (bare scripts, tests) it still works — the
+    gauges just push from whatever process runs the loop.
+
+    Usage inside ``train_loop_per_worker``::
+
+        prof = ray_tpu.train.step_profiler()
+        step = prof.wrap_jit(jitted_step)          # cost_analysis FLOPs
+        for batch in loader:
+            with prof.step(tokens=batch.size) as s:
+                s.data_ready()
+                state, metrics = step(state, batch)
+                s.block(metrics["loss"])
+    """
+    global _step_profiler
+    if _step_profiler is None:
+        from ray_tpu.util.profiling import StepProfiler
+        _step_profiler = StepProfiler("train_step")
+    return _step_profiler
+
+
 def get_dataset_shard(name: str = "train"):
     """This worker's streaming shard of a Dataset passed to the trainer
     (reference: ray.train.get_dataset_shard — DataIterator per worker)."""

@@ -44,8 +44,15 @@ class JaxTrainer:
         self.resume_from_checkpoint = resume_from_checkpoint
 
     def fit(self) -> Result:
+        from ray_tpu._private import events
         rc = self.run_config
         name = rc.name or f"train_{int(time.time())}"
+        with events.record_span("train.fit", category="train",
+                                run_name=name):
+            return self._fit(name, rc)
+
+    def _fit(self, name: str, rc) -> Result:
+        from ray_tpu._private import events
         from ray_tpu.util import storage as _storage
         storage = rc.storage_path or os.path.join(
             tempfile.gettempdir(), "ray_tpu_results")
@@ -88,6 +95,14 @@ class JaxTrainer:
                                            "_timestamp": time.time()}
                                 history.append(metrics)
                                 last_metrics = metrics
+                                # reported train metrics become timeline
+                                # instants so loss/MFU curves line up
+                                # with the runtime spans around them
+                                events.record_instant(
+                                    "train.report", category="train",
+                                    run_name=name,
+                                    **{k: v for k, v in metrics.items()
+                                       if isinstance(v, (int, float))})
                                 if ckpt is not None:
                                     manager.register(ckpt, metrics)
                     done, error = executor.finished()

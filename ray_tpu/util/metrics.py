@@ -8,13 +8,19 @@ Prometheus text format.
 
 from __future__ import annotations
 
+import logging
+import random
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+logger = logging.getLogger(__name__)
+
 _registry: Dict[str, "Metric"] = {}
 _registry_lock = threading.Lock()
 _pusher_started = False
+_pusher_stop = threading.Event()
+_push_failures = 0
 
 
 def _ensure_pusher():
@@ -23,8 +29,31 @@ def _ensure_pusher():
         if _pusher_started:
             return
         _pusher_started = True
+        _pusher_stop.clear()
     t = threading.Thread(target=_push_loop, name="metrics-push", daemon=True)
     t.start()
+
+
+def resume_pusher():
+    """Restart the pusher after a stop_pusher() (ray_tpu re-init in the
+    same process): metrics registered before the shutdown would
+    otherwise never push again. No-op with an empty registry — a
+    metric-less process doesn't deserve a thread."""
+    with _registry_lock:
+        if not _registry:
+            return
+    _ensure_pusher()
+
+
+def stop_pusher():
+    """Worker shutdown: wake the pusher and let it exit instead of
+    spinning forever on is_initialized(). The final snapshot flush is
+    the worker's own stop path (worker.py stop_async) — this only
+    retires the thread."""
+    global _pusher_started
+    _pusher_stop.set()
+    with _registry_lock:
+        _pusher_started = False
 
 
 def registry_snapshot() -> List[Dict]:
@@ -34,21 +63,52 @@ def registry_snapshot() -> List[Dict]:
         return [m._snapshot() for m in _registry.values()]
 
 
+def _push_interval() -> float:
+    """Base cadence jittered +/-25% so a fleet of workers spreads its
+    pushes over the control plane instead of synchronizing on it."""
+    try:
+        from ray_tpu._private.config import cfg
+        base = float(cfg.metrics_push_interval_s)
+    except Exception:
+        base = 2.0
+    return base * random.uniform(0.75, 1.25)
+
+
+def push_once() -> bool:
+    """One registry push through the connected worker. Returns True on
+    success; the FIRST failure per process logs (at most one line — a
+    dead GCS must not spam), later ones stay silent."""
+    global _push_failures
+    try:
+        import ray_tpu
+        if not ray_tpu.is_initialized():
+            return False
+        payload = registry_snapshot()
+        if not payload:
+            return True
+        core = ray_tpu._get_worker().core
+        ray_tpu._get_worker().gcs_call(
+            "report_metrics",
+            worker_id=core.worker_id,
+            node_id=getattr(core, "node_id", None),
+            metrics=payload)
+        _push_failures = 0
+        return True
+    except Exception as e:
+        if _push_failures == 0:
+            logger.warning(
+                "metrics push to GCS failed (%s: %s); further failures "
+                "suppressed until one succeeds", type(e).__name__, e)
+        _push_failures += 1
+        return False
+
+
 def _push_loop():
     while True:
-        time.sleep(2.0)
+        if _pusher_stop.wait(timeout=_push_interval()):
+            return      # clean exit on worker shutdown (stop_pusher)
         try:
-            import ray_tpu
-            if not ray_tpu.is_initialized():
-                continue
-            payload = registry_snapshot()
-            if payload:
-                core = ray_tpu._get_worker().core
-                ray_tpu._get_worker().gcs_call(
-                    "report_metrics",
-                    worker_id=core.worker_id,
-                    node_id=getattr(core, "node_id", None),
-                    metrics=payload)
+            push_once()
         except Exception:
             pass
 
@@ -134,6 +194,22 @@ class Histogram(Metric):
                     "samples": [[list(k), self._counts[k],
                                  self._sums.get(k, 0.0)]
                                 for k in self._counts]}
+
+
+def counter_snapshot(name: str, value: float, help: str = "",
+                     tags: Optional[Dict[str, str]] = None) -> Dict:
+    """A registry-shaped counter snapshot built from an externally-held
+    cumulative value (daemons like the node manager own their counters
+    as plain ints and push them directly — no Metric object needed).
+    Compatible with render_prometheus and the GCS time-series ingest."""
+    return {"name": name, "type": "counter", "help": help,
+            "samples": [[sorted((tags or {}).items()), float(value)]]}
+
+
+def gauge_snapshot(name: str, value: float, help: str = "",
+                   tags: Optional[Dict[str, str]] = None) -> Dict:
+    return {"name": name, "type": "gauge", "help": help,
+            "samples": [[sorted((tags or {}).items()), float(value)]]}
 
 
 def _escape_label_value(value) -> str:

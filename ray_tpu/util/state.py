@@ -64,6 +64,31 @@ def summarize_runtime_events(limit: int = 10000) -> Dict[str, Dict]:
     return out
 
 
+def query_metrics(name: str, window: float = 60.0, agg: str = "avg",
+                  tags: Optional[Dict[str, str]] = None,
+                  threshold: Optional[float] = None) -> Dict:
+    """Windowed aggregate over the GCS time-series metrics plane (fed by
+    every process's 2s registry pushes). agg: "rate"/"sum"/"avg"/"max"/
+    "min"/"latest" for counters and gauges; "p50"/"p90"/"p95"/"p99"
+    (reconstructed from histogram bucket deltas), "frac_over" (with
+    `threshold` — the SLO bad-event fraction) and "buckets" for
+    histograms; "series" returns the raw samples. Returns {"value": ...,
+    "n_samples": ...}; value is None when nothing matched the window.
+
+    Example::
+
+        state.query_metrics("serve_llm_ttft_ms", window=30, agg="p95")
+    """
+    return _w().gcs_call("query_metrics", name=name, window=window,
+                         agg=agg, tags=tags, threshold=threshold)
+
+
+def list_metric_series() -> List[Dict]:
+    """Per-metric inventory of the time-series plane: name, kind,
+    series count, retained samples, staleness."""
+    return _w().gcs_call("list_metric_series")
+
+
 def list_named_actors(namespace: Optional[str] = None) -> List[Dict]:
     return _w().gcs_call("list_named_actors", namespace=namespace)
 

@@ -95,7 +95,9 @@ def task_events_to_otlp(rows: List[Dict],
     }
 
 
-def task_events_to_chrome(rows: List[Dict]) -> List[Dict]:
+def task_events_to_chrome(rows: List[Dict],
+                          gauge_series: Optional[List[Dict]] = None
+                          ) -> List[Dict]:
     """GCS task-event rows -> chrome://tracing / Perfetto event list.
 
     Task rows keep the classic layout (pid = node, tid = worker).
@@ -103,8 +105,26 @@ def task_events_to_chrome(rows: List[Dict]) -> List[Dict]:
     ``runtime:<category>``) so engine/store/data/serve phases line up
     under the tasks that caused them; instants emit as ``ph: "i"``.
     Events are sorted by ts and every duration event has dur >= 1us —
-    the output loads in either viewer without sanitizing."""
+    the output loads in either viewer without sanitizing.
+
+    gauge_series: raw time-series rows from the GCS metrics plane
+    (``dump_metric_series``: {name, tags, worker_id, samples: [[ts,
+    v], ...]}); each renders as a counter track (``ph: "C"``) on the
+    ``metrics`` pid, so slot-occupancy / queue-depth curves draw
+    alongside the spans that explain them."""
     events: List[Dict] = []
+    for s in gauge_series or []:
+        label = s.get("name", "metric")
+        tags = s.get("tags") or {}
+        if tags:
+            label += "{" + ",".join(f"{k}={v}"
+                                    for k, v in sorted(tags.items())) + "}"
+        for ts, value in s.get("samples", []):
+            events.append({
+                "name": label, "cat": "metrics", "ph": "C",
+                "ts": ts * 1e6, "pid": "metrics",
+                "args": {"value": value},
+            })
     for row in rows:
         times = row.get("state_times", {})
         start = times.get("RUNNING")

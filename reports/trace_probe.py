@@ -1,19 +1,22 @@
-"""Flight-recorder overhead probe (`bench.py recorder_overhead`).
+"""Observability overhead probe (`bench.py observability_overhead`).
 
-Measures the two hot paths the recorder rides closest to:
+Measures the hot paths the observability plane rides closest to, with
+EVERYTHING enabled (span recorder + metrics gauges + step profiler) vs
+everything off:
 
-- **decode-step**: the inference engine's per-step spans (engine.decode
-  + per-chunk prefill + slot bookkeeping). Steps/s with the recorder
-  enabled vs disabled on the same engine geometry.
+- **decode-step**: the inference engine's per-step spans + on_step
+  gauge wiring + the decode step profiler. Steps/s all-on vs all-off on
+  the same engine geometry.
 - **put**: a span wrapped around every `ray_tpu.put` of a small object
   — the worst case for span-per-op cost, since a small put is already
   only ~100us of real work. Falls back to a pure record_span
   microbenchmark when no cluster runtime is available.
 
 Modes alternate off/on within each run so thermal/clock drift hits both
-sides equally. Prints ONE line: `RESULT {json}` with per-path rates,
-overhead percentages, and `within_budget` (< 5% on both paths — the
-acceptance guard).
+sides equally. Also times a windowed p95 `query_metrics` against a
+populated time-series ring (`metrics_query_ms`). Prints ONE line:
+`RESULT {json}` with per-path rates, overhead percentages, and
+`within_budget` (< 5% on both paths — the acceptance guard).
 
 Usage: python trace_probe.py --one '{"iters": 200, "runs": 3}'
 """
@@ -31,7 +34,8 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 
-def _tiny_engine(n_slots: int = 4, max_len: int = 128):
+def _tiny_engine(n_slots: int = 4, max_len: int = 128,
+                 step_profile: bool = True):
     import jax
     import numpy as np
 
@@ -47,15 +51,21 @@ def _tiny_engine(n_slots: int = 4, max_len: int = 128):
     return InferenceEngine(
         model, params,
         EngineConfig(n_slots=n_slots, max_len=max_len, prefill_chunk=16,
-                     prefill_budget=64))
+                     prefill_budget=64, step_profile=step_profile))
 
 
 def _measure_decode(iters: int, enabled: bool) -> float:
-    """Decode steps/s with every slot occupied for the whole window."""
+    """Decode steps/s with every slot occupied for the whole window.
+    `enabled` toggles the WHOLE observability plane: span recorder,
+    per-step metric gauges (the serve on_step wiring), and the decode
+    step profiler."""
     from ray_tpu._private import events
     events.set_enabled(enabled)
     try:
-        eng = _tiny_engine()
+        eng = _tiny_engine(step_profile=enabled)
+        if enabled:
+            from ray_tpu.inference.api import _EngineMetrics
+            eng.on_step = _EngineMetrics().on_step
         handles = [eng.submit([1, 2, 3, 4], max_new_tokens=10 ** 6)
                    for _ in range(eng.config.n_slots)]
         for _ in range(8):      # warm: admissions + compiles done
@@ -104,6 +114,41 @@ def _measure_put(iters: int, enabled: bool, use_ray: bool) -> float:
         return iters / dt
     finally:
         events.set_enabled(True)
+
+
+def _measure_metrics_query(n_pushes: int = 300, n_queries: int = 200):
+    """Median latency (ms) of a windowed p95 query against a populated
+    time-series ring: ~n_pushes histogram pushes across 4 series plus a
+    handful of counters/gauges — the live-dashboard steady state."""
+    import statistics
+
+    from ray_tpu._private.metrics_ts import MetricsTimeSeries
+    ts = MetricsTimeSeries(retention_s=3600.0, max_samples=600)
+    bounds = [1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0]
+    now = 0.0
+    for i in range(n_pushes):
+        now = i * 2.0
+        counts = [(i + b) % 7 + 1 for b in range(len(bounds) + 1)]
+        cum = [sum(counts[:j + 1]) * (i + 1) for j in range(len(counts))]
+        rows = [
+            {"name": "serve_llm_ttft_ms", "type": "histogram",
+             "help": "", "boundaries": bounds,
+             "samples": [[[["replica", str(r)]], cum, float(i * 100)]
+                         for r in range(4)]},
+            {"name": "serve_llm_tokens_total", "type": "counter",
+             "help": "", "samples": [[[], float(i * 50)]]},
+            {"name": "serve_llm_queue_depth", "type": "gauge",
+             "help": "", "samples": [[[], float(i % 9)]]},
+        ]
+        ts.ingest(f"w{i % 4}", rows, ts=now)
+    lat = []
+    for _ in range(n_queries):
+        t0 = time.perf_counter()
+        out = ts.query("serve_llm_ttft_ms", window_s=30.0, agg="p95",
+                       now=now)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    assert out["value"] is not None
+    return round(statistics.median(lat), 4)
 
 
 def _overhead_pct(on: float, off: float) -> float:
@@ -157,6 +202,9 @@ def run(spec: dict) -> dict:
         "runs": runs,
         "decode_runs_on": [round(v, 1) for v in dec_on],
         "decode_runs_off": [round(v, 1) for v in dec_off],
+        # enabled side = recorder + metrics gauges + step profiler
+        "plane": "recorder+metrics+profiler",
+        "metrics_query_ms": _measure_metrics_query(),
     }
     if use_ray:
         # a real put (~100us+ of serialization + arena copy) is the op
