@@ -40,6 +40,7 @@ first-token as a single trace. `trace_context()` lets non-task threads
 from __future__ import annotations
 
 import contextlib
+import logging
 import os
 import sys
 import threading
@@ -414,13 +415,23 @@ def flush() -> int:
     return len(rows)
 
 
+_flush_err_logged = False
+
+
 def _flush_loop():
+    global _flush_err_logged
     while True:
         time.sleep(1.0)
         try:
             flush()
         except Exception:
-            pass
+            # flush() already swallows sink errors; reaching here means
+            # the recorder itself broke — say so once, don't spam a
+            # 1 Hz daemon log
+            if not _flush_err_logged:
+                _flush_err_logged = True
+                logging.getLogger(__name__).warning(
+                    "event flush loop error (logged once)", exc_info=True)
 
 
 def _ensure_flusher():

@@ -385,10 +385,15 @@ class NodeManager:
                     # leaks one of each
                     try:
                         await old.close()
+                    # rtlint: disable=RT004 — the replaced half-open conn
+                    # is already dead; close is purely hygiene
                     except Exception:
                         pass
                 except Exception:
-                    pass
+                    # redial failed — log at debug (every heartbeat tick
+                    # retries; an error-level line per tick would flood)
+                    logger.debug("GCS redial failed; retrying next "
+                                 "heartbeat", exc_info=True)
             await asyncio.sleep(cfg.heartbeat_interval_s)
 
     def _read_gcs_address(self) -> Optional[str]:
@@ -464,8 +469,11 @@ class NodeManager:
                     worker_id=f"nm:{self.node_id[:12]}",
                     node_id=self.node_id,
                     metrics=self._observability_metrics())
+            # rtlint: disable=RT004 — best-effort push on a jittered
+            # cadence; the heartbeat loop owns reconnect and the next
+            # tick re-reports cumulative counters (no data loss)
             except Exception:
-                pass        # reconnect handled by the heartbeat loop
+                pass
 
     async def _view_refresh_loop(self):
         # versioned delta pull with a periodic full resync as drift guard;
@@ -496,7 +504,8 @@ class NodeManager:
                     self.cluster_view = await self.gcs.call(
                         "get_cluster_view")
                 except Exception:
-                    pass
+                    logger.debug("cluster-view full resync failed; "
+                                 "retrying next refresh", exc_info=True)
             self._expire_view_debits()
             # reap half-received transfers whose pusher died mid-stream
             # (their unsealed buffers would otherwise pin arena space)
@@ -712,6 +721,8 @@ class NodeManager:
             try:
                 nm = await self.pool.get(view["address"])
                 await nm.call("channel_close", path=path)
+            # rtlint: disable=RT004 — close fan-out to peers that may
+            # already be dead; a dead peer's channel needs no close
             except Exception:
                 pass
         return True
@@ -733,18 +744,27 @@ class NodeManager:
                     "labels": payload.get("labels", {})}
                 self._wake_lease_waiters()
 
+    @staticmethod
+    def _tail_chunk(path: str, off: int) -> bytes:
+        """Blocking file read of one log tail; runs in the default
+        executor — disk IO on the owner loop would stall heartbeats and
+        lease grants behind a slow volume."""
+        with open(path, "rb") as f:
+            f.seek(off)
+            return f.read(256 * 1024)
+
     async def _log_monitor_loop(self):
         """Tail per-worker log files and publish new lines to the LOGS
         pubsub channel so drivers can echo them (reference: LogMonitor
         python/ray/_private/log_monitor.py:103 magic-prefix routing)."""
+        loop = asyncio.get_event_loop()
         while True:
             await asyncio.sleep(cfg.log_tail_interval_s)
             for pid, files in list(self._log_files.items()):
                 for i, (path, stream, off) in enumerate(files):
                     try:
-                        with open(path, "rb") as f:
-                            f.seek(off)
-                            chunk = f.read(256 * 1024)
+                        chunk = await loop.run_in_executor(
+                            None, self._tail_chunk, path, off)
                     except OSError:
                         continue
                     if not chunk:
@@ -761,6 +781,9 @@ class NodeManager:
                             payload={"pid": pid, "stream": stream,
                                      "ip": rpc.node_ip_address(),
                                      "lines": lines[:200]})
+                    # rtlint: disable=RT004 — LOGS fan-out is best-effort
+                    # by contract; the file offset already advanced, and
+                    # re-publishing stale lines would duplicate output
                     except Exception:
                         pass
 

@@ -15,8 +15,10 @@ from __future__ import annotations
 import ctypes
 import os
 import sys
+import threading
 from typing import Optional, Tuple
 
+from ray_tpu._private.markers import off_loop
 from ray_tpu.native.build import build
 
 ID_LEN = 20
@@ -215,7 +217,12 @@ class ObjectStoreClient:
         # oid -> live pin count held by this client; used so close() can
         # release pins a crashed/leaked SharedBuffer would otherwise hold
         # forever, and so we never munmap while zero-copy views are live.
+        # Mutated from caller threads (off-loop gets), the owner loop, and
+        # GC finalizers (_PinnedRegion.__del__ runs on whatever thread
+        # drops the last view) — the get/release counter updates are
+        # read-modify-writes, so they hold _pins_lock.
         self._pins: dict = {}
+        self._pins_lock = threading.Lock()
 
     # -- object ops ---------------------------------------------------------
 
@@ -228,6 +235,7 @@ class ObjectStoreClient:
             raise OSError(f"object store client for {self.path} is closed")
         return h
 
+    @off_loop(lock="_pins_lock")
     def create(self, oid: bytes, data_size: int, meta_size: int = 0,
                evictable: bool = True) -> Optional[Tuple[memoryview, memoryview]]:
         """Allocate a buffer; returns (data_view, meta_view) to write into.
@@ -267,6 +275,7 @@ class ObjectStoreClient:
     def abort(self, oid: bytes) -> None:
         self._lib.rt_abort(self._handle(), oid)
 
+    @off_loop(lock="_pins_lock")
     def get(self, oid: bytes) -> Optional[SharedBuffer]:
         """Zero-copy read of a sealed object; None if not present."""
         dsize = ctypes.c_uint64()
@@ -275,19 +284,25 @@ class ObjectStoreClient:
                                ctypes.byref(msize), 1)
         if off < 0:
             return None
-        self._pins[oid] = self._pins.get(oid, 0) + 1
+        with self._pins_lock:
+            self._pins[oid] = self._pins.get(oid, 0) + 1
         region = _PinnedRegion(self, oid, self._view[off:off + dsize.value])
         meta = bytes(self._view[off + dsize.value:off + dsize.value + msize.value])
         return SharedBuffer(region, memoryview(region), meta)
 
+    @off_loop(lock="_pins_lock")
     def _release(self, oid: bytes) -> None:
-        if self._h and self._pins.get(oid):
+        # runs on whatever thread drops the last zero-copy view (GC
+        # finalizer), so the counter decrement must hold the lock too
+        with self._pins_lock:
+            if not (self._h and self._pins.get(oid)):
+                return
             n = self._pins[oid] - 1
             if n:
                 self._pins[oid] = n
             else:
                 del self._pins[oid]
-            self._lib.rt_release(self._h, oid)
+        self._lib.rt_release(self._h, oid)
 
     def contains(self, oid: bytes) -> bool:
         return bool(self._lib.rt_contains(self._handle(), oid))
@@ -307,6 +322,7 @@ class ObjectStoreClient:
         """Reclaim orphaned never-sealed objects (writer died before seal)."""
         return self._lib.rt_gc_unsealed(self._handle(), max_age_sec)
 
+    @off_loop(lock="_pins_lock")
     def put_bytes(self, oid: bytes, payload, metadata: bytes = b"") -> bool:
         """Convenience: create+write+seal. False if already present."""
         payload = memoryview(payload)
@@ -361,22 +377,24 @@ class ObjectStoreClient:
         raw = buf.raw
         return [raw[i * ID_LEN:(i + 1) * ID_LEN] for i in range(n)]
 
+    @off_loop(lock="_pins_lock")
     def close(self):
         """Release this client's pins. Unmaps only when no zero-copy views
         remain — a live SharedBuffer keeps the mapping for process lifetime
         (munmap under a live view would be a use-after-free)."""
-        if not self._h:
-            return
-        h = self._h
-        if self._pins:
-            # Outstanding zero-copy views: drop the pins so the objects stay
-            # evictable node-wide, but keep the mapping alive.
-            for oid, n in list(self._pins.items()):
-                for _ in range(n):
-                    self._lib.rt_release(h, oid)
-            self._pins.clear()
+        with self._pins_lock:
+            if not self._h:
+                return
+            h = self._h
+            if self._pins:
+                # Outstanding zero-copy views: drop the pins so the objects
+                # stay evictable node-wide, but keep the mapping alive.
+                for oid, n in list(self._pins.items()):
+                    for _ in range(n):
+                        self._lib.rt_release(h, oid)
+                self._pins.clear()
+                self._h = None
+                return
             self._h = None
-            return
-        self._h = None
         self._view.release()
         self._lib.rt_store_close(h)

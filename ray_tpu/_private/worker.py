@@ -40,6 +40,7 @@ import cloudpickle
 
 from ray_tpu._private import ids, rpc, serialization
 from ray_tpu._private.config import cfg
+from ray_tpu._private.markers import off_loop
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.object_store import ObjectStoreClient
 from ray_tpu._private.serialization import (ActorDiedError, ObjectLostError,
@@ -269,6 +270,7 @@ class CoreWorker:
         self._submit_scheduled = False
         self._submit_lock = threading.Lock()
 
+    @off_loop(lock="_submit_lock")
     def _enqueue_submit(self, fn, *args):
         with self._submit_lock:
             self._submit_buf.append((fn, args))
@@ -494,7 +496,9 @@ class CoreWorker:
             try:
                 await self.gcs.notify("add_task_events", events=batch)
             except Exception:
-                pass
+                # the batch is gone — account it so the observability
+                # plane shows the gap instead of looking quietly healthy
+                self._task_events_dropped += len(batch)
 
 
     async def _reconnect_gcs(self):
@@ -530,6 +534,7 @@ class CoreWorker:
             return await self.gcs.call(method, **kw)
 
     # -------------------------------------------------- ownership bookkeeping
+    @off_loop(lock="_ref_lock")
     def _register_owned(self, oid: bytes, lineage=None, complete=False,
                         contained=None):
         """Publish a fully-built owned entry in ONE dict store. Callers run
@@ -541,6 +546,9 @@ class CoreWorker:
                  "complete": complete}
         if contained is not None:
             entry["contained"] = contained
+        # rtlint: disable=RT003 — single GIL-atomic publish of a fully
+        # built entry (see docstring); taking _ref_lock here would put a
+        # lock on every put's hot path for no added safety
         self.owned[oid] = entry
         return entry
 
@@ -570,6 +578,7 @@ class CoreWorker:
     # store.create, the (GIL-free, chunked) arena copy and seal never touch
     # the owner event loop. The loop is only involved for the rare blocking
     # spill RPC and for waking any asyncio waiters on the object event.
+    @off_loop(lock="_ref_lock")
     def put_local(self, value) -> ObjectRef:
         """Synchronous put (callable from user threads AND from task code
         executing inline on the loop — nothing here blocks on the loop)."""
@@ -582,6 +591,7 @@ class CoreWorker:
         await self._spill_pressure_async(s)
         return self._put_serialized(s)
 
+    @off_loop(lock="_ref_lock")
     def _put_serialized(self, s: serialization.SerializedObject) -> ObjectRef:
         task_id = ids.new_task_id(ids.job_id_from_int(self.job_id))
         oid = ids.object_id_for_put(task_id, next(self._put_counter))
@@ -593,7 +603,8 @@ class CoreWorker:
         self._store_serialized(oid, s)
         return ObjectRef(oid, self.address)
 
-    def _refresh_spill_probe(self) -> None:
+    @off_loop(lock="_ref_lock")
+    def _refresh_spill_probe(self) -> None:  # rtlint: disable=RT003 — amortized probe: a racing refresh only re-reads store stats; fields are advisory
         """Re-read store usage for the spill-pressure check (the native
         read is a lock-free seqlock snapshot, but even the ctypes hop is
         too much per put — so it runs every N puts, not every put)."""
@@ -603,6 +614,7 @@ class CoreWorker:
         self._spill_local_bytes = 0
         self._spill_probe_left = cfg.spill_probe_interval_puts
 
+    @off_loop(lock="_ref_lock")
     def _needs_spill(self, s: serialization.SerializedObject) -> bool:
         """Under memory pressure, spill sealed objects to disk before this
         create LRU-evicts them irrecoverably (reference: plasma creates
@@ -678,8 +690,12 @@ class CoreWorker:
                     pass
             return self.store.create(oid, data_size, meta_size)
 
+    @off_loop(lock="_ref_lock")
     def _store_serialized(self, oid: bytes, s: serialization.SerializedObject):
+        # memory_store publishes below are single GIL-atomic dict stores of
+        # fully built tuples — loop-side readers see old-or-new, never torn
         if s.is_inline() or self.store is None:
+            # rtlint: disable=RT003 — GIL-atomic publish (see above)
             self.memory_store[oid] = ("wire",) + s.to_wire()
         else:
             try:
@@ -697,12 +713,14 @@ class CoreWorker:
                         self.store.abort(oid)
                         raise
                     self.store.seal(oid)
+                # rtlint: disable=RT003 — GIL-atomic publish (see above)
                 self.memory_store[oid] = ("shm",)
                 entry = self.owned.get(oid)
                 if entry is not None:
                     entry["location"] = self.node_id
             except Exception:
                 logger.exception("shm put failed; falling back to memory store")
+                # rtlint: disable=RT003 — GIL-atomic publish (see above)
                 self.memory_store[oid] = ("wire",) + s.to_wire()
         ev = self.object_events.pop(oid, None)
         if ev is not None:
@@ -2371,6 +2389,9 @@ class CoreWorker:
                         res = closer() if closer else None
                         if asyncio.iscoroutine(res):
                             await res
+                    # rtlint: disable=RT004 — best-effort close of a user
+                    # generator whose task already finished/errored; its
+                    # close-time exception has nowhere useful to go
                     except Exception:
                         pass
             self.current_task_name = None

@@ -1,0 +1,2 @@
+"""Developer tooling that ships with the package but never imports the
+runtime (lint must be runnable on a box that can't even start a node)."""

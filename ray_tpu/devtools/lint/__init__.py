@@ -1,0 +1,30 @@
+"""rtlint — runtime-aware static analysis for the ray_tpu codebase.
+
+The runtime has three load-bearing invariants that nothing checked
+*statically* until this package existed (the reference covers the same
+ground with tsan/asan CI builds and `thread_checker.h` compile-time
+assertions, SURVEY §5.2):
+
+- owner-loop handlers must never block (the asyncio analog of a data
+  race: one blocking callback stalls every daemon on that loop),
+- jitted hot paths must never retrace (`decode_compile_count == 1`,
+  the "exactly 3 XLA programs" guarantee the serving stack builds on),
+- off-loop threads must mutate shared state only under their declared
+  locks (`@off_loop(lock=...)` markers on the PR 1/PR 6 thread-entry
+  methods).
+
+rtlint walks the package ASTs with a rule registry (RT001..RT005),
+honors inline suppressions (``# rtlint: disable=RT001``, with an
+optional justification after the rule list; a disable comment on a
+``def`` line covers the whole function), subtracts a committed baseline
+of justified legacy findings, and renders human or JSON output. Run it
+as ``ray_tpu lint`` or ``python -m ray_tpu.devtools.lint``.
+"""
+
+from ray_tpu.devtools.lint.config import LintConfig, load_config
+from ray_tpu.devtools.lint.engine import LintResult, run_lint
+from ray_tpu.devtools.lint.finding import Finding
+from ray_tpu.devtools.lint.registry import Rule, all_rules, register
+
+__all__ = ["Finding", "LintConfig", "LintResult", "Rule", "all_rules",
+           "load_config", "register", "run_lint"]

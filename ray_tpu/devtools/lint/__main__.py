@@ -1,0 +1,9 @@
+"""``python -m ray_tpu.devtools.lint`` — same surface as ``ray_tpu
+lint`` (scripts/cli.py delegates here)."""
+
+import sys
+
+from ray_tpu.devtools.lint.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

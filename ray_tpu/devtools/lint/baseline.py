@@ -1,0 +1,73 @@
+"""Committed baseline: legacy/intentional findings that don't fail the
+gate, each with a one-line justification.
+
+Entries match findings by fingerprint (rule + file + enclosing symbol +
+normalized source line + occurrence — line numbers excluded so edits
+above a finding don't churn the file). ``update`` rewrites the file
+from the current findings, preserving the justification of every entry
+that still matches and stamping new ones with TODO so review catches
+unjustified additions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+from ray_tpu.devtools.lint.finding import Finding
+
+TODO_JUSTIFICATION = "TODO: justify this exemption"
+
+
+class Baseline:
+    def __init__(self, path: str = "", entries: Dict[str, dict] = None):
+        self.path = path
+        self.entries: Dict[str, dict] = entries or {}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not path or not os.path.exists(path):
+            return cls(path)
+        with open(path) as f:
+            doc = json.load(f)
+        entries = {e["fingerprint"]: e for e in doc.get("entries", [])}
+        return cls(path, entries)
+
+    def apply(self, findings: List[Finding]) -> List[Finding]:
+        """Mark matched findings as baselined; returns the unmatched
+        (i.e. NEW) findings."""
+        new = []
+        for f in findings:
+            entry = self.entries.get(f.fingerprint)
+            if entry is not None and entry.get("rule", f.rule) == f.rule:
+                f.baselined = True
+                f.justification = entry.get("justification", "")
+            else:
+                new.append(f)
+        return new
+
+    def stale_fingerprints(self, findings: List[Finding]) -> List[str]:
+        live = {f.fingerprint for f in findings}
+        return sorted(fp for fp in self.entries if fp not in live)
+
+    def update(self, findings: List[Finding], path: str = "") -> str:
+        path = path or self.path
+        entries = []
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+            old = self.entries.get(f.fingerprint, {})
+            entries.append({
+                "fingerprint": f.fingerprint, "rule": f.rule,
+                "path": f.path, "symbol": f.symbol, "snippet": f.snippet,
+                "justification": old.get("justification",
+                                         TODO_JUSTIFICATION),
+            })
+        doc = {"version": 1,
+               "comment": ("rtlint baseline — every entry needs a one-line "
+                           "justification; regenerate with "
+                           "`ray_tpu lint --update-baseline`"),
+               "entries": entries}
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=False)
+            f.write("\n")
+        return path

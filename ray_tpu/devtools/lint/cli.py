@@ -1,0 +1,72 @@
+"""Argument handling for the lint gate (shared by `ray_tpu lint` and
+`python -m ray_tpu.devtools.lint`).
+
+Exit codes: 0 clean (baselined/suppressed findings don't fail the
+gate), 1 new findings or parse errors, 2 usage errors. CI runs
+``ray_tpu lint ray_tpu/ --format json`` and treats non-zero as red.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def add_lint_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("paths", nargs="*", default=[],
+                        help="files/dirs to lint (default: [tool.rtlint] "
+                             "paths from pyproject.toml)")
+    parser.add_argument("--format", choices=["text", "json"],
+                        default="text", dest="fmt")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline file (default: [tool.rtlint] "
+                             "baseline, rtlint-baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline; show every finding")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from current findings "
+                             "(preserves existing justifications)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule subset (e.g. "
+                             "RT001,RT004)")
+
+
+def run_from_args(args) -> int:
+    from ray_tpu.devtools.lint import load_config, run_lint
+    from ray_tpu.devtools.lint.baseline import Baseline
+    from ray_tpu.devtools.lint.report import render_json, render_text
+
+    start = args.paths[0] if args.paths else "."
+    config = load_config(start)
+    enable = [r.strip().upper() for r in args.rules.split(",")] \
+        if args.rules else None
+    try:
+        result = run_lint(paths=args.paths or None, config=config,
+                          enable=enable, baseline_path=args.baseline,
+                          use_baseline=not args.no_baseline)
+    except ValueError as e:
+        print(f"rtlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        bpath = args.baseline or config.baseline_path
+        bl = Baseline.load(bpath)
+        kept = bl.update(result.findings + result.baselined, bpath)
+        print(f"rtlint: baseline rewritten with "
+              f"{len(result.findings) + len(result.baselined)} "
+              f"entr(y/ies) at {kept}")
+        return 0
+
+    out = render_json(result) if args.fmt == "json" else \
+        render_text(result)
+    print(out)
+    return 0 if result.ok else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="rtlint",
+        description="runtime-aware static analysis for ray_tpu")
+    add_lint_args(parser)
+    return run_from_args(parser.parse_args(argv))

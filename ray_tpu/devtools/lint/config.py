@@ -1,0 +1,108 @@
+"""``[tool.rtlint]`` configuration from pyproject.toml.
+
+Discovery walks up from the first lint target (or cwd) to the nearest
+pyproject.toml carrying a ``[tool.rtlint]`` table; relative paths in
+the config (targets, baseline) resolve against that file's directory,
+so ``ray_tpu lint`` behaves the same from any cwd.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+try:
+    import tomllib          # 3.11+
+except ImportError:         # pragma: no cover — tier-1 box runs 3.10
+    tomllib = None
+
+DEFAULT_PATHS = ["ray_tpu"]
+DEFAULT_EXCLUDE = ["__pycache__", "native/_build", ".git"]
+DEFAULT_BASELINE = "rtlint-baseline.json"
+
+
+@dataclass
+class LintConfig:
+    root: str = ""                       # dir holding pyproject.toml ("" = cwd)
+    paths: List[str] = field(default_factory=lambda: list(DEFAULT_PATHS))
+    exclude: List[str] = field(default_factory=lambda: list(DEFAULT_EXCLUDE))
+    enable: List[str] = field(default_factory=list)   # [] = all registered
+    baseline: str = DEFAULT_BASELINE
+
+    def resolve(self, path: str) -> str:
+        if os.path.isabs(path):
+            return path
+        return os.path.join(self.root or os.getcwd(), path)
+
+    @property
+    def baseline_path(self) -> str:
+        return self.resolve(self.baseline) if self.baseline else ""
+
+
+def _find_pyproject(start: str) -> Optional[str]:
+    d = os.path.abspath(start)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    while True:
+        cand = os.path.join(d, "pyproject.toml")
+        if os.path.exists(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+def _parse_rtlint_table(text: str) -> dict:
+    """Minimal [tool.rtlint] reader for interpreters without tomllib
+    (<3.11): supports exactly the shapes this config uses — string and
+    array-of-string values, one per line."""
+    m = re.search(r"^\[tool\.rtlint\]\s*$(.*?)(?:^\[|\Z)", text,
+                  re.MULTILINE | re.DOTALL)
+    if not m:
+        return {}
+    table = {}
+    for line in m.group(1).splitlines():
+        line = line.split("#", 1)[0].strip()
+        kv = re.match(r"^(\w+)\s*=\s*(.+)$", line)
+        if not kv:
+            continue
+        key, raw = kv.group(1), kv.group(2).strip()
+        if raw.startswith("["):
+            table[key] = re.findall(r'"([^"]*)"', raw)
+        elif raw.startswith('"') and raw.endswith('"'):
+            table[key] = raw[1:-1]
+    return table
+
+
+def load_config(start: str = ".") -> LintConfig:
+    """Config from the nearest pyproject.toml above `start`; defaults
+    when none (or no [tool.rtlint] table) is found."""
+    pyproject = _find_pyproject(start)
+    if pyproject is None:
+        root = os.path.abspath(start)
+        if os.path.isfile(root):
+            root = os.path.dirname(root)
+        return LintConfig(root=root)
+    if tomllib is not None:
+        with open(pyproject, "rb") as f:
+            try:
+                doc = tomllib.load(f)
+            except tomllib.TOMLDecodeError:
+                return LintConfig(root=os.path.dirname(pyproject))
+        table = doc.get("tool", {}).get("rtlint", {})
+    else:
+        with open(pyproject, encoding="utf-8") as f:
+            table = _parse_rtlint_table(f.read())
+    cfg = LintConfig(root=os.path.dirname(pyproject))
+    if "paths" in table:
+        cfg.paths = [str(p) for p in table["paths"]]
+    if "exclude" in table:
+        cfg.exclude = [str(p) for p in table["exclude"]]
+    if "enable" in table:
+        cfg.enable = [str(r).upper() for r in table["enable"]]
+    if "baseline" in table:
+        cfg.baseline = str(table["baseline"])
+    return cfg

@@ -1,0 +1,141 @@
+"""Lint driver: discover files, parse once, run every enabled rule,
+apply suppressions and the baseline, and time the whole pass (the CI
+self-gate asserts the package lints in well under 10 s)."""
+
+from __future__ import annotations
+
+import ast
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ray_tpu.devtools.lint.baseline import Baseline
+from ray_tpu.devtools.lint.config import LintConfig, load_config
+from ray_tpu.devtools.lint.finding import Finding
+from ray_tpu.devtools.lint.registry import FileContext, all_rules
+from ray_tpu.devtools.lint import suppress
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)   # NEW (gate fails)
+    baselined: List[Finding] = field(default_factory=list)
+    suppressed: int = 0
+    files_scanned: int = 0
+    errors: List[dict] = field(default_factory=list)        # parse failures
+    stale_baseline: List[str] = field(default_factory=list)
+    duration_s: float = 0.0
+    rules_run: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.errors
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "findings": [f.to_dict() for f in self.findings],
+            "baselined": [f.to_dict() for f in self.baselined],
+            "suppressed": self.suppressed,
+            "files_scanned": self.files_scanned,
+            "errors": self.errors,
+            "stale_baseline": self.stale_baseline,
+            "duration_s": round(self.duration_s, 3),
+            "rules": self.rules_run,
+        }
+
+
+def discover_files(paths: Sequence[str], exclude: Sequence[str],
+                   root: str) -> List[str]:
+    out = []
+    for p in paths:
+        p = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            rel = os.path.relpath(dirpath, root)
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if not _excluded(os.path.join(rel, d), exclude))
+            for fn in sorted(filenames):
+                if fn.endswith(".py") and \
+                        not _excluded(os.path.join(rel, fn), exclude):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def _excluded(relpath: str, exclude: Sequence[str]) -> bool:
+    rel = relpath.replace(os.sep, "/")
+    return any(pat in rel for pat in exclude)
+
+
+def lint_file(path: str, root: str, rules: Dict[str, object],
+              result: LintResult) -> None:
+    relpath = os.path.relpath(path, root).replace(os.sep, "/")
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+    except (OSError, SyntaxError, ValueError) as e:
+        result.errors.append({"path": relpath, "error": str(e)})
+        return
+    result.files_scanned += 1
+    per_line, file_wide = suppress.parse_suppressions(source)
+    ctx = FileContext(relpath, source, tree)
+    for rule in rules.values():
+        if not rule.applies_to(relpath):
+            continue
+        for f in rule.check(ctx):
+            if suppress.is_suppressed(f.rule, f.line, f.scope_lines,
+                                      per_line, file_wide):
+                result.suppressed += 1
+            else:
+                result.findings.append(f)
+
+
+def run_lint(paths: Optional[Sequence[str]] = None,
+             config: Optional[LintConfig] = None,
+             enable: Optional[Sequence[str]] = None,
+             baseline_path: Optional[str] = None,
+             use_baseline: bool = True) -> LintResult:
+    """Lint `paths` (default: config paths). `baseline_path=None` uses
+    the config's baseline; pass use_baseline=False to see everything."""
+    t0 = time.perf_counter()
+    if config is None:
+        start = paths[0] if paths else "."
+        config = load_config(start)
+    targets = list(paths) if paths else list(config.paths)
+    enabled = [r.upper() for r in (enable or config.enable)] or None
+    registry = all_rules()
+    if enabled is not None:
+        unknown = [r for r in enabled if r not in registry]
+        if unknown:
+            raise ValueError(f"unknown rule(s): {', '.join(unknown)}")
+        registry = {k: v for k, v in registry.items() if k in enabled}
+    rules = {code: cls() for code, cls in sorted(registry.items())}
+
+    result = LintResult(rules_run=sorted(rules))
+    files = discover_files(targets, config.exclude, config.root)
+    if not files:
+        # an explicitly named target that resolves to nothing is an
+        # error, not a quietly green gate
+        result.errors.append(
+            {"path": ", ".join(targets),
+             "error": "no Python files found under the given path(s)"})
+    for path in files:
+        lint_file(path, config.root, rules, result)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    if use_baseline:
+        bpath = baseline_path if baseline_path is not None \
+            else config.baseline_path
+        bl = Baseline.load(bpath)
+        all_findings = result.findings
+        result.findings = bl.apply(all_findings)
+        result.baselined = [f for f in all_findings if f.baselined]
+        result.stale_baseline = bl.stale_fingerprints(all_findings)
+    result.duration_s = time.perf_counter() - t0
+    return result

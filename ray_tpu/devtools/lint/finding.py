@@ -1,0 +1,52 @@
+"""Finding: one rule violation at one source location.
+
+The fingerprint deliberately excludes the line number: baselines must
+survive unrelated edits above a finding, so identity is (rule, file,
+enclosing symbol, normalized source line, occurrence index) — the same
+scheme flake8-bugbear-style baselines use to stay stable across rebases.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class Finding:
+    rule: str                 # "RT001"
+    path: str                 # repo-relative, forward slashes
+    line: int                 # 1-based
+    col: int
+    message: str
+    symbol: str = ""          # enclosing def/class qualname ("" = module)
+    snippet: str = ""         # stripped source line
+    occurrence: int = 0       # disambiguates identical lines in one symbol
+    # def-line numbers of every enclosing function: a suppression comment
+    # on any of these lines silences the finding for the whole scope
+    scope_lines: List[int] = field(default_factory=list)
+    baselined: bool = False
+    justification: str = ""   # carried from the matching baseline entry
+
+    @property
+    def fingerprint(self) -> str:
+        key = "\x1f".join([self.rule, self.path, self.symbol,
+                           self.snippet, str(self.occurrence)])
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        d = {"rule": self.rule, "path": self.path, "line": self.line,
+             "col": self.col, "message": self.message,
+             "symbol": self.symbol, "snippet": self.snippet,
+             "fingerprint": self.fingerprint}
+        if self.baselined:
+            d["baselined"] = True
+            if self.justification:
+                d["justification"] = self.justification
+        return d
+
+    def format(self) -> str:
+        where = f"{self.path}:{self.line}:{self.col}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{where}: {self.rule} {self.message}{sym}"

@@ -1,0 +1,151 @@
+"""Rule registry + the per-file context rules run against.
+
+A rule is a class with a ``code`` ("RT001"), a short ``name``, an
+optional ``path_filter`` (substring any of which must appear in the
+repo-relative path — RT004 is scoped to ``_private/`` daemon code this
+way), and ``check(ctx)`` yielding Findings. Registration is import-time
+(`@register`); ``ray_tpu.devtools.lint.rules`` imports every rule
+module so ``all_rules()`` is complete after one import.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple, Type
+
+from ray_tpu.devtools.lint.finding import Finding
+
+_RULES: Dict[str, Type["Rule"]] = {}
+
+
+def register(cls: Type["Rule"]) -> Type["Rule"]:
+    if not getattr(cls, "code", None):
+        raise ValueError(f"rule {cls.__name__} has no code")
+    if cls.code in _RULES:
+        raise ValueError(f"duplicate rule code {cls.code}")
+    _RULES[cls.code] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, Type["Rule"]]:
+    # import for side effect: each rule module registers itself
+    import ray_tpu.devtools.lint.rules  # noqa: F401
+    return dict(_RULES)
+
+
+class FileContext:
+    """Parsed view of one file shared by every rule: source lines, the
+    AST, and an interval index of function bodies (for symbol
+    attribution and def-line scoped suppressions)."""
+
+    def __init__(self, relpath: str, source: str, tree: ast.Module):
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        # (start, end, def_line, qualname) per def/async def, outermost first
+        self.func_spans: List[Tuple[int, int, int, str]] = []
+        self._index_functions(tree, [])
+        self._occ: Dict[tuple, int] = {}
+
+    def _index_functions(self, node, stack: List[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qual = stack + [child.name]
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.func_spans.append(
+                        (child.lineno, child.end_lineno or child.lineno,
+                         child.lineno, ".".join(qual)))
+                self._index_functions(child, qual)
+            else:
+                self._index_functions(child, stack)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def enclosing(self, lineno: int) -> Tuple[str, List[int]]:
+        """(innermost enclosing qualname, def-lines of every enclosing
+        function) for a source line."""
+        qual, defs = "", []
+        for start, end, def_line, name in self.func_spans:
+            if start <= lineno <= end:
+                defs.append(def_line)
+                qual = name   # spans are outermost-first; keep innermost
+        return qual, defs
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        lineno = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        symbol, scope_lines = self.enclosing(lineno)
+        snippet = self.line_text(lineno)
+        key = (rule, symbol, snippet)
+        occ = self._occ.get(key, 0)
+        self._occ[key] = occ + 1
+        return Finding(rule=rule, path=self.relpath, line=lineno, col=col,
+                       message=message, symbol=symbol, snippet=snippet,
+                       occurrence=occ, scope_lines=scope_lines)
+
+
+class Rule:
+    code: str = ""
+    name: str = ""
+    description: str = ""
+    # substrings; when non-empty, the rule only runs on files whose
+    # repo-relative path contains one of them
+    path_filter: Tuple[str, ...] = ()
+
+    def applies_to(self, relpath: str) -> bool:
+        if not self.path_filter:
+            return True
+        return any(part in relpath for part in self.path_filter)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------- helpers
+# Shared AST utilities the rules lean on.
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target ('time.sleep', 'sock.connect', 'int');
+    '' when the target is not a name/attribute chain."""
+    return dotted_name(node.func)
+
+
+def dotted_name(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if parts:
+        # e.g. <call>.result — keep the attribute chain with a wildcard base
+        return ".".join(["*"] + list(reversed(parts)))
+    return ""
+
+
+def names_in(node: ast.AST) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def decorator_names(fn) -> List[str]:
+    """Dotted names of each decorator; calls unwrap to their target
+    ('off_loop(lock=...)' -> 'off_loop', '@partial(jax.jit)' -> 'partial')."""
+    out = []
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        out.append(dotted_name(target))
+    return out
+
+
+def const_str_kwarg(call: ast.Call, name: str) -> Optional[str]:
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return None

@@ -1,0 +1,33 @@
+"""Human and JSON rendering of a LintResult."""
+
+from __future__ import annotations
+
+import json
+
+from ray_tpu.devtools.lint.engine import LintResult
+
+
+def render_json(result: LintResult) -> str:
+    return json.dumps(result.to_dict(), indent=2)
+
+
+def render_text(result: LintResult) -> str:
+    lines = []
+    for f in result.findings:
+        lines.append(f.format())
+        if f.snippet:
+            lines.append(f"    {f.snippet}")
+    for e in result.errors:
+        lines.append(f"{e['path']}: PARSE-ERROR {e['error']}")
+    if result.stale_baseline:
+        lines.append(f"note: {len(result.stale_baseline)} baseline "
+                     f"entr{'y is' if len(result.stale_baseline) == 1 else 'ies are'} "
+                     "stale (finding no longer present) — re-run with "
+                     "--update-baseline to prune")
+    verdict = "ok" if result.ok else "FAILED"
+    lines.append(
+        f"rtlint: {verdict} — {len(result.findings)} new finding(s), "
+        f"{len(result.baselined)} baselined, {result.suppressed} "
+        f"suppressed across {result.files_scanned} files "
+        f"({result.duration_s:.2f}s, rules: {', '.join(result.rules_run)})")
+    return "\n".join(lines)

@@ -1,0 +1,11 @@
+"""Rule modules register themselves on import; importing this package
+is what makes ``all_rules()`` complete. Add a rule = add a module here
+with a ``@register``-ed Rule subclass and import it below."""
+
+from ray_tpu.devtools.lint.rules import (  # noqa: F401
+    rt001_loop_blocking,
+    rt002_jit_retrace,
+    rt003_cross_thread,
+    rt004_swallowed,
+    rt005_msgpack,
+)

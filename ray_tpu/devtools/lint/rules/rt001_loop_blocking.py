@@ -1,0 +1,151 @@
+"""RT001: blocking calls on owner-loop code paths.
+
+A blocking call inside an ``async def`` body or a registered ``h_*``
+handler (sync handlers run inline on the daemon's event loop) stalls
+every coroutine sharing that loop — in this runtime that means missed
+heartbeats, delayed lease grants, false node-death. This is the static
+complement to ``util/sanitizers.py``'s dynamic loop sanitizer, which
+only catches the block after it already happened in a tagged run.
+
+Flagged inside loop-owned scopes (nested ``def``s are skipped — they
+are routinely shipped to executor threads, where blocking is fine):
+
+- ``time.sleep`` (use ``await asyncio.sleep``)
+- blocking subprocess waits: ``subprocess.run/call/check_call/
+  check_output``, ``os.system``, ``.communicate()``/``.wait()`` on
+  process-ish receivers
+- blocking socket ops on socket-ish receivers (``*sock*.connect`` etc.;
+  use ``loop.sock_*`` / streams)
+- ``socket.create_connection``, ``urllib.request.urlopen``
+- blocking file IO: builtin ``open`` (use ``run_in_executor``)
+- thread-lock acquisition: ``<lock-ish>.acquire()`` without
+  ``blocking=False`` and ``with <lock-ish>:`` — a held peer thread
+  turns the critical section into a loop stall
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_tpu.devtools.lint.finding import Finding
+from ray_tpu.devtools.lint.registry import (FileContext, Rule, call_name,
+                                            dotted_name, register)
+
+_CALL_BLOCKLIST = {
+    "time.sleep": "time.sleep blocks the event loop (await asyncio.sleep)",
+    "subprocess.run": "subprocess.run blocks the event loop "
+                      "(use asyncio.create_subprocess_exec)",
+    "subprocess.call": "subprocess.call blocks the event loop",
+    "subprocess.check_call": "subprocess.check_call blocks the event loop",
+    "subprocess.check_output": "subprocess.check_output blocks the "
+                               "event loop",
+    "os.system": "os.system blocks the event loop",
+    "os.waitpid": "os.waitpid blocks the event loop",
+    "socket.create_connection": "socket.create_connection blocks the "
+                                "event loop (use loop.sock_connect)",
+    "urllib.request.urlopen": "urlopen blocks the event loop",
+}
+
+_SOCKET_METHODS = {"accept", "connect", "recv", "recv_into", "recvfrom",
+                   "send", "sendall", "sendto"}
+_PROC_METHODS = {"communicate", "wait"}
+_LOCKISH = ("lock", "mutex", "_mu", "sem", "cond")
+
+
+def _lockish(name: str) -> bool:
+    low = name.lower()
+    return any(part in low for part in _LOCKISH)
+
+
+def _receiver(node: ast.AST) -> str:
+    """Base identifier of an attribute chain ('self._sock.recv' ->
+    '_sock', 'sock.connect' -> 'sock')."""
+    dotted = dotted_name(node)
+    parts = [p for p in dotted.split(".") if p not in ("self", "*")]
+    return parts[-2] if len(parts) >= 2 else ""
+
+
+@register
+class LoopBlockingRule(Rule):
+    code = "RT001"
+    name = "loop-blocking"
+    description = ("blocking call inside an async def body or a "
+                   "registered h_* handler")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        yield from self._scan(ctx.tree, ctx, owned=False)
+
+    def _scan(self, node, ctx, owned: bool) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.AsyncFunctionDef):
+                yield from self._scan_owned(child, ctx)
+            elif isinstance(child, ast.FunctionDef):
+                if child.name.startswith("h_"):
+                    # sync RPC handlers dispatch inline on the loop
+                    yield from self._scan_owned(child, ctx)
+                # other sync defs: not loop-owned, skip their bodies
+            elif isinstance(child, ast.Lambda):
+                continue
+            else:
+                yield from self._scan(child, ctx, owned)
+
+    def _scan_owned(self, fn, ctx) -> Iterator[Finding]:
+        """Walk one loop-owned function body, skipping nested defs."""
+        for stmt in fn.body:
+            yield from self._walk_stmt(stmt, ctx)
+
+    def _walk_stmt(self, node, ctx) -> Iterator[Finding]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return     # executor thunks / helpers: not loop-owned
+        if isinstance(node, ast.With):
+            for item in node.items:
+                name = dotted_name(item.context_expr)
+                base = name.split(".")[-1] if name else ""
+                if base and _lockish(base) and not isinstance(
+                        item.context_expr, ast.Call):
+                    yield ctx.finding(
+                        self.code, item.context_expr,
+                        f"`with {name}:` acquires a thread lock on the "
+                        "event loop — a holder thread stalls every "
+                        "coroutine on it")
+        if isinstance(node, ast.Call):
+            yield from self._check_call(node, ctx)
+        for child in ast.iter_child_nodes(node):
+            yield from self._walk_stmt(child, ctx)
+
+    def _check_call(self, call: ast.Call, ctx) -> Iterator[Finding]:
+        name = call_name(call)
+        if name in _CALL_BLOCKLIST:
+            yield ctx.finding(self.code, call, _CALL_BLOCKLIST[name])
+            return
+        if name == "open" or name.endswith(".open") and "os." in name:
+            yield ctx.finding(
+                self.code, call,
+                "blocking file open on the event loop (wrap the read in "
+                "loop.run_in_executor)")
+            return
+        last = name.split(".")[-1] if name else ""
+        recv = _receiver(call.func) if isinstance(call.func,
+                                                  ast.Attribute) else ""
+        if last in _SOCKET_METHODS and "sock" in recv.lower():
+            yield ctx.finding(
+                self.code, call,
+                f"blocking socket op `{name}` on the event loop "
+                "(use loop.sock_* or asyncio streams)")
+            return
+        if last in _PROC_METHODS and ("proc" in recv.lower()
+                                      or "popen" in recv.lower()):
+            yield ctx.finding(
+                self.code, call,
+                f"blocking process wait `{name}` on the event loop")
+            return
+        if last == "acquire" and _lockish(recv):
+            if not any(kw.arg == "blocking" and
+                       isinstance(kw.value, ast.Constant) and
+                       kw.value.value is False for kw in call.keywords):
+                yield ctx.finding(
+                    self.code, call,
+                    f"blocking lock acquire `{name}` on the event loop "
+                    "(pass blocking=False or restructure)")

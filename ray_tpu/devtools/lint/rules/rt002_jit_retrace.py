@@ -1,0 +1,292 @@
+"""RT002: retrace hazards in jit-compiled functions.
+
+The engine's whole performance story rests on compile-once contracts
+(`decode_compile_count == 1`, "exactly 3 XLA programs"); one Python
+coercion of a traced value, one data-dependent branch, or one
+unhashable static arg silently turns a cached dispatch into a
+recompile per call. This rule finds the function objects handed to
+``jax.jit`` / ``jit`` / ``wrap_jit`` (decorator form, ``partial(jax.jit,
+...)`` form, and the ``name = jax.jit(fn, ...)`` assignment form used by
+``make_train_fns`` and the inference engine) and flags, inside them:
+
+- host coercion of traced arguments: ``int(x)`` / ``float(x)`` /
+  ``bool(x)`` / ``x.item()`` where ``x`` involves a non-static
+  parameter.  Shape arithmetic (``x.shape``, ``len(x)``, ``x.ndim``,
+  ``x.size``) is static under tracing and is NOT flagged;
+- Python branching on traced arguments (``if``/``while`` tests naming a
+  non-static parameter — ``is``/``is not`` comparisons excluded: they
+  resolve at trace time without concretizing);
+- static args that cannot hash: a ``static_argnums``/``static_argnames``
+  target whose default is a list/dict/set literal;
+- donated-buffer reuse: a later read of a plain-name argument passed in
+  a ``donate_argnums`` position of a known-jitted callable (straight-line
+  analysis within one function body; rebinding clears the taint).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ray_tpu.devtools.lint.finding import Finding
+from ray_tpu.devtools.lint.registry import (FileContext, Rule, call_name,
+                                            dotted_name, register)
+
+_JIT_NAMES = {"jax.jit", "jit", "wrap_jit", "pjit", "jax.pjit"}
+_SHAPEY = {"shape", "ndim", "size", "dtype", "itemsize", "nbytes"}
+_COERCIONS = {"int", "float", "bool", "complex"}
+
+
+def _is_jit_call(node: ast.AST) -> Optional[ast.Call]:
+    """The Call node if `node` is jax.jit(...)/jit(...)/wrap_jit(...),
+    or partial(jax.jit, ...); else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = call_name(node)
+    if name in _JIT_NAMES or name.endswith(".wrap_jit"):
+        return node
+    if name in ("partial", "functools.partial") and node.args:
+        inner = dotted_name(node.args[0])
+        if inner in _JIT_NAMES:
+            return node
+    return None
+
+
+def _static_params(fn, jit_call: Optional[ast.Call]) -> Set[str]:
+    """Parameter names excluded from tracing via static_argnums/names."""
+    static: Set[str] = set()
+    if jit_call is None:
+        return static
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnums":
+            for idx in _int_elts(kw.value):
+                if 0 <= idx < len(params):
+                    static.add(params[idx])
+        elif kw.arg == "static_argnames":
+            for name in _str_elts(kw.value):
+                static.add(name)
+    return static
+
+
+def _int_elts(node) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+def _str_elts(node) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _traced_mentions(node: ast.AST, traced: Set[str]) -> bool:
+    """True when `node` references a traced param OUTSIDE a static
+    accessor chain (x.shape / x.ndim / len(x) / x.dtype)."""
+    def visit(n) -> bool:
+        if isinstance(n, ast.Attribute) and n.attr in _SHAPEY:
+            return False                     # x.shape... — static
+        if isinstance(n, ast.Call):
+            fname = call_name(n)
+            if fname in ("len", "isinstance", "getattr", "hasattr"):
+                return False                 # len(x) etc. — static/meta
+        if isinstance(n, ast.Name):
+            return n.id in traced
+        return any(visit(c) for c in ast.iter_child_nodes(n))
+    return visit(node)
+
+
+@register
+class JitRetraceRule(Rule):
+    code = "RT002"
+    name = "jit-retrace"
+    description = "retrace hazard inside a jit-compiled function"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # pass 1: map locally defined functions and jitted callables
+        defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node
+        # jitted_fns: function-def node -> jit call (or None for bare @jit)
+        jitted: List[Tuple[ast.AST, Optional[ast.Call]]] = []
+        # donating callables visible by name: name -> donated positions
+        donors: Dict[str, Set[int]] = {}
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    jc = _is_jit_call(dec)
+                    if jc is not None:
+                        jitted.append((node, jc))
+                    elif dotted_name(dec) in _JIT_NAMES:
+                        jitted.append((node, None))
+            if isinstance(node, ast.Call):
+                # jit(fn, ...) anywhere — assignment, return, argument
+                jc = _is_jit_call(node)
+                if jc is not None and jc.args:
+                    fname = dotted_name(jc.args[0])
+                    if fname in defs:
+                        jitted.append((defs[fname], jc))
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                jc = _is_jit_call(node.value)
+                if jc is not None:
+                    target = node.targets[0]
+                    tname = None
+                    if isinstance(target, ast.Name):
+                        tname = target.id
+                    elif isinstance(target, ast.Attribute):
+                        tname = dotted_name(target)
+                    donated = set()
+                    for kw in jc.keywords:
+                        if kw.arg == "donate_argnums":
+                            donated = set(_int_elts(kw.value))
+                    if tname and donated:
+                        donors[tname] = donated
+
+        seen = set()
+        for fn, jc in jitted:
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            yield from self._check_jitted(fn, jc, ctx)
+
+        # donated-buffer reuse sites: every function body + module body
+        bodies = [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                               if isinstance(n, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef))]
+        for body_owner in bodies:
+            yield from self._check_donation_reuse(body_owner, donors, ctx)
+
+    # ------------------------------------------------------ jitted bodies
+    def _check_jitted(self, fn, jit_call, ctx) -> Iterator[Finding]:
+        params = {a.arg for a in fn.args.posonlyargs + fn.args.args
+                  + fn.args.kwonlyargs}
+        static = _static_params(fn, jit_call)
+        traced = params - static
+
+        # unhashable / mutable static defaults
+        defaults = fn.args.defaults
+        pos = fn.args.posonlyargs + fn.args.args
+        for arg, default in zip(pos[len(pos) - len(defaults):], defaults):
+            if arg.arg in static and isinstance(
+                    default, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                              ast.DictComp, ast.SetComp)):
+                yield ctx.finding(
+                    self.code, default,
+                    f"static arg `{arg.arg}` of jitted `{fn.name}` has a "
+                    "mutable (unhashable) default — every call misses the "
+                    "jit cache or raises")
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in _COERCIONS and node.args and \
+                        _traced_mentions(node.args[0], traced):
+                    yield ctx.finding(
+                        self.code, node,
+                        f"`{name}()` concretizes a traced value inside "
+                        f"jitted `{fn.name}` — retraces (or errors) every "
+                        "distinct value")
+                elif name.endswith(".item") and _traced_mentions(
+                        node.func, traced):
+                    yield ctx.finding(
+                        self.code, node,
+                        f"`.item()` forces a host sync inside jitted "
+                        f"`{fn.name}` — breaks tracing / retraces per value")
+            elif isinstance(node, (ast.If, ast.While)):
+                test = node.test
+                if self._branch_on_traced(test, traced):
+                    yield ctx.finding(
+                        self.code, test,
+                        f"Python branch on traced value in jitted "
+                        f"`{fn.name}` — use lax.cond/lax.select or mark "
+                        "the arg static")
+
+    def _branch_on_traced(self, test: ast.AST, traced: Set[str]) -> bool:
+        if isinstance(test, ast.Compare) and all(
+                op.__class__ in (ast.Is, ast.IsNot)
+                for op in test.ops):
+            return False       # `x is None` resolves at trace time
+        return _traced_mentions(test, traced)
+
+    # ------------------------------------------------- donated-arg reuse
+    def _check_donation_reuse(self, owner, donors: Dict[str, Set[int]],
+                              ctx) -> Iterator[Finding]:
+        """Linear pass over one body: after `r = g(buf, ...)` with g
+        donating that position, a later plain read of `buf` (without
+        rebinding) is a use of a freed buffer. Compound statements
+        (if/for/try/with bodies) are analyzed as isolated scopes with a
+        COPY of the live taint — a donation inside one branch never
+        taints code after the branch point, so mutually-exclusive
+        early-return paths (`if fast: return g(state); slow(state)`)
+        don't false-positive."""
+        if not donors:
+            return
+        body = owner.body if hasattr(owner, "body") else []
+        yield from self._linear(body, dict(), donors, ctx)
+
+    _BLOCK_ATTRS = ("body", "orelse", "finalbody")
+
+    def _linear(self, stmts, tainted: Dict[str, ast.Call],
+                donors: Dict[str, Set[int]], ctx) -> Iterator[Finding]:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue      # nested defs get their own pass
+            compound = any(getattr(stmt, a, None)
+                           for a in self._BLOCK_ATTRS) or \
+                getattr(stmt, "handlers", None)
+            # reads in this statement's own expressions (for a compound,
+            # that's the test/iter/items — its blocks recurse below)
+            check_nodes = [stmt] if not compound else \
+                [n for n in (getattr(stmt, "test", None),
+                             getattr(stmt, "iter", None),
+                             *(i.context_expr for i in
+                               getattr(stmt, "items", []) or []))
+                 if n is not None]
+            for top in check_nodes:
+                for n in ast.walk(top):
+                    if isinstance(n, ast.Name) and \
+                            isinstance(n.ctx, ast.Load) and n.id in tainted:
+                        call = tainted.pop(n.id)  # one report per taint
+                        yield ctx.finding(
+                            self.code, n,
+                            f"`{n.id}` was donated to "
+                            f"`{call_name(call)}` (donate_argnums) and "
+                            "is read afterwards — donated buffers are "
+                            "invalid after the call")
+            if compound:
+                for attr in self._BLOCK_ATTRS:
+                    block = getattr(stmt, attr, None) or []
+                    yield from self._linear(block, dict(tainted),
+                                            donors, ctx)
+                for handler in getattr(stmt, "handlers", []) or []:
+                    yield from self._linear(handler.body, dict(tainted),
+                                            donors, ctx)
+                continue
+            # taint donated plain-name args of calls in this statement
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call):
+                    cname = call_name(n)
+                    if cname in donors:
+                        for pos in donors[cname]:
+                            if pos < len(n.args) and isinstance(
+                                    n.args[pos], ast.Name):
+                                tainted[n.args[pos].id] = n
+            # assignments rebind (clear taint) after the statement runs
+            if isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            tainted.pop(n.id, None)

@@ -1,0 +1,126 @@
+"""RT003: unlocked shared-state mutation in off-loop methods.
+
+Methods that run on caller threads (the PR 1 put path, the PR 6 striped
+arena clients) are marked ``@off_loop(lock="_ref_lock")``
+(``ray_tpu/_private/markers.py``). Inside a marked method, every store
+to ``self`` state — attribute assigns, augmented assigns, subscript
+assigns on a self attribute, and ``del`` — must happen inside a
+``with self.<declared-lock>:`` block.
+
+Single-bytecode dict publishes (``self.d[k] = fully_built_value``) are
+GIL-atomic and sometimes intentional; those sites carry an inline
+``# rtlint: disable=RT003 — <why>`` (or a baseline entry) so the
+atomicity argument is written down next to the code instead of lost in
+a reviewer's head. The read-modify-write shapes this rule exists for
+(``self.n += 1``, ``self.d[k] = self.d.get(k, 0) + 1``) are never safe
+unlocked, GIL or not.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ray_tpu.devtools.lint.finding import Finding
+from ray_tpu.devtools.lint.registry import (FileContext, Rule,
+                                            const_str_kwarg, dotted_name,
+                                            register)
+
+_MARKER = "off_loop"
+
+
+def _off_loop_lock(fn) -> Optional[tuple]:
+    """(lock_name or None,) when fn carries @off_loop; None when not
+    marked. lock may legitimately be None (marker without a declared
+    lock: every store is flagged and the message asks for one)."""
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target)
+        if name == _MARKER or name.endswith("." + _MARKER):
+            lock = const_str_kwarg(dec, "lock") if isinstance(
+                dec, ast.Call) else None
+            return (lock,)
+    return None
+
+
+def _self_store_target(node: ast.AST) -> Optional[str]:
+    """'attr' when node stores to self.attr or self.attr[...]; else
+    None."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _is_lock_ctx(expr: ast.AST, lock: Optional[str]) -> bool:
+    """`with self.<lock>:` (or getattr(self, lock)) for the declared
+    lock; with no declared lock, any `with self.*lock*:` counts so the
+    finding message can focus on declaring one."""
+    name = dotted_name(expr)
+    if lock is not None:
+        return name == f"self.{lock}"
+    return name.startswith("self.") and "lock" in name.lower()
+
+
+@register
+class CrossThreadMutationRule(Rule):
+    code = "RT003"
+    name = "cross-thread-mutation"
+    description = ("self.* store outside the declared lock in an "
+                   "@off_loop method")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                marker = _off_loop_lock(node)
+                if marker is not None:
+                    yield from self._check_method(node, marker[0], ctx)
+
+    def _check_method(self, fn, lock: Optional[str],
+                      ctx) -> Iterator[Finding]:
+        yield from self._walk(fn.body, fn, lock, ctx, locked=False)
+
+    def _walk(self, stmts, fn, lock, ctx, locked: bool) -> Iterator[Finding]:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue      # nested defs have their own markers
+            now_locked = locked
+            if isinstance(stmt, ast.With):
+                if any(_is_lock_ctx(item.context_expr, lock)
+                       for item in stmt.items):
+                    now_locked = True
+            if not locked:
+                yield from self._check_stmt(stmt, fn, lock, ctx)
+            for attr in ("body", "orelse", "finalbody"):
+                yield from self._walk(getattr(stmt, attr, []) or [],
+                                      fn, lock, ctx, now_locked)
+            for handler in getattr(stmt, "handlers", []) or []:
+                yield from self._walk(handler.body, fn, lock, ctx,
+                                      now_locked)
+
+    def _check_stmt(self, stmt, fn, lock, ctx) -> Iterator[Finding]:
+        """Direct (non-nested-block) stores in one statement."""
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Delete):
+            targets = stmt.targets
+        for t in targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for e in elts:
+                attr = _self_store_target(e)
+                if attr is None:
+                    continue
+                need = (f"`with self.{lock}:`" if lock
+                        else "a declared lock (@off_loop(lock=...))")
+                kind = ("read-modify-write"
+                        if isinstance(stmt, ast.AugAssign) else "store")
+                yield ctx.finding(
+                    self.code, stmt,
+                    f"{kind} to self.{attr} in off-loop method "
+                    f"`{fn.name}` outside {need}")

@@ -1,0 +1,78 @@
+"""RT004: exceptions swallowed inside daemon loops (`_private/` scope).
+
+``except Exception: pass`` directly inside a ``for``/``while`` body is a
+repeating silent failure: a daemon loop that hits the same error every
+tick looks healthy forever (no log line, no counter) while e.g. task
+events or spill requests silently stop flowing. The rule is scoped to
+``_private/`` — that's where the runtime daemons live; best-effort
+swallows elsewhere (user-facing conveniences) are a different
+conversation.
+
+Only fully-silent handlers are flagged: type Exception/BaseException/
+bare, body exactly ``pass`` (or ``...``). A handler that logs, counts,
+narrows the type, or even ``continue``s with a comment is out of scope.
+Intentional best-effort swallows stay, but carry an inline
+``# rtlint: disable=RT004 — <why>`` or a baseline justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_tpu.devtools.lint.finding import Finding
+from ray_tpu.devtools.lint.registry import FileContext, Rule, register
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    if len(handler.body) != 1:
+        return False
+    stmt = handler.body[0]
+    if isinstance(stmt, ast.Pass):
+        return True
+    return isinstance(stmt, ast.Expr) and \
+        isinstance(stmt.value, ast.Constant) and stmt.value.value is ...
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    if isinstance(handler.type, ast.Name):
+        return handler.type.id in _BROAD
+    if isinstance(handler.type, ast.Attribute):
+        return handler.type.attr in _BROAD
+    return False
+
+
+@register
+class SwallowedExceptionRule(Rule):
+    code = "RT004"
+    name = "swallowed-exception"
+    description = ("`except Exception: pass` inside a daemon loop "
+                   "(_private/)")
+    path_filter = ("_private/",)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        yield from self._walk(ctx.tree, ctx, in_loop=False)
+
+    def _walk(self, node, ctx, in_loop: bool) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            child_in_loop = in_loop
+            if isinstance(child, (ast.For, ast.AsyncFor, ast.While)):
+                child_in_loop = True
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                # a nested def starts a fresh (non-loop) scope
+                child_in_loop = False
+            if isinstance(child, ast.ExceptHandler) and in_loop and \
+                    _is_broad(child) and _is_silent(child):
+                tname = "bare except" if child.type is None else \
+                    f"except {ast.unparse(child.type)}"
+                yield ctx.finding(
+                    self.code, child,
+                    f"`{tname}: pass` inside a loop swallows every "
+                    "iteration's failure silently — log it, count it, "
+                    "or narrow the type")
+            yield from self._walk(child, ctx, child_in_loop)

@@ -1,0 +1,105 @@
+"""RT005: msgpack-unsafe values returned from RPC handlers.
+
+``h_*`` handler return values ride the msgpack control plane
+(``rpc.py``: ``packb(use_bin_type=True)`` / ``unpackb(raw=False)``).
+Three shapes fail or corrupt silently:
+
+- sets / frozensets: msgpack has no set type — ``packb`` raises
+  TypeError at call time, on the REMOTE caller's request;
+- numpy scalars (``np.int64(...)`` & friends): not packable without a
+  custom default hook, which this control plane deliberately does not
+  install (payload bytes belong on the data plane);
+- bytes-keyed dict literals: they round-trip msgpack itself, but every
+  state/dashboard surface re-exports handler payloads as JSON
+  (``json.dumps`` rejects bytes keys) and older peers unpack with
+  ``strict_map_key=True`` — hex-encode ids at the boundary instead.
+
+The analysis is decidable-shapes-only: literals and direct
+constructor calls in ``return`` expressions of ``h_*`` methods
+(including values nested in dict/list/tuple literals). Dynamic values
+are out of scope — the RPC layer's error path covers those at runtime.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_tpu.devtools.lint.finding import Finding
+from ray_tpu.devtools.lint.registry import (FileContext, Rule, call_name,
+                                            register)
+
+_SET_CTORS = {"set", "frozenset"}
+_NP_SCALARS = {"int8", "int16", "int32", "int64", "uint8", "uint16",
+               "uint32", "uint64", "float16", "float32", "float64",
+               "bool_", "intp", "longlong"}
+
+
+@register
+class MsgpackUnsafeReturnRule(Rule):
+    code = "RT005"
+    name = "msgpack-unsafe-return"
+    description = "msgpack-unsafe value returned from an h_* RPC handler"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name.startswith("h_"):
+                yield from self._check_handler(node, ctx)
+
+    def _check_handler(self, fn, ctx) -> Iterator[Finding]:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                yield from self._check_value(node.value, fn, ctx)
+
+    _COERCERS = {"int", "float", "str", "bool", "bytes", "list", "sorted",
+                 "tuple"}
+
+    def _iter_payload(self, expr) -> Iterator[ast.AST]:
+        """Walk a return expression, pruning subtrees already coerced to
+        a packable type (`int(np.int64(x))` is fine at the boundary)."""
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Call) and \
+                    call_name(node) in self._COERCERS:
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _check_value(self, expr, fn, ctx) -> Iterator[Finding]:
+        for node in self._iter_payload(expr):
+            if isinstance(node, (ast.Set, ast.SetComp)):
+                yield ctx.finding(
+                    self.code, node,
+                    f"handler `{fn.name}` returns a set — msgpack has no "
+                    "set type; the remote caller's request fails at "
+                    "pack time (return a sorted list)")
+            elif isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in _SET_CTORS:
+                    yield ctx.finding(
+                        self.code, node,
+                        f"handler `{fn.name}` returns `{name}(...)` — "
+                        "msgpack has no set type (return a sorted list)")
+                elif self._np_scalar(name):
+                    yield ctx.finding(
+                        self.code, node,
+                        f"handler `{fn.name}` returns numpy scalar "
+                        f"`{name}` — not msgpack-packable on this "
+                        "control plane (coerce with int()/float())")
+            elif isinstance(node, ast.Dict):
+                for key in node.keys:
+                    if isinstance(key, ast.Constant) and \
+                            isinstance(key.value, bytes):
+                        yield ctx.finding(
+                            self.code, key,
+                            f"handler `{fn.name}` returns a bytes-keyed "
+                            "dict — breaks JSON re-export and "
+                            "strict_map_key peers (hex-encode the key)")
+
+    @staticmethod
+    def _np_scalar(name: str) -> bool:
+        parts = name.split(".")
+        return len(parts) == 2 and parts[0] in ("np", "numpy") \
+            and parts[1] in _NP_SCALARS

@@ -1,0 +1,80 @@
+"""Inline suppressions: ``# rtlint: disable=RT001[,RT002|all][ — why]``.
+
+Parsed from the token stream (not the AST — comments don't survive
+parsing). A trailing disable comment applies to the findings on its own
+line; a standalone comment (or comment block) applies to the next code
+line; a disable comment on a ``def`` line covers the whole function body
+(the engine matches finding ``scope_lines`` against the map). Optional
+justification text after the rule list is kept for the report but never
+interpreted. ``disable-file=`` anywhere in a file suppresses those
+rules for the entire file.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from typing import Dict, Set, Tuple
+
+_PRAGMA = re.compile(
+    r"#\s*rtlint:\s*(disable(?:-file)?)\s*=\s*"
+    r"(all|RT\d{3}(?:\s*,\s*RT\d{3})*)",
+    re.IGNORECASE)
+
+ALL = "all"
+
+
+def parse_suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """-> ({lineno: {"RT001", ...} or {"all"}}, file-wide rule set)."""
+    per_line: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+    lines = source.splitlines()
+
+    def _is_code_line(idx0: int) -> bool:
+        stripped = lines[idx0].strip() if idx0 < len(lines) else ""
+        return bool(stripped) and not stripped.startswith("#")
+
+    def _bind_line(lineno: int) -> int:
+        """A standalone pragma comment binds to the next code line (so a
+        justification block sits ABOVE the store it exempts); a trailing
+        pragma binds to its own line."""
+        if _is_code_line(lineno - 1):
+            return lineno
+        nxt = lineno
+        while nxt <= len(lines) and not _is_code_line(nxt - 1):
+            nxt += 1
+        return nxt if nxt <= len(lines) else lineno
+
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA.search(tok.string)
+            if not m:
+                continue
+            kind, rules_raw = m.group(1).lower(), m.group(2)
+            rules = ({ALL} if rules_raw.lower() == ALL
+                     else {r.strip().upper()
+                           for r in rules_raw.split(",")})
+            if kind == "disable-file":
+                file_wide |= rules
+            else:
+                per_line.setdefault(_bind_line(tok.start[0]),
+                                    set()).update(rules)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass   # unparseable file: the engine reports that separately
+    return per_line, file_wide
+
+
+def is_suppressed(rule: str, line: int, scope_lines,
+                  per_line: Dict[int, Set[str]],
+                  file_wide: Set[str]) -> bool:
+    if ALL in file_wide or rule in file_wide:
+        return True
+    for ln in [line, *scope_lines]:
+        rules = per_line.get(ln)
+        if rules and (ALL in rules or rule in rules):
+            return True
+    return False

@@ -54,9 +54,12 @@ def build(name: str) -> str:
 # off-loop put path (per-stripe allocator + rt_write_parallel copy pool)
 # and the lock-striped arena's racy surfaces — lock-free seal CAS,
 # seqlock stats reads, and concurrent create/seal/get/evict across >=4
-# stripes. tsan runs single-process multi-thread only — the
-# cross-process robust-mutex EOWNERDEAD repair path is exercised by the
-# asan harness via a re-exec'd crash child.
+# stripes. The seqlock's publication edge is explicitly annotated for
+# tsan (RT_TSAN_ACQUIRE/RT_TSAN_RELEASE in shm_store.cpp, compiled in
+# only under -fsanitize=thread), so the reader/writer pairing is checked
+# at the protocol level, not just per-field. tsan runs single-process
+# multi-thread only — the cross-process robust-mutex EOWNERDEAD repair
+# path is exercised by the asan harness via a re-exec'd crash child.
 _SELFTESTS = {
     "shm_store_selftest": ["shm_store_selftest.cpp", "shm_store.cpp"],
     "mutable_channel_selftest": ["mutable_channel_selftest.cpp",

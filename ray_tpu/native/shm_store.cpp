@@ -88,6 +88,32 @@ enum : uint32_t { kEmpty = 0, kCreated = 1, kSealed = 2, kTombstone = 3 };
 // builtins (std::atomic members are not guaranteed address-free across
 // processes by the standard; the builtins are, on this ABI, and tsan
 // models them).
+
+// ThreadSanitizer annotations for the seqlock protocol (build:tsan
+// analog — tests/test_sanitizers.py runs the striped hammer under
+// -fsanitize=thread). Every seqlock-covered field is itself accessed
+// through the __atomic builtins above, so tsan already derives the
+// happens-before edges from the atomics; these annotations make the
+// publication edge EXPLICIT at the protocol level (writer's closing
+// lockseq bump releases, reader's validated snapshot acquires), so a
+// future relaxation of a field load to a plain read is still anchored
+// to the seqlock rather than silently racing.
+#if defined(__SANITIZE_THREAD__)
+#define RT_TSAN 1
+#elif defined(__has_feature)
+#if __has_feature(thread_sanitizer)
+#define RT_TSAN 1
+#endif
+#endif
+#ifdef RT_TSAN
+extern "C" void __tsan_acquire(void* addr);
+extern "C" void __tsan_release(void* addr);
+#define RT_TSAN_ACQUIRE(p) __tsan_acquire((void*)(p))
+#define RT_TSAN_RELEASE(p) __tsan_release((void*)(p))
+#else
+#define RT_TSAN_ACQUIRE(p) ((void)0)
+#define RT_TSAN_RELEASE(p) ((void)0)
+#endif
 inline uint32_t ld32(const uint32_t* p, int mo = __ATOMIC_ACQUIRE) {
   return __atomic_load_n(p, mo);
 }
@@ -370,6 +396,10 @@ class StripeGuard {
   }
   ~StripeGuard() {
     st32(&sp_->mutating, 0);
+    // everything mutated in this window is published to seqlock readers
+    // by the closing (even) bump — release BEFORE it so the reader's
+    // paired acquire in snapshot_stripe() covers the whole window
+    RT_TSAN_RELEASE(&sp_->lockseq);
     add64(&sp_->lockseq, 1);  // even: snapshot stable
     pthread_mutex_unlock(&sp_->mutex);
   }
@@ -656,7 +686,12 @@ void snapshot_stripe(Store* s, uint32_t si, StripeSnap* o) {
     if (s0 & 1) continue;
     read_stripe_fields(sp, o);
     __atomic_thread_fence(__ATOMIC_ACQUIRE);
-    if (ld64(&sp->lockseq) == s0) return;
+    if (ld64(&sp->lockseq) == s0) {
+      // validated: pair with the writer's RT_TSAN_RELEASE in
+      // ~StripeGuard — the snapshot happens-after the last closed window
+      RT_TSAN_ACQUIRE(&sp->lockseq);
+      return;
+    }
   }
   StripeGuard g(s, si);
   read_stripe_fields(sp, o);

@@ -333,6 +333,15 @@ def cmd_serve_shutdown(args):
     print("serve shut down")
 
 
+def cmd_lint(args):
+    """Runtime-aware static analysis (rtlint): RT001 loop-blocking,
+    RT002 jit-retrace, RT003 cross-thread mutation, RT004 swallowed
+    exceptions in daemons, RT005 msgpack-unsafe RPC returns. Exits
+    non-zero on NEW findings (baseline + inline suppressions pass)."""
+    from ray_tpu.devtools.lint.cli import run_from_args
+    sys.exit(run_from_args(args))
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -429,6 +438,12 @@ def main(argv=None):
     ssh = srv_sub.add_parser("shutdown")
     ssh.add_argument("--address", default=None)
     ssh.set_defaults(fn=cmd_serve_shutdown)
+
+    plint = sub.add_parser(
+        "lint", help="runtime-aware static analysis (rtlint RT001..RT005)")
+    from ray_tpu.devtools.lint.cli import add_lint_args
+    add_lint_args(plint)
+    plint.set_defaults(fn=cmd_lint)
 
     args = p.parse_args(argv)
     args.fn(args)

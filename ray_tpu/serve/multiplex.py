@@ -38,6 +38,9 @@ class _ModelCache:
         self.lock = threading.Lock()
 
     async def get(self, owner, model_id: str):
+        # rtlint: disable=RT001 — bounded dict-op critical section, never
+        # held across an await; sync callers (loaded_ids/__getstate__)
+        # share the same threading.Lock so an asyncio.Lock can't replace it
         with self.lock:
             if model_id in self.models:
                 self.models.move_to_end(model_id)
@@ -45,6 +48,7 @@ class _ModelCache:
         model = self.loader(owner, model_id)
         if asyncio.iscoroutine(model):
             model = await model
+        # rtlint: disable=RT001 — bounded dict-op critical section (above)
         with self.lock:
             self.models[model_id] = model
             self.models.move_to_end(model_id)

@@ -1,0 +1,3 @@
+# Fixture corpus for tests/test_lint.py — deliberately buggy snippets.
+# Never imported; linted as files. Kept out of the default lint paths
+# (pyproject [tool.rtlint] paths = ["ray_tpu"]).

@@ -1,0 +1,2 @@
+# RT004 is scoped to _private/ paths; these fixtures live under a
+# _private/ segment so the rule applies to them.

@@ -1,0 +1,21 @@
+"""BAD: silent broad excepts inside daemon loops (RT004)."""
+import asyncio
+import time
+
+
+def flush_daemon(flush):
+    while True:
+        time.sleep(1.0)
+        try:
+            flush()
+        except Exception:                    # RT004: swallowed every tick
+            pass
+
+
+async def refresh_loop(gcs):
+    for attempt in range(30):
+        try:
+            await gcs.call("get_view")
+        except:                              # RT004: bare + silent, in loop
+            pass
+        await asyncio.sleep(1)

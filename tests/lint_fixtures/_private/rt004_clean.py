@@ -1,0 +1,29 @@
+"""CLEAN for RT004: logged, counted, narrowed, or outside a loop."""
+import logging
+import time
+
+logger = logging.getLogger(__name__)
+
+
+def logged_daemon(flush):
+    while True:
+        time.sleep(1.0)
+        try:
+            flush()
+        except Exception:
+            logger.debug("flush failed", exc_info=True)   # visible
+
+
+def narrowed_daemon(read):
+    while True:
+        try:
+            read()
+        except OSError:                      # narrowed type: deliberate
+            pass
+
+
+def one_shot(best_effort):
+    try:
+        best_effort()
+    except Exception:                        # not in a loop: out of scope
+        pass

@@ -1,0 +1,8 @@
+"""Baseline fixture: one unsuppressed violation that test_lint.py
+registers in a temp baseline file (with a justification), proving the
+gate passes on baselined findings and fails without them."""
+import time
+
+
+async def legacy_block():
+    time.sleep(0.5)          # known legacy finding — baselined in test

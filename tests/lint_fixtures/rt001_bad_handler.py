@@ -1,0 +1,24 @@
+"""BAD: blocking ops in a registered h_* handler and a lock acquire in
+an async def (RT001 x3)."""
+import socket
+import threading
+
+_state_lock = threading.Lock()
+
+
+class NodeThing:
+    def __init__(self):
+        self._sock = socket.socket()
+
+    def h_fetch(self, conn, addr):
+        # sync h_* handlers dispatch inline on the owner loop
+        self._sock.connect(addr)              # RT001: blocking socket op
+        sock = socket.create_connection(addr)  # RT001: blocking connect
+        return sock
+
+    async def h_report(self, conn):
+        _state_lock.acquire()                 # RT001: blocking lock acquire
+        try:
+            return {"ok": True}
+        finally:
+            _state_lock.release()

@@ -1,0 +1,14 @@
+"""BAD: blocking calls inside async bodies (RT001 x3)."""
+import subprocess
+import time
+
+
+async def heartbeat_loop():
+    while True:
+        time.sleep(1.0)                       # RT001: blocks the loop
+
+
+async def spawn_helper():
+    subprocess.run(["true"])                  # RT001: blocking subprocess
+    with open("/tmp/x") as f:                 # RT001: blocking file IO
+        return f.read()

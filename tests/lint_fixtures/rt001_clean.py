@@ -1,0 +1,23 @@
+"""CLEAN for RT001: awaits, executor thunks, sync-context sleeps."""
+import asyncio
+import time
+
+
+async def polite_loop():
+    while True:
+        await asyncio.sleep(1.0)              # the async way
+
+
+async def offloaded_read(path):
+    loop = asyncio.get_event_loop()
+
+    def _read():                              # nested sync def: runs in
+        with open(path, "rb") as f:           # the executor, not the loop
+            time.sleep(0.01)
+            return f.read()
+
+    return await loop.run_in_executor(None, _read)
+
+
+def worker_thread_tick():
+    time.sleep(0.5)                           # sync context: fine

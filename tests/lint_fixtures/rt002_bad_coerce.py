@@ -1,0 +1,17 @@
+"""BAD: host coercion + traced branch + .item() in jitted fns (RT002)."""
+import jax
+
+
+@jax.jit
+def decode_step(lengths, toks):
+    cur = int(lengths)                 # RT002: concretizes traced value
+    if toks > 0:                       # RT002: Python branch on traced arg
+        return toks + cur
+    return toks
+
+
+def build(model):
+    def sample(logits, temp):
+        t = temp.item()                # RT002: .item() host sync
+        return logits / t
+    return jax.jit(sample)

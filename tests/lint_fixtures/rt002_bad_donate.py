@@ -1,0 +1,19 @@
+"""BAD: donated-buffer reuse + unhashable static default (RT002)."""
+import jax
+
+
+def step(kv, tok):
+    return kv + tok, tok
+
+
+def loss(x, cfg=[1, 2]):               # noqa: B006 — deliberate
+    return x * cfg[0]
+
+
+jit_step = jax.jit(step, donate_argnums=(0,))
+jit_loss = jax.jit(loss, static_argnums=(1,))  # RT002: mutable static default
+
+
+def run(kv, tok):
+    out, tok2 = jit_step(kv, tok)
+    return kv.sum() + tok2             # RT002: kv was donated above

@@ -1,0 +1,30 @@
+"""CLEAN for RT002: shape math, static-arg branches, donation rebind,
+early-return branches that never alias the donated buffer."""
+import jax
+
+
+@jax.jit
+def shape_math(x):
+    n = int(x.shape[0])                # shapes are static under tracing
+    return x.reshape(n, -1), len(x.shape)
+
+
+def make(fn):
+    inner = jax.jit(fn, static_argnums=(1,))
+    return inner
+
+
+def branch_on_static(x, mode):
+    f = jax.jit(lambda a: a, static_argnums=())
+    if mode == "fast":                 # mode isn't traced here (host code)
+        return f(x)
+    return f(x) * 2
+
+
+jit_roll = jax.jit(lambda kv: kv * 2, donate_argnums=(0,))
+
+
+def decode_loop(kv, steps):
+    for _ in range(steps):
+        kv = jit_roll(kv)              # rebinding: the donated name is
+    return kv                          # always the NEW buffer

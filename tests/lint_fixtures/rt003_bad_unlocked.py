@@ -1,0 +1,20 @@
+"""BAD: unlocked self.* stores in @off_loop methods (RT003)."""
+import threading
+
+from ray_tpu._private.markers import off_loop
+
+
+class PutPath:
+    def __init__(self):
+        self._ref_lock = threading.Lock()
+        self.count = 0
+        self.table = {}
+
+    @off_loop(lock="_ref_lock")
+    def record(self, oid):
+        self.count += 1                      # RT003: RMW outside the lock
+        self.table[oid] = self.table.get(oid, 0) + 1   # RT003: store
+
+    @off_loop()
+    def mark(self, flag):
+        self.flag = flag                     # RT003: no lock even declared

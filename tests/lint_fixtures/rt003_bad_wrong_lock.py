@@ -1,0 +1,16 @@
+"""BAD: store under the WRONG lock in an @off_loop method (RT003)."""
+import threading
+
+from ray_tpu._private.markers import off_loop
+
+
+class ArenaClient:
+    def __init__(self):
+        self._pins_lock = threading.Lock()
+        self._other_lock = threading.Lock()
+        self._pins = {}
+
+    @off_loop(lock="_pins_lock")
+    def pin(self, oid):
+        with self._other_lock:               # not the declared lock
+            self._pins[oid] = self._pins.get(oid, 0) + 1   # RT003

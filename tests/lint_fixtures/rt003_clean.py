@@ -1,0 +1,22 @@
+"""CLEAN for RT003: stores under the declared lock; unmarked methods
+are out of scope; local/arg stores are not self state."""
+import threading
+
+from ray_tpu._private.markers import off_loop
+
+
+class PutPath:
+    def __init__(self):
+        self._ref_lock = threading.Lock()
+        self.count = 0
+
+    @off_loop(lock="_ref_lock")
+    def record(self, oid):
+        local = oid * 2                      # locals are thread-private
+        with self._ref_lock:
+            self.count += 1                  # guarded RMW
+            self.last = local
+        return local
+
+    def loop_side(self):
+        self.count = 0                       # unmarked: loop-owned, fine

@@ -1,0 +1,16 @@
+"""BAD: msgpack-unsafe handler returns (RT005)."""
+import numpy as np
+
+
+class Handlers:
+    def h_list_nodes(self, conn):
+        return {"alive": {"n1", "n2"}}       # RT005: set in the payload
+
+    def h_count(self, conn):
+        return np.int64(3)                   # RT005: numpy scalar
+
+    async def h_locations(self, conn, oid):
+        return {b"\x01\x02": "n1"}           # RT005: bytes-keyed dict
+
+    def h_ids(self, conn, rows):
+        return set(r["id"] for r in rows)    # RT005: set() constructor

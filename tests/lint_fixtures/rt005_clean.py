@@ -1,0 +1,17 @@
+"""CLEAN for RT005: lists, str keys, coerced scalars, and non-handler
+methods (out of scope)."""
+import numpy as np
+
+
+class Handlers:
+    def h_list_nodes(self, conn):
+        return {"alive": sorted(["n1", "n2"])}
+
+    def h_count(self, conn):
+        return int(np.int64(3))              # coerced at the boundary
+
+    async def h_locations(self, conn, oid):
+        return {oid.hex(): "n1"}
+
+    def internal_set(self):                  # not an h_* handler
+        return {"x", "y"}

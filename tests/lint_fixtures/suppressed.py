@@ -1,0 +1,18 @@
+"""Suppression syntax fixture: every violation here carries a pragma,
+so a lint run reports zero findings but a nonzero suppressed count."""
+import time
+
+
+async def tick():
+    # trailing same-line pragma
+    time.sleep(0.01)  # rtlint: disable=RT001 — test fixture: deliberate
+
+    # standalone pragma block binds to the next code line
+    # rtlint: disable=RT001 — also deliberate
+    time.sleep(0.02)
+
+
+# def-line pragma covers the whole body
+async def settle():  # rtlint: disable=RT001 — fixture: scope suppression
+    time.sleep(0.03)
+    time.sleep(0.04)

@@ -45,11 +45,16 @@ def test_native_tsan_concurrent_puts():
     lock-striped arena — concurrent create/seal/get against a
     per-stripe evictor and a lock-free rt_stats poller on a 4-stripe
     store (the lock-free seal CAS and seqlock snapshot reads are the
-    racy surfaces this build exists to watch). Single-process
-    multi-thread is the regime tsan models well; cross-process
-    robust-mutex EOWNERDEAD repair stays with the asan harness above
-    (re-exec'd crash child). Any data race aborts with a nonzero
-    exit."""
+    racy surfaces this build exists to watch). The seqlock's
+    publication edge carries explicit __tsan_acquire/__tsan_release
+    annotations (shm_store.cpp RT_TSAN_*, compiled in only under this
+    build): the stats reader's validated snapshot is anchored to the
+    writer's closing lockseq bump at the protocol level, so a future
+    relaxation of a per-field atomic to a plain load still trips tsan
+    instead of silently racing. Single-process multi-thread is the
+    regime tsan models well; cross-process robust-mutex EOWNERDEAD
+    repair stays with the asan harness above (re-exec'd crash child).
+    Any data race aborts with a nonzero exit."""
     from ray_tpu.native.build import build_selftest
     binary = build_selftest("shm_store_selftest", sanitize="thread")
     r = subprocess.run([binary, "/dev/shm/rt_selftest_tsan_pytest"],
