@@ -473,6 +473,37 @@ def bench_serve_tokens_per_s(tpu_ok: bool = False):
     return {"skipped": True, "reason": last}
 
 
+def bench_serve_availability_under_churn():
+    """Serving availability under rolling replica loss
+    (reports/churn_probe.py): the same Poisson streaming workload run
+    quiet and under churn (alternating graceful preemption notices and
+    hard kills, >= 3 losses), with exactly-once token delivery checked
+    against a greedy reference. The headline is the p95-TTFT ratio
+    churn/quiet; error_rate, dropped/duplicated token counts ride in
+    the same entry and are expected to be ZERO — a nonzero count is a
+    robustness regression, not a slow run. Needs the cluster runtime
+    (Python >= 3.12)."""
+    import os
+    import sys
+    if sys.version_info < (3, 12):
+        return {"skipped": True,
+                "reason": "cluster runtime requires Python >= 3.12"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    runner = os.path.join(here, "reports", "churn_probe.py")
+    spec = {"n_replicas": 2, "n_slots": 2, "n_requests": 16,
+            "arrival_rate_rps": 4.0, "min_losses": 3,
+            "loss_interval_s": 3.0, "seed": 0}
+    last = "unknown"
+    for attempt in range(2):
+        if attempt:
+            time.sleep(10)
+        result, last = _run_probe(runner, spec, timeout=1200)
+        if result is not None:
+            return result
+        log(f"churn probe failed: {last}")
+    return {"skipped": True, "reason": last}
+
+
 def bench_transfer_gb_per_s():
     """Cross-node object-transfer bandwidth (reports/transfer_probe.py):
     a 256 MB object pushed between two single-box node managers over
@@ -913,6 +944,34 @@ def main():
         log(f"serve probe FAILED: {e}")
         results["serve_tokens_per_s"] = {"skipped": True,
                                          "reason": str(e)[:200]}
+
+    try:
+        churn = bench_serve_availability_under_churn()
+        if not churn.get("skipped"):
+            results["serve_availability_under_churn"] = {
+                "value": churn.get("vs_quiet_p95"),
+                "unit": "p95_ttft_ratio_churn_vs_quiet",
+                "error_rate": churn.get("error_rate"),
+                "dropped_streams": churn.get("dropped_streams"),
+                "dropped_tokens": churn.get("dropped_tokens"),
+                "duplicated_tokens": churn.get("duplicated_tokens"),
+                "losses": churn.get("losses"),
+                "ttft_p95_ms_quiet": churn.get("ttft_p95_ms_quiet"),
+                "ttft_p95_ms_churn": churn.get("ttft_p95_ms_churn"),
+                "n_replicas": churn.get("n_replicas")}
+            log(f"serve_availability_under_churn: p95 ratio "
+                f"{churn.get('vs_quiet_p95')} (errors "
+                f"{churn.get('error_rate')}, dropped "
+                f"{churn.get('dropped_tokens')}, dup "
+                f"{churn.get('duplicated_tokens')}, losses "
+                f"{churn.get('losses')})")
+        else:
+            results["serve_availability_under_churn"] = churn
+            log(f"churn probe skipped: {churn.get('reason')}")
+    except Exception as e:
+        log(f"churn probe FAILED: {e}")
+        results["serve_availability_under_churn"] = {
+            "skipped": True, "reason": str(e)[:200]}
 
     try:
         rec = bench_observability_overhead()

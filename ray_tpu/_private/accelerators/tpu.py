@@ -81,6 +81,48 @@ def _gce_metadata(path: str) -> Optional[str]:
     return value
 
 
+# ------------------------------------------------------ preemption notice
+# Spot/preemptible TPU-VMs get ~30s of warning: GCE flips the
+# instance/preempted metadata attribute (and delivers the ACPI G2 soft
+# off) before the hard kill. Serving replicas poll this channel and
+# drain instead of dying mid-stream (serve/replica.py); chaos tests
+# inject the notice without a cloud via the env/file hooks below.
+PREEMPT_TEST_ENV = "RAY_TPU_TESTING_PREEMPTED"
+PREEMPT_TEST_FILE_ENV = "RAY_TPU_TESTING_PREEMPT_FILE"
+
+
+def preemption_watch_enabled() -> bool:
+    """Whether polling for preemption notices can ever observe one:
+    on GCE, or when a chaos injection hook is armed."""
+    return bool(os.environ.get(PREEMPT_TEST_ENV)
+                or os.environ.get(PREEMPT_TEST_FILE_ENV)
+                or _on_gce())
+
+
+def check_preemption_notice() -> bool:
+    """True once the platform announced this VM is being preempted.
+    Deliberately NOT cached (unlike _gce_metadata) — the whole point is
+    observing the flip; callers poll on a ~1s cadence. Chaos channels
+    are checked first: the env flag arms a whole process at spawn, the
+    marker file lets a test flip a LIVE replica from outside."""
+    if os.environ.get(PREEMPT_TEST_ENV):
+        return True
+    marker = os.environ.get(PREEMPT_TEST_FILE_ENV)
+    if marker:
+        return os.path.exists(marker)
+    if not _on_gce():
+        return False
+    try:
+        import urllib.request
+        req = urllib.request.Request(
+            _GCE_METADATA_URL + "instance/preempted",
+            headers={"Metadata-Flavor": "Google"})
+        with urllib.request.urlopen(req, timeout=_GCE_TIMEOUT_S) as r:
+            return r.read().decode().strip().upper() == "TRUE"
+    except Exception:
+        return False
+
+
 class TPUAcceleratorManager(AcceleratorManager):
     @staticmethod
     def get_resource_name() -> str:

@@ -28,12 +28,21 @@ class AutoscalerConfig:
     node_types: Dict[str, NodeTypeConfig]
     idle_timeout_s: float = 30.0
     upscale_interval_s: float = 2.0
+    # consecutive step() failures back the loop off exponentially up to
+    # this cap (a dead cloud API must not be hammered — nor fill the log
+    # — every upscale_interval_s)
+    max_backoff_s: float = 60.0
+    # fold the serve controller's unmet replica demand
+    # (ServeController.get_replica_demand) into binpacking, so the
+    # provider acquires TPU slices for replicas the serve control loop
+    # wants before their lease requests even reach a node manager
+    serve_demand: bool = True
 
 
 class Autoscaler:
     def __init__(self, config: AutoscalerConfig, provider,
                  protected_node_ids: Optional[List[str]] = None,
-                 nodes_fn=None):
+                 nodes_fn=None, serve_demand_fn=None):
         self.config = config
         self.provider = provider
         self.protected = set(protected_node_ids or [])
@@ -44,12 +53,56 @@ class Autoscaler:
         # TPU-VM) isn't re-launched every step for the same demand
         self._inflight: Dict[str, str] = {}   # node_id -> node_type
         self._idle_since: Dict[str, float] = {}
+        # serve demand source: injected fn for tests, else lazily
+        # discovered SERVE_CONTROLLER actor (absent = no serve = [])
+        self._serve_demand_fn = serve_demand_fn
+        self._serve_ctrl = None
+        self._serve_ctrl_next_probe = 0.0
+        self._consecutive_failures = 0
+        from ray_tpu.util.metrics import Counter
+        self._step_failures = Counter(
+            "autoscaler_step_failures",
+            "autoscaler reconcile steps that raised (provider/API "
+            "errors); the run loop backs off exponentially while these "
+            "accumulate")
 
     def _cluster_nodes(self) -> List[Dict]:
         if self._nodes_fn is not None:
             return self._nodes_fn()
         import ray_tpu
         return ray_tpu.nodes()
+
+    def _serve_demand(self) -> List[Dict[str, float]]:
+        """Replica demand exported by the serve control loop (ROADMAP
+        item 2: the burn-rate autoscaler raises targets, THIS is how
+        those targets turn into TPU slices). Best-effort: no controller
+        (or a dead one) means no serve demand, never a failed step."""
+        if not self.config.serve_demand:
+            return []
+        if self._serve_demand_fn is not None:
+            try:
+                return list(self._serve_demand_fn() or [])
+            except Exception:
+                return []
+        import ray_tpu
+        now = time.monotonic()
+        if self._serve_ctrl is None:
+            if now < self._serve_ctrl_next_probe:
+                return []
+            try:
+                self._serve_ctrl = ray_tpu.get_actor(
+                    "SERVE_CONTROLLER", namespace="serve")
+            except Exception:
+                # no serve session yet; re-probe at a gentle cadence
+                self._serve_ctrl_next_probe = now + 10.0
+                return []
+        try:
+            return list(ray_tpu.get(
+                self._serve_ctrl.get_replica_demand.remote(),
+                timeout=5) or [])
+        except Exception:
+            self._serve_ctrl = None   # controller died/rolled: rediscover
+            return []
 
     def step(self) -> Dict:
         """One reconcile iteration; returns a summary of actions."""
@@ -58,6 +111,22 @@ class Autoscaler:
         demand: List[Dict[str, float]] = []
         for n in alive:
             demand.extend(n.get("pending_demand") or [])
+        # serve demand dedupes against lease demand: once a wanted
+        # replica's actor lease is queued at a node manager it shows up
+        # in pending_demand with the same resource shape — counting both
+        # would double-launch
+        serve_rows = self._serve_demand()
+        if serve_rows:
+            queued: Dict[tuple, int] = {}
+            for req in demand:
+                k = tuple(sorted(req.items()))
+                queued[k] = queued.get(k, 0) + 1
+            for req in serve_rows:
+                k = tuple(sorted(req.items()))
+                if queued.get(k, 0) > 0:
+                    queued[k] -= 1
+                else:
+                    demand.append(req)
         actions = {"launched": [], "terminated": []}
 
         # reconcile in-flight launches: once a launched node registers it
@@ -176,10 +245,38 @@ class Autoscaler:
             self._idle_since.pop(nid, None)
         return actions
 
+    def _step_delay(self, failures: int) -> float:
+        """Loop cadence: the configured interval while healthy, doubling
+        per consecutive failure up to max_backoff_s — a dead provider
+        API is retried at a polite pace instead of hot-looping a full
+        stack trace every interval."""
+        base = self.config.upscale_interval_s
+        if failures <= 0:
+            return base
+        return min(self.config.max_backoff_s,
+                   base * (2.0 ** min(failures, 6)))
+
     def run(self, stop_event=None):
         while stop_event is None or not stop_event.is_set():
             try:
                 self.step()
+                self._consecutive_failures = 0
             except Exception:
-                logger.exception("autoscaler step failed")
-            time.sleep(self.config.upscale_interval_s)
+                self._consecutive_failures += 1
+                self._step_failures.inc()
+                if self._consecutive_failures == 1:
+                    logger.exception("autoscaler step failed")
+                else:
+                    # the first failure carried the stack; repeats log
+                    # one line with the escalating backoff
+                    logger.warning(
+                        "autoscaler step failed (%d consecutive); "
+                        "backing off %.1fs",
+                        self._consecutive_failures,
+                        self._step_delay(self._consecutive_failures))
+            delay = self._step_delay(self._consecutive_failures)
+            if stop_event is not None:
+                if stop_event.wait(delay):
+                    return
+            else:
+                time.sleep(delay)

@@ -60,7 +60,19 @@ class LLMDeployment:
         init with `seed` — the CI/bench shape.
     Engine knobs (n_slots, max_len, prefill_chunk, prefill_budget,
     eos_id, temperature, top_k, top_p) mirror EngineConfig.
+
+    Streaming resume (``__serve_resumable__``): a stream severed by
+    replica death is resubmitted by the handle layer with
+    ``resume_tokens=<tokens already delivered>``; the generated-so-far
+    suffix rides the prompt through the chunked-prefill path on the
+    survivor and generation continues from the exact next position —
+    zero dropped, zero duplicated tokens for greedy decoding (sampled
+    decoding resumes from the same position but re-draws randomness).
     """
+
+    # handle.py resubmits severed streams with resume_tokens= instead of
+    # restarting them from scratch (serve/handle.py stream re-route)
+    __serve_resumable__ = True
 
     def __init__(self, model="llama-debug", *, n_slots: int = 4,
                  max_len: int = 256, prefill_chunk: int = 32,
@@ -93,12 +105,24 @@ class LLMDeployment:
     def __call__(self, prompt_tokens, max_new_tokens: int = 64,
                  temperature: Optional[float] = None,
                  eos_id: Optional[int] = None,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 resume_tokens=None):
         """Streaming generator: yields one token id at a time. Invoked
         with .options(stream=True) this rides the replica streaming
         path; the finally-cancel frees the slot when the client drops
-        the iterator mid-generation (GeneratorExit lands here)."""
+        the iterator mid-generation (GeneratorExit lands here).
+
+        resume_tokens: tokens a previous attempt already delivered —
+        they re-prefill as part of the prompt (the chunked-prefill path
+        makes this one budgeted admission, not a decode replay) and only
+        the continuation is yielded."""
         from ray_tpu._private import events
+        if resume_tokens:
+            resume_tokens = [int(t) for t in resume_tokens]
+            prompt_tokens = list(prompt_tokens) + resume_tokens
+            max_new_tokens = int(max_new_tokens) - len(resume_tokens)
+            if max_new_tokens <= 0:
+                return   # the dead replica already delivered everything
         # the request span chains under the replica task's propagated
         # trace context (the generator body runs inside handle_stream's
         # execution, which re-establishes it per resumption), and the
@@ -147,6 +171,17 @@ class LLMDeployment:
     # ------------------------------------------------------------- control
     def stats(self) -> Dict:
         return self.engine.stats()
+
+    def begin_drain(self):
+        """Preemption notice (serve/replica.py relays it here): the
+        engine refuses new submissions — the handle layer re-routes
+        them — while queued and in-flight requests run to completion."""
+        self.engine.begin_drain()
+
+    def drain_status(self) -> Dict:
+        st = self.engine.stats()
+        return {"draining": st["draining"],
+                "pending": st["slots_occupied"] + st["queue_depth"]}
 
     def check_health(self):
         if self.engine._thread is not None \

@@ -263,6 +263,14 @@ class InferenceEngine:
             self._work.notify_all()
         return h
 
+    def begin_drain(self):
+        """Preemption drain: refuse new submissions (submit raises and
+        the serving layer re-routes), finish everything in flight. The
+        loop keeps stepping until the last slot evicts."""
+        with self._work:
+            self.sched.begin_drain()
+            self._work.notify_all()
+
     # --------------------------------------------------------------- loop
     def start(self) -> "InferenceEngine":
         with self._lock:
@@ -486,4 +494,5 @@ class InferenceEngine:
             "steps": self.steps,
             "tokens_generated": self.tokens_generated,
             "decode_compile_count": self.decode_compile_count,
+            "draining": self.sched.draining,
         }

@@ -168,9 +168,28 @@ class Scheduler:
         self._prefilling: List[RequestState] = []  # slot held, prompt wip
         self._active: Dict[int, RequestState] = {}  # slot -> state
         self._free_slots = list(range(n_slots))
+        # drain mode (preemption notice): new submissions are refused —
+        # the caller re-routes them to a surviving replica — while
+        # everything already queued/prefilling/active runs to completion
+        self.draining = False
+
+    # ----------------------------------------------------------- draining
+    def begin_drain(self):
+        """Flip admission off ahead of a preemption kill. Idempotent;
+        there is no un-drain — a drained replica is on its way out."""
+        self.draining = True
+
+    def drained(self) -> bool:
+        """True once every in-flight request has finished (the point at
+        which the controller may reap the replica early)."""
+        return self.draining and not self.has_work()
 
     # ------------------------------------------------------------ intake
     def submit(self, request: Request) -> RequestHandle:
+        if self.draining:
+            raise RuntimeError(
+                "scheduler is draining (preemption notice): new "
+                "requests must be routed to another replica")
         rid = next(self._rid)
         handle = RequestHandle(rid)
         temp = (request.temperature

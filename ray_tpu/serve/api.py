@@ -22,14 +22,23 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 16,
                ray_actor_options: Optional[Dict] = None,
                autoscaling_config=None, slo_config=None,
-               num_hosts: int = 1,
+               num_hosts: int = 1, resumable_streams: Optional[bool] = None,
+               preempt_grace_s: Optional[float] = None,
                topology: Optional[str] = None, **_ignored):
     def wrap(target):
+        # a callable opts into stream resume with __serve_resumable__ =
+        # True (its streaming methods accept resume_tokens=); the
+        # explicit kwarg overrides either way
+        resumable = (getattr(target, "__serve_resumable__", False)
+                     if resumable_streams is None else resumable_streams)
         cfg = DeploymentConfig(
             num_replicas=num_replicas,
             max_ongoing_requests=max_ongoing_requests,
             ray_actor_options=ray_actor_options,
-            num_hosts=num_hosts, topology=topology)
+            num_hosts=num_hosts, topology=topology,
+            resumable_streams=bool(resumable))
+        if preempt_grace_s is not None:
+            cfg.preempt_grace_s = float(preempt_grace_s)
         if autoscaling_config is not None:
             cfg.autoscaling_config = (
                 AutoscalingConfig(**autoscaling_config)

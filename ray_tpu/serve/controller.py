@@ -83,6 +83,10 @@ class ServeController:
         # tick against the GCS time-series plane for deployments that
         # declared slo_config
         self._slo_tracker = None
+        # burn-driven replica scaling (serve/slo.py BurnRateScaler):
+        # one policy instance per (app, deployment)
+        self._burn_scalers: Dict[tuple, Any] = {}
+        self._target_gauge = None
         self._longpoll = threading.Condition()
         self._proxy_reconcile_lock = threading.Lock()
         self._thread = threading.Thread(target=self._reconcile_loop,
@@ -471,8 +475,13 @@ class ServeController:
                                app_name, name)
             except Exception:
                 alive.append(r)   # slow ≠ dead
-        lens = self._probe_loads(dep)
+        probed, states = self._probe_states(dep)
+        lens = ([int(s.get("queue_len") or 0) for s in states]
+                if states is not None else None)
         self._reap_draining(dep)
+        # SLO evaluation talks to the GCS — keep it off the lock; the
+        # rows feed both get_slo_status and the burn scaler below
+        slo_rows = self._evaluate_slo(app_name, name, dep)
         dead = []
         with self._lock:
             if len(alive) != len(dep["replicas"]):
@@ -486,7 +495,17 @@ class ServeController:
                 dep["replicas"] = alive
                 dep["version"] += 1
                 self._bump_dep(dep)
+            # preemption notices: a replica that flipped itself into
+            # draining (GCE metadata / chaos channel) leaves the routing
+            # table NOW and a replacement pre-starts below — the notice
+            # grace, not the health checker, is its clock from here on
+            if states is not None:
+                for r, s in zip(probed, states):
+                    if s.get("draining"):
+                        self._detach_for_drain(
+                            dep, r, self._preempt_grace(dep))
             self._autoscale(app_name, name, dep, lens)
+            self._burn_autoscale(app_name, name, dep, slo_rows, lens)
             n_create = self._reconcile_deployment(dep)
         # a dead sharded rank-0 leaves peers + a PG behind: tear the
         # gang down — OUTSIDE the lock, kill RPCs can block on slow
@@ -495,7 +514,7 @@ class ServeController:
         for r in dead:
             self._kill_replica(dep, r)
         self._publish_loads(dep, lens)
-        self._evaluate_slo(app_name, name, dep)
+        self._export_target(app_name, name, dep)
         # slow construction (sharded gangs: pg wait + jax.distributed
         # init + model load) runs on its own thread so ONE rebuilding
         # deployment never stalls the others' health checks — the
@@ -522,14 +541,152 @@ class ServeController:
             auto, hist, float(sum(lens)), dep["target"], now,
             self._up_since, self._down_since, key)
 
-    def _start_drain(self, dep: Dict, victim):
+    def _burn_autoscale(self, app_name, name, dep, rows, lens=None):
+        """Burn-driven replica scaling (serve/slo.py BurnRateScaler):
+        sustained dual-window SLO burn raises dep["target"], sustained
+        idle burn releases replicas — with hold + cooldown so instant
+        spikes don't flap the fleet. Requires BOTH autoscaling_config
+        (bounds + knobs) and slo_config (the signal). Caller holds
+        self._lock."""
+        auto = dep["spec"]["config"].get("autoscaling_config")
+        if not auto or not rows:
+            return
+        from ray_tpu.serve.slo import BurnRateScaler
+        key = (app_name, name)
+        scaler = self._burn_scalers.setdefault(key, BurnRateScaler())
+        total_load = float(sum(lens)) if lens else 0.0
+        new_target = scaler.decide(auto, rows, dep["target"], total_load,
+                                   time.monotonic())
+        if new_target == dep["target"]:
+            return
+        from ray_tpu._private import events
+        events.record_instant(
+            "serve.autoscale", category="serve", app=app_name,
+            deployment=name, old_target=dep["target"],
+            new_target=new_target,
+            burn_slow=max((r.get("burn_slow") or 0.0 for r in rows),
+                          default=0.0))
+        logger.info("burn autoscale %s/%s: target %d -> %d", app_name,
+                    name, dep["target"], new_target)
+        dep["target"] = new_target
+
+    def _export_target(self, app_name: str, name: str, dep: Dict):
+        """serve_replica_target / serve_replica_deficit gauges: the
+        autoscaler and dashboards watch the control loop's intent, not
+        just its outcome."""
+        if self._target_gauge is None:
+            from ray_tpu.util.metrics import Gauge
+            self._target_gauge = {
+                "target": Gauge("serve_replica_target",
+                                "replica target per deployment",
+                                tag_keys=("app", "deployment")),
+                "deficit": Gauge("serve_replica_deficit",
+                                 "replicas wanted but not yet running",
+                                 tag_keys=("app", "deployment")),
+            }
+        tags = {"app": app_name, "deployment": name}
+        with self._lock:
+            target = dep["target"]
+            running = len(dep["replicas"])
+        self._target_gauge["target"].set(float(target), tags=tags)
+        self._target_gauge["deficit"].set(float(max(0, target - running)),
+                                          tags=tags)
+
+    def get_replica_demand(self) -> List[Dict]:
+        """Unmet replica demand as resource requests — one dict per
+        missing replica, shaped like a node-manager pending_demand row —
+        so the cluster autoscaler (autoscaler/autoscaler.py) acquires
+        TPU slices/VMs for replicas the serve control loop wants but
+        cannot place yet, instead of waiting for lease-queue
+        heartbeats."""
+        out: List[Dict] = []
+        with self._lock:
+            for app in self.apps.values():
+                for dep in app.values():
+                    deficit = dep["target"] - len(dep["replicas"])
+                    if deficit <= 0:
+                        continue
+                    spec = dep["spec"]
+                    opts = dict(spec["config"].get("ray_actor_options")
+                                or {})
+                    req: Dict[str, float] = {
+                        "CPU": float(opts.get("num_cpus", 0.25))}
+                    if opts.get("num_tpus"):
+                        req["TPU"] = float(opts["num_tpus"])
+                    for k, v in (opts.get("resources") or {}).items():
+                        req[k] = float(v)
+                    out.extend([dict(req)] * int(deficit))
+        return out
+
+    def _start_drain(self, dep: Dict, victim,
+                     timeout_s: Optional[float] = None):
         """Enroll a retired replica for graceful drain (deadline from
-        the deployment's graceful_shutdown_timeout_s, default 30s).
-        Caller holds self._lock."""
-        timeout = float(dep["spec"]["config"]
-                        .get("graceful_shutdown_timeout_s", 30.0))
+        the deployment's graceful_shutdown_timeout_s, default 30s;
+        preemptions pass the shorter notice grace). Caller holds
+        self._lock."""
+        if timeout_s is None:
+            timeout_s = float(dep["spec"]["config"]
+                              .get("graceful_shutdown_timeout_s", 30.0))
         dep.setdefault("draining", []).append(
-            (victim, time.time() + timeout))
+            (victim, time.time() + float(timeout_s)))
+
+    def _preempt_grace(self, dep: Dict) -> float:
+        return float(dep["spec"]["config"].get("preempt_grace_s", 25.0))
+
+    def _detach_for_drain(self, dep: Dict, victim,
+                          grace_s: Optional[float] = None) -> bool:
+        """Remove a replica from the routing set and enroll it for
+        drain — the draining replica never appears in a routing table
+        again (get_deployment_info reads dep["replicas"]). Caller holds
+        self._lock. Returns False when the replica already left the set
+        (raced with a health-check prune or a second notice)."""
+        idx = next((i for i, r in enumerate(dep["replicas"])
+                    if r is victim), None)
+        if idx is None:
+            return False
+        dep["replicas"].pop(idx)
+        gens = dep.get("replica_gens") or []
+        if idx < len(gens):
+            gens.pop(idx)
+        self._start_drain(dep, victim, grace_s)
+        dep["version"] += 1
+        self._bump_dep(dep)
+        return True
+
+    def preempt_replica(self, app_name: str, name: str,
+                        replica_index: int = 0,
+                        grace_s: Optional[float] = None) -> bool:
+        """Notice-based preemption (the graceful half of spot-TPU
+        economics): deliver a drain notice to one replica, drop it from
+        the routing table, and pre-start its replacement immediately —
+        BEFORE the kill deadline, so capacity never dips. The replica
+        finishes in-flight streams; _reap_draining force-kills it at
+        the grace deadline if its queue never empties."""
+        import ray_tpu
+        with self._lock:
+            dep = self.apps.get(app_name, {}).get(name)
+            if dep is None or not dep["replicas"]:
+                return False
+            victim = dep["replicas"][replica_index % len(dep["replicas"])]
+        try:
+            # outside the lock: the notice is an RPC into user code
+            ray_tpu.get(victim.begin_drain.remote(), timeout=10)
+        except Exception:
+            # already dead or wedged — the health checker replaces it
+            # through the crash path instead
+            logger.warning("drain notice to %s/%s replica failed",
+                           app_name, name, exc_info=True)
+        with self._lock:
+            if grace_s is None:
+                grace_s = self._preempt_grace(dep)
+            if not self._detach_for_drain(dep, victim, grace_s):
+                return False
+            n_create = self._reconcile_deployment(dep)
+        if n_create:
+            threading.Thread(
+                target=self._create_replicas, args=(dep, n_create),
+                name=f"serve-build-{name}", daemon=True).start()
+        return True
 
     def _reap_draining(self, dep: Dict):
         """Kill retired replicas once their queues empty (or the drain
@@ -570,18 +727,22 @@ class ServeController:
             dep["draining"] = keep + [e for e in current
                                       if id(e[0]) not in snap_ids]
 
-    def _probe_loads(self, dep: Dict):
-        """One queue-depth probe per reconcile tick, shared by autoscaling
-        and the router load push."""
+    def _probe_states(self, dep: Dict):
+        """One runtime-state probe per reconcile tick, shared by
+        autoscaling, the router load push, and preemption-notice pickup.
+        Returns (replica_snapshot, [{"queue_len", "draining"}, ...]) or
+        (None, None) when the probe failed."""
         import ray_tpu
         replicas = list(dep["replicas"])
         if not replicas:
-            return None
+            return None, None
         try:
-            return ray_tpu.get([r.get_queue_len.remote() for r in replicas],
-                               timeout=5)
+            states = ray_tpu.get(
+                [r.get_runtime_state.remote() for r in replicas],
+                timeout=5)
+            return replicas, states
         except Exception:
-            return None
+            return None, None
 
     def _publish_loads(self, dep: Dict, lens):
         """Push probed queue depths to routers: every handle then shares
@@ -592,6 +753,9 @@ class ServeController:
         if lens is None:
             return
         with self._lock:
+            if len(lens) != len(dep["replicas"]):
+                return   # replica set moved since the probe (death or
+                         # drain detach): stale loads would misroute
             if lens != dep.get("loads"):
                 dep["loads"] = lens
                 self._bump_dep(dep)
@@ -619,9 +783,11 @@ class ServeController:
             rows = self._slo_tracker.update(app_name, name, slo, query)
             with self._lock:
                 dep["slo_status"] = rows
+            return rows
         except Exception:
             logger.exception("SLO evaluation failed for %s/%s",
                              app_name, name)
+            return None
 
     def get_slo_status(self) -> Dict:
         """{app: {deployment: [objective rows]}} for declared SLOs."""
@@ -640,7 +806,9 @@ class ServeController:
                 return {"version": -1, "replicas": []}
             return {"version": dep["version"],
                     "replicas": list(dep["replicas"]),
-                    "loads": list(dep.get("loads") or [])}
+                    "loads": list(dep.get("loads") or []),
+                    "resumable": bool(dep["spec"]["config"]
+                                      .get("resumable_streams"))}
 
     def get_status(self) -> Dict:
         with self._lock:

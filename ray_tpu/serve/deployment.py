@@ -21,6 +21,17 @@ class AutoscalingConfig:
     # decision — bursty load doesn't flap replicas (reference:
     # serve/autoscaling_policy.py look_back_period_s)
     look_back_period_s: float = 10.0
+    # burn-rate scaling knobs (serve/slo.py BurnRateScaler) — active
+    # only when the deployment also declares slo_config. Dual-window
+    # burn must persist burn_upscale_hold_s before the target rises;
+    # burn below burn_release_threshold with per-replica load under
+    # half of target_ongoing_requests for burn_downscale_idle_s
+    # releases one replica; burn_cooldown_s separates actions so the
+    # loop cannot flap faster than the windows refill
+    burn_upscale_hold_s: float = 6.0
+    burn_downscale_idle_s: float = 60.0
+    burn_cooldown_s: float = 30.0
+    burn_release_threshold: float = 0.25
 
 
 @dataclasses.dataclass
@@ -41,6 +52,18 @@ class DeploymentConfig:
     # (serve/sharded_replica.py; SURVEY §7.2-10)
     num_hosts: int = 1
     topology: Optional[str] = None
+    # streaming resume (serve/handle.py): True when the callable opted
+    # in (``__serve_resumable__ = True``) — its streaming methods accept
+    # ``resume_tokens=<chunks already delivered>`` and continue from
+    # there, so a stream severed by replica death restarts on a
+    # survivor with zero dropped or duplicated chunks
+    resumable_streams: bool = False
+    # drain deadline handed to a replica on a preemption NOTICE (GCE
+    # spot TPU-VMs get ~30s between notice and kill; leave headroom for
+    # the forced reap). Plain retirement keeps using
+    # graceful_shutdown_timeout_s
+    preempt_grace_s: float = 25.0
+    graceful_shutdown_timeout_s: float = 30.0
 
 
 def _coerce_slo(slo):

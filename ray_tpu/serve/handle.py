@@ -29,10 +29,13 @@ class DeploymentResponse:
     def result(self, timeout: Optional[float] = None):
         try:
             return ray_tpu.get(self._ref, timeout=timeout)
-        except ray_tpu.ActorDiedError:
+        except Exception as e:
             # the replica died after accepting the call (e.g. retired
-            # mid-roll before the router refreshed): re-route ONCE
-            # through the handle against the current replica set
+            # mid-roll before the router refreshed) or refused it while
+            # draining: re-route ONCE through the handle against the
+            # current replica set
+            if not _is_replica_death(e):
+                raise
             self._settle()
             if self._resubmit is None:
                 raise
@@ -69,37 +72,82 @@ class DeploymentResponse:
         self._settle()
 
 
+def _is_replica_death(e: BaseException) -> bool:
+    """Failures that mean THIS replica is gone (re-routable), as opposed
+    to an application error the caller must see. A draining replica
+    (preemption notice won the race against the routing-table update)
+    counts: it refuses the call at the boundary, before side effects."""
+    from ray_tpu.serve.replica import ReplicaDrainingError
+    return isinstance(e, (ray_tpu.ActorDiedError, ray_tpu.ObjectLostError,
+                          ray_tpu.WorkerCrashedError,
+                          ReplicaDrainingError))
+
+
 class DeploymentResponseGenerator:
     """Iterator over a streaming deployment call: a thin value-fetching
     wrapper around the core ObjectRefGenerator — chunks arrive as the
     replica's generator yields, with the core protocol's backpressure
-    (round-5; reference: DeploymentResponseGenerator, serve/handle.py)."""
+    (round-5; reference: DeploymentResponseGenerator, serve/handle.py).
 
-    def __init__(self, ref_gen, router, replica_idx):
+    Replica death mid-stream re-routes ONCE, like the unary
+    DeploymentResponse: ``resume(delivered, chunks)`` (installed by the
+    handle) restarts the stream on the current replica set. Resumable
+    deployments get the delivered chunks back as ``resume_tokens`` and
+    continue in place; non-resumable ones restart from scratch and this
+    wrapper discards the first ``delivered`` chunks — either way the
+    consumer sees every chunk exactly once."""
+
+    def __init__(self, ref_gen, router, replica_idx, resume=None,
+                 record_chunks: bool = False):
         self._gen = ref_gen
         self._router = router
         self._idx = replica_idx
         self._got_first = False
+        self._resume = resume
+        self._delivered = 0
+        # delivered chunks, kept only for resumable deployments (they
+        # are token ids there — small); non-resumable re-routes dedupe
+        # by count alone
+        self._chunks: Optional[List] = [] if record_chunks else None
 
     def __iter__(self):
         return self
 
+    def _fetch(self):
+        """One chunk off the underlying ref generator (StopIteration at
+        end of stream). Split out so the resume path and the skip-ahead
+        dedupe share it."""
+        # 60s liveness bound: a replica generator wedged in user
+        # code surfaces a TimeoutError instead of hanging the caller
+        ref = self._gen.next(timeout=60)
+        return self._get(ref)
+
+    @staticmethod
+    def _get(ref):
+        return ray_tpu.get(ref, timeout=60)
+
     def __next__(self):
-        try:
-            # 60s liveness bound: a replica generator wedged in user
-            # code surfaces a TimeoutError instead of hanging the caller
-            ref = self._gen.next(timeout=60)
-        except StopIteration:
-            self._settle()
-            raise
-        except Exception:
-            self._settle()
-            raise
-        try:
-            value = ray_tpu.get(ref, timeout=60)
-        except Exception:
-            self._settle()
-            raise
+        while True:
+            try:
+                value = self._fetch()
+                break
+            except StopIteration:
+                self._settle()
+                raise
+            except Exception as e:
+                if self._resume is None or not _is_replica_death(e):
+                    self._settle()
+                    raise
+                resume, self._resume = self._resume, None   # one-shot
+                try:
+                    fresh, skip = resume(self._delivered, self._chunks)
+                    self._adopt(fresh, skip)
+                except StopIteration:
+                    self._settle()
+                    raise
+                except Exception:
+                    self._settle()
+                    raise e   # surface the ORIGINAL death, not the retry
         if not self._got_first:
             # client-observed first chunk (TTFT as the CALLER saw it,
             # network + queueing included — the engine-side first-token
@@ -107,7 +155,23 @@ class DeploymentResponseGenerator:
             self._got_first = True
             from ray_tpu._private import events
             events.record_instant("serve.first_chunk", category="serve")
+        self._delivered += 1
+        if self._chunks is not None:
+            self._chunks.append(value)
         return value
+
+    def _adopt(self, fresh: "DeploymentResponseGenerator", skip: int):
+        """Take over a freshly routed stream: steal its underlying
+        generator + routing slot (neutering the donor so its __del__
+        doesn't decrement our in-flight count), then discard the first
+        `skip` chunks — the ones a non-resumable restart re-produces."""
+        self._settle()
+        self._gen = fresh._gen
+        self._idx = fresh._idx
+        self._router = fresh._router
+        fresh._router = None
+        for _ in range(skip):
+            self._fetch()
 
     def _settle(self):
         if self._router is not None:
@@ -197,6 +261,7 @@ class _Router:
         self.inflight: Dict[int, int] = {}
         self.shared_load: Dict[int, int] = {}  # controller-probed depths
         self.version = -1
+        self.resumable = False   # deployment streams accept resume_tokens
         self.lock = threading.Lock()
         self._last_refresh = 0.0
         self.model_map: Dict[str, int] = {}   # multiplexed model -> replica
@@ -208,6 +273,7 @@ class _Router:
     def _apply_push(self, info: Dict):
         with self.lock:
             self._last_refresh = time.monotonic()
+            self.resumable = bool(info.get("resumable"))
             if info["version"] != self.version:
                 self.version = info["version"]
                 self.replicas = info["replicas"]
@@ -227,6 +293,7 @@ class _Router:
             self.app_name, self.deployment_name), timeout=30)
         with self.lock:
             self._last_refresh = now
+            self.resumable = bool(info.get("resumable"))
             if info["version"] != self.version:
                 self.version = info["version"]
                 self.replicas = info["replicas"]
@@ -309,8 +376,13 @@ class DeploymentHandle:
                     ref_gen = replica.handle_stream.options(
                         num_returns="streaming").remote(
                             method, args, kwargs)
+                    resume = None
+                    if allow_resubmit:
+                        resume = self._make_stream_resume(method, args,
+                                                          kwargs, retry)
                     return DeploymentResponseGenerator(
-                        ref_gen, self._router, idx)
+                        ref_gen, self._router, idx, resume=resume,
+                        record_chunks=self._router.resumable)
                 ref = replica.handle_request.remote(method, args, kwargs)
                 # one resubmit only: the retried response carries NO
                 # further resubmit, so a crash loop surfaces instead of
@@ -328,6 +400,26 @@ class DeploymentHandle:
                 self._router.refresh(force=True)
                 last_err = e
         raise last_err
+
+    def _make_stream_resume(self, method, args, kwargs, retry):
+        """One-shot re-route for a stream severed by replica death (the
+        streaming counterpart of DeploymentResponse's resubmit). Returns
+        (fresh DeploymentResponseGenerator, chunks_to_skip): resumable
+        deployments receive the delivered chunks as resume_tokens and
+        continue from the exact next position (skip 0); non-resumable
+        ones restart the stream internally and the caller skips the
+        first `delivered` chunks so the client never sees a duplicate."""
+        def resume(delivered: int, chunks):
+            self._router.refresh(force=True)
+            if self._router.resumable and chunks is not None:
+                kw = dict(kwargs)
+                prior = list(kw.pop("resume_tokens", None) or [])
+                kw["resume_tokens"] = prior + list(chunks)
+                return self._invoke(method, args, kw, retry=retry,
+                                    allow_resubmit=False), 0
+            return self._invoke(method, args, kwargs, retry=retry,
+                                allow_resubmit=False), delivered
+        return resume
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return self._invoke("__call__", args, kwargs)

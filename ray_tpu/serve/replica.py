@@ -6,8 +6,22 @@ count for autoscaling."""
 
 from __future__ import annotations
 
+import logging
+import os
 import threading
+import time
 from typing import Any, Dict, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class ReplicaDrainingError(Exception):
+    """Raised at the replica boundary for calls that arrive AFTER a
+    drain notice (router staleness window): the replica is alive and
+    finishing in-flight work but takes nothing new. The handle layer
+    treats it like replica death — refresh the routing table and
+    re-route once — so clients of a preempted replica see a survivor,
+    not an error."""
 
 
 class Replica:
@@ -22,10 +36,61 @@ class Replica:
             self._callable = target(*init_args, **init_kwargs)
         self._ongoing = 0
         self._lock = threading.Lock()
+        self._draining = False
+        # spot preemption notices: on GCE (or under chaos injection) a
+        # watcher polls the metadata channel and flips this replica into
+        # draining before the platform kills the VM — the controller
+        # sees it on its next state probe and pre-starts a replacement
+        from ray_tpu._private.accelerators import tpu as tpu_accel
+        if tpu_accel.preemption_watch_enabled():
+            threading.Thread(target=self._preemption_watch,
+                             name="serve-preempt-watch",
+                             daemon=True).start()
+
+    def _preemption_watch(self):
+        from ray_tpu._private.accelerators import tpu as tpu_accel
+        poll_s = float(os.environ.get("RAY_TPU_PREEMPT_POLL_S", "1.0"))
+        while not self._draining:
+            try:
+                if tpu_accel.check_preemption_notice():
+                    logger.warning("preemption notice received; draining")
+                    self.begin_drain()
+                    return
+            except Exception:
+                logger.debug("preemption poll failed", exc_info=True)
+            time.sleep(poll_s)
+
+    # ------------------------------------------------------------- draining
+    def begin_drain(self) -> bool:
+        """Preemption notice / graceful retirement: stop taking new
+        work. The routing layer drops this replica on the controller's
+        next probe; streams already in flight run to completion (the
+        engine's drain mode refuses only NEW submissions). Idempotent."""
+        with self._lock:
+            if self._draining:
+                return True
+            self._draining = True
+        fn = getattr(self._callable, "begin_drain", None)
+        if fn is not None:
+            try:
+                fn()
+            except Exception:
+                logger.warning("callable begin_drain failed",
+                               exc_info=True)
+        return True
+
+    def get_runtime_state(self) -> Dict:
+        """One-probe view for the controller's reconcile tick: queue
+        depth (autoscaling + router load push) and the draining flag
+        (preemption pickup)."""
+        return {"queue_len": self._ongoing, "draining": self._draining}
 
     def handle_request(self, method: str, args: Tuple, kwargs: Dict):
         import ray_tpu
         from ray_tpu import ObjectRef
+        if self._draining:
+            raise ReplicaDrainingError(
+                "replica is draining (preemption notice); re-route")
         # composed calls pass upstream DeploymentResponses as refs; resolve
         # to values before invoking user code (reference: handle.py resolves
         # nested DeploymentResponses)
@@ -87,6 +152,9 @@ class Replica:
         ObjectRefGenerator, serve/handle.py)."""
         from ray_tpu._private import events
         from ray_tpu.serve import multiplex
+        if self._draining:
+            raise ReplicaDrainingError(
+                "replica is draining (preemption notice); re-route")
         model_id = kwargs.pop("__serve_model_id", "")
         with self._lock:
             self._ongoing += 1

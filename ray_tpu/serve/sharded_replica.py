@@ -51,6 +51,7 @@ class ReplicaShard:
         # set when a stream died mid-collective: the gang's ranks are
         # desynchronized and must be replaced as a unit
         self._wedged = False
+        self._draining = False
 
     def setup_distributed(self, group_name: str) -> bool:
         """Join the group's jax.distributed world (KV rendezvous). Must
@@ -176,6 +177,23 @@ class ReplicaShard:
     # --------------------------------------------------------- control plane
     def get_queue_len(self) -> int:
         return self._ongoing
+
+    def begin_drain(self) -> bool:
+        """Drain notice for the whole gang (ingress is rank 0, so
+        flipping the rank-0 callable stops new admissions)."""
+        self._draining = True
+        fn = getattr(self._callable, "begin_drain", None)
+        if fn is not None:
+            try:
+                fn()
+            except Exception:
+                logger.warning("callable begin_drain failed",
+                               exc_info=True)
+        return True
+
+    def get_runtime_state(self) -> Dict:
+        return {"queue_len": self._ongoing,
+                "draining": getattr(self, "_draining", False)}
 
     def check_health(self) -> bool:
         """Rank 0 probes every peer: one dead rank = unhealthy group, so

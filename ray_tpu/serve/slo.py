@@ -131,6 +131,88 @@ def evaluate_slo(slo, query: Callable[..., Dict]) -> List[Dict]:
     return out
 
 
+class BurnRateScaler:
+    """Burn-driven replica-target policy — the consumer of the rows
+    ``evaluate_slo`` produces (ROADMAP item 2's "control loop
+    remaining"). One instance per deployment, held by the controller.
+
+    Decisions are deliberately conservative (SRE multiwindow rule +
+    hold + cooldown), because replica churn is the most expensive thing
+    a TPU serving fleet can do:
+
+    - **Upscale** only when an objective is *violating* (BOTH burn
+      windows above threshold — ``evaluate_slo`` already applies the
+      multiwindow rule, so an instant spike that lights up only the
+      fast window never reaches here as violating) and has stayed
+      violating for ``burn_upscale_hold_s``. The new target scales with
+      the slow-window burn (burning 2x over budget doubles the target)
+      but always moves by at least one replica.
+    - **Downscale** only when every burn is below
+      ``burn_release_threshold`` AND the measured load per replica is
+      under half the autoscaler's ``target_ongoing_requests`` for
+      ``burn_downscale_idle_s`` — idle capacity releases, a loaded but
+      healthy fleet does not.
+    - ``burn_cooldown_s`` separates consecutive actions in either
+      direction so the loop cannot flap faster than the windows can
+      re-fill with post-action samples.
+
+    Pure: ``decide`` takes ``now`` and mutates only this object, so
+    tests drive it with a fake clock and a synthetic metrics ring."""
+
+    def __init__(self):
+        self._violating_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._last_action_t: Optional[float] = None
+
+    def decide(self, auto, rows: List[Dict], target: int,
+               total_load: float, now: float) -> int:
+        import math
+        lo = int(_cfg_get(auto, "min_replicas", 1) or 1)
+        hi = int(_cfg_get(auto, "max_replicas", 4) or 4)
+        hold = float(_cfg_get(auto, "burn_upscale_hold_s", 6.0))
+        idle_s = float(_cfg_get(auto, "burn_downscale_idle_s", 60.0))
+        cooldown = float(_cfg_get(auto, "burn_cooldown_s", 30.0))
+        release = float(_cfg_get(auto, "burn_release_threshold", 0.25))
+        target_ongoing = float(
+            _cfg_get(auto, "target_ongoing_requests", 2.0) or 2.0)
+        violating = any(r.get("violating") for r in rows)
+        burn_slow = max((r.get("burn_slow") or 0.0 for r in rows),
+                        default=0.0)
+        burn_fast = max((r.get("burn_fast") or 0.0 for r in rows),
+                        default=0.0)
+        in_cooldown = (self._last_action_t is not None
+                       and now - self._last_action_t < cooldown)
+
+        if violating:
+            self._idle_since = None
+            if self._violating_since is None:
+                self._violating_since = now
+            sustained = now - self._violating_since >= hold
+            if sustained and not in_cooldown and target < hi:
+                desired = min(hi, max(
+                    target + 1,
+                    math.ceil(target * min(max(burn_slow, 1.0), 2.0))))
+                self._last_action_t = now
+                self._violating_since = now   # re-arm the hold
+                return desired
+            return target
+
+        self._violating_since = None
+        idle = (burn_fast < release and burn_slow < release
+                and total_load < 0.5 * target_ongoing * max(target, 1))
+        if not idle:
+            self._idle_since = None
+            return target
+        if self._idle_since is None:
+            self._idle_since = now
+        if (now - self._idle_since >= idle_s and not in_cooldown
+                and target > lo):
+            self._last_action_t = now
+            self._idle_since = now            # step down one per cooldown
+            return target - 1
+        return target
+
+
 class SloTracker:
     """Transition memory + emission. One per controller; keys are
     (app, deployment, objective)."""

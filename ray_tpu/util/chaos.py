@@ -125,26 +125,43 @@ class ServeReplicaKiller:
         self.app_name = app_name
         self.deployment_name = deployment_name
         self.killed = 0
+        self.preempted = 0
         self._rng = random.Random(seed)
+
+    def _controller(self):
+        from ray_tpu.serve.api import _get_controller
+        return _get_controller()
 
     def _info(self):
         import ray_tpu
-        from ray_tpu.serve.api import _get_controller
-        return ray_tpu.get(_get_controller().get_deployment_info.remote(
+        return ray_tpu.get(self._controller().get_deployment_info.remote(
             self.app_name, self.deployment_name), timeout=30)
 
     def replicas(self) -> List:
         return list(self._info().get("replicas") or [])
 
-    def kill_one(self) -> bool:
+    def kill_one(self, prefer_busy: bool = False) -> bool:
         """Kill one (random) replica actor; returns False when none are
         up. The controller detects the death on its next reconcile and
-        builds a replacement."""
+        builds a replacement. prefer_busy=True targets a replica with a
+        non-empty queue when one exists — the interesting victim for
+        stream-resume tests (killing an idle replica severs nothing)."""
         import ray_tpu
         reps = self.replicas()
         if not reps:
             return False
-        victim = self._rng.choice(reps)
+        victim = None
+        if prefer_busy:
+            for r in reps:
+                try:
+                    if ray_tpu.get(r.get_queue_len.remote(),
+                                   timeout=10) > 0:
+                        victim = r
+                        break
+                except Exception:
+                    continue
+        if victim is None:
+            victim = self._rng.choice(reps)
         try:
             ray_tpu.kill(victim)
         except Exception:
@@ -152,21 +169,50 @@ class ServeReplicaKiller:
         self.killed += 1
         return True
 
+    def preempt_one(self, grace_s: Optional[float] = None) -> bool:
+        """Graceful-notice preemption: the controller delivers a drain
+        notice to one (random) replica, drops it from the routing table,
+        and pre-starts a replacement — exercising the notice -> drain ->
+        replace path instead of the crash path. The drained replica is
+        force-killed at the grace deadline if its queue never empties."""
+        import ray_tpu
+        n = len(self.replicas())
+        if not n:
+            return False
+        ok = ray_tpu.get(self._controller().preempt_replica.remote(
+            self.app_name, self.deployment_name,
+            self._rng.randrange(n), grace_s), timeout=30)
+        if ok:
+            self.preempted += 1
+        return bool(ok)
+
     def wait_for_replacement(self, timeout_s: float = 60.0,
-                             min_running: int = 1) -> bool:
+                             min_running: int = 1, handle=None) -> bool:
         """Block until the deployment again reports >= min_running
         replicas under a NEW version set (the controller bumps the
-        router view when the replica set changes)."""
+        router view when the replica set changes). Pass the test's
+        DeploymentHandle as `handle` to ALSO wait for router-view
+        propagation — the handle's router must have applied the new
+        replica set, otherwise the next `handle.remote(...)` races the
+        stale routing table and lands on the corpse."""
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             try:
-                reps = self.replicas()
+                info = self._info()
+                reps = list(info.get("replicas") or [])
                 if len(reps) >= min_running:
                     import ray_tpu
                     # replacement must actually answer, not just exist
                     ray_tpu.get([r.get_queue_len.remote() for r in reps],
                                 timeout=10)
-                    return True
+                    if handle is None:
+                        return True
+                    router = handle._router
+                    router.refresh(force=True)
+                    with router.lock:
+                        if (router.version >= info["version"]
+                                and len(router.replicas) >= min_running):
+                            return True
             except Exception:
                 pass
             time.sleep(0.5)
