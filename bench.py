@@ -381,22 +381,31 @@ def _run_probe(runner: str, spec: dict, timeout: float,
 
 def _plausible_decode(result):
     """Bench-side belt over the probe's own guard (BENCH_r05 published a
-    physically impossible 384e6 tok/s run): drop runs that beat the
-    probe-reported HBM roofline — or a 1e7 tok/s absolute cap when an
-    older probe carries no roofline field — and re-derive the median
-    from the surviving runs. Returns None when nothing survives, so the
-    caller resamples instead of publishing garbage."""
-    runs = [r for r in result.get("runs", []) if r > 0]
+    physically impossible 384e6 tok/s run — and it leaked into the
+    artifact's `runs` list, not just the median): partition into
+    ACCEPTED samples first, then derive EVERY published figure — runs,
+    median, spread — from the accepted set only. A run is accepted when
+    it is positive and does not beat the probe-reported HBM roofline
+    (or a 1e7 tok/s absolute cap when an older probe carries no
+    roofline field). The e2e figure gets the same cap: e2e includes
+    prefill, so it can never legitimately exceed pure decode's ceiling.
+    Returns None when nothing survives, so the caller resamples instead
+    of publishing garbage."""
     roofline = result.get("roofline_tokens_per_s") or 1e7
-    ok = sorted(r for r in runs if r <= roofline)
-    if not ok:
+    accepted = sorted(r for r in result.get("runs", [])
+                      if 0 < r <= roofline)
+    if not accepted:
         return None
     clean = dict(result)
-    clean["runs"] = [round(r, 1) for r in ok]
-    clean["decode_tokens_per_s"] = round(ok[len(ok) // 2], 1)
-    clean["rejected_by_bench"] = len(runs) - len(ok)
-    med = clean["decode_tokens_per_s"]
-    clean["spread"] = round((ok[-1] - ok[0]) / med, 3) if med else 0.0
+    clean["runs"] = [round(r, 1) for r in accepted]
+    med = accepted[len(accepted) // 2]
+    clean["decode_tokens_per_s"] = round(med, 1)
+    clean["rejected_by_bench"] = len(result.get("runs", [])) - len(accepted)
+    clean["spread"] = round((accepted[-1] - accepted[0]) / med, 3) \
+        if med else 0.0
+    e2e = result.get("e2e_tokens_per_s")
+    if e2e is not None and not 0 < e2e <= roofline:
+        clean["e2e_tokens_per_s"] = None     # same guard, same reason
     return clean
 
 
@@ -470,6 +479,45 @@ def bench_serve_tokens_per_s(tpu_ok: bool = False):
             if result is not None:
                 return result
             log(f"serve probe failed: {last}")
+    return {"skipped": True, "reason": last}
+
+
+# r05's end-to-end serving rate (the decode probe's e2e figure — the
+# engine itself sustained ~8,500 tok/s, so the serving stack was the
+# bottleneck): the PR-10 ratchet floor. serve_tokens_per_s must not
+# regress below this with stream coalescing enabled, and the issue
+# targets >= 2x.
+R05_SERVE_TOKENS_PER_S = 1217.9
+
+
+def bench_serve_prefix_tokens_per_s(tpu_ok: bool = False):
+    """Shared-system-prompt serving throughput (the radix-cache rung of
+    reports/serve_probe.py): N Poisson sessions over K distinct shared
+    prefixes, reporting prefix_hit_rate, p95 TTFT split hit-vs-miss,
+    and the same workload through a cache-disabled engine in the SAME
+    entry — vs_no_prefix >= 1.0 is the prefix cache's reason to exist."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    runner = os.path.join(here, "reports", "serve_probe.py")
+    base = {"n_slots": 8, "n_requests": 24, "runs": 3,
+            "shared_prefixes": 4, "prefix_len": 128,
+            "suffix_lens": [2, 12], "new_tokens": [4, 32],
+            "arrival_rate_rps": 50.0}
+    if tpu_ok:
+        ladder = [dict(base, model="tpu-1b", max_len=512,
+                       prefill_chunk=64),
+                  dict(base, model="tiny")]
+    else:
+        ladder = [dict(base, model="tiny")]
+    last = "unknown"
+    for attempt in range(2):
+        if attempt:
+            time.sleep(10)
+        for spec in ladder:
+            result, last = _run_probe(runner, spec, timeout=1200)
+            if result is not None:
+                return result
+            log(f"serve prefix probe failed: {last}")
     return {"skipped": True, "reason": last}
 
 
@@ -925,6 +973,8 @@ def main():
         tpu_ok = not mfu_res.get("skipped")
         srv = bench_serve_tokens_per_s(tpu_ok)
         if not srv.get("skipped"):
+            vs_r05 = round(
+                srv["serve_tokens_per_s"] / R05_SERVE_TOKENS_PER_S, 3)
             results["serve_tokens_per_s"] = {
                 "value": srv["serve_tokens_per_s"],
                 "unit": "tokens_per_s", "model": srv["model"],
@@ -933,10 +983,18 @@ def main():
                 "ttft_p95_ms": srv["ttft_p95_ms"],
                 "static_tokens_per_s": srv["static_tokens_per_s"],
                 "vs_static": srv["vs_static"],
+                "vs_r05_ratchet": vs_r05,
                 "spread": srv["spread"], "runs": srv["runs"]}
             log(f"serve_tokens_per_s: {srv['serve_tokens_per_s']} "
                 f"({srv['model']}, vs_static {srv['vs_static']}x, "
                 f"ttft p50 {srv['ttft_p50_ms']}ms)")
+            if srv.get("model") != "tiny" and vs_r05 < 1.0:
+                # the coalescing/prefix-cache ratchet: an on-TPU number
+                # below r05's 1,218 tok/s is a serving regression — make
+                # it loud in the artifact, not just on stderr
+                results["serve_tokens_per_s"]["regressed_vs_r05"] = True
+                log(f"serve_tokens_per_s REGRESSED vs r05: "
+                    f"{vs_r05}x of {R05_SERVE_TOKENS_PER_S}")
         else:
             results["serve_tokens_per_s"] = srv
             log(f"serve probe skipped: {srv.get('reason')}")
@@ -944,6 +1002,36 @@ def main():
         log(f"serve probe FAILED: {e}")
         results["serve_tokens_per_s"] = {"skipped": True,
                                          "reason": str(e)[:200]}
+
+    try:
+        tpu_ok = not mfu_res.get("skipped")
+        pfx = bench_serve_prefix_tokens_per_s(tpu_ok)
+        if not pfx.get("skipped"):
+            results["serve_prefix_tokens_per_s"] = {
+                "value": pfx["serve_tokens_per_s"],
+                "unit": "tokens_per_s", "model": pfx["model"],
+                "shared_prefixes": pfx.get("shared_prefixes"),
+                "prefix_len": pfx.get("prefix_len"),
+                "prefix_hit_rate": pfx.get("prefix_hit_rate"),
+                "prefix_tokens_saved": pfx.get("prefix_tokens_saved"),
+                "ttft_p95_hit_ms": pfx.get("ttft_p95_hit_ms"),
+                "ttft_p95_miss_ms": pfx.get("ttft_p95_miss_ms"),
+                "ttft_hit_vs_miss_p95": pfx.get("ttft_hit_vs_miss_p95"),
+                "no_prefix_tokens_per_s": pfx.get("no_prefix_tokens_per_s"),
+                "vs_no_prefix": pfx.get("vs_no_prefix"),
+                "decode_compile_count": pfx.get("decode_compile_count"),
+                "spread": pfx.get("spread"), "runs": pfx.get("runs")}
+            log(f"serve_prefix_tokens_per_s: {pfx['serve_tokens_per_s']} "
+                f"(hit_rate {pfx.get('prefix_hit_rate')}, vs_no_prefix "
+                f"{pfx.get('vs_no_prefix')}x, ttft hit/miss p95 "
+                f"{pfx.get('ttft_hit_vs_miss_p95')})")
+        else:
+            results["serve_prefix_tokens_per_s"] = pfx
+            log(f"serve prefix probe skipped: {pfx.get('reason')}")
+    except Exception as e:
+        log(f"serve prefix probe FAILED: {e}")
+        results["serve_prefix_tokens_per_s"] = {"skipped": True,
+                                                "reason": str(e)[:200]}
 
     try:
         churn = bench_serve_availability_under_churn()

@@ -4,11 +4,13 @@ loop, per-request token streaming). See engine.py for the architecture,
 api.py for the Serve integration."""
 
 from ray_tpu.inference.engine import EngineConfig, InferenceEngine
+from ray_tpu.inference.prefix_cache import RadixPrefixCache
 from ray_tpu.inference.scheduler import (FINISH_CANCELLED, FINISH_DEADLINE,
                                          FINISH_EOS, FINISH_LENGTH,
                                          Request, RequestHandle, Scheduler)
 from ray_tpu.inference.api import LLMDeployment
 
-__all__ = ["EngineConfig", "InferenceEngine", "LLMDeployment", "Request",
-           "RequestHandle", "Scheduler", "FINISH_CANCELLED",
-           "FINISH_DEADLINE", "FINISH_EOS", "FINISH_LENGTH"]
+__all__ = ["EngineConfig", "InferenceEngine", "LLMDeployment",
+           "RadixPrefixCache", "Request", "RequestHandle", "Scheduler",
+           "FINISH_CANCELLED", "FINISH_DEADLINE", "FINISH_EOS",
+           "FINISH_LENGTH"]

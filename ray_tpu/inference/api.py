@@ -73,16 +73,30 @@ class LLMDeployment:
     # handle.py resubmits severed streams with resume_tokens= instead of
     # restarting them from scratch (serve/handle.py stream re-route)
     __serve_resumable__ = True
+    # streams yield COALESCED chunks (lists of token ids) instead of one
+    # token per frame: the handle layer unpacks them back to per-token
+    # iteration while the wire carries ~stream_coalesce_tokens per
+    # round-trip (serve/handle.py DeploymentResponseGenerator)
+    __serve_coalesce_stream__ = True
 
     def __init__(self, model="llama-debug", *, n_slots: int = 4,
                  max_len: int = 256, prefill_chunk: int = 32,
                  prefill_budget: int = 64, eos_id: int = -1,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, params_fn=None, mesh=None,
-                 seed: int = 0):
+                 seed: int = 0, prefix_cache_slots: int = 2,
+                 stream_coalesce_tokens: int = 8,
+                 stream_coalesce_ms: float = 20.0):
         import jax
 
         self.model = _resolve_model(model)
+        # coalescing knobs: how many decoded tokens ride one streaming
+        # frame (handle->router->replica->proxy round-trip) and how long
+        # a partial batch may wait before flushing. The FIRST token of
+        # every request is always flushed eagerly — TTFT never pays the
+        # coalesce window.
+        self.stream_coalesce_tokens = max(1, int(stream_coalesce_tokens))
+        self.stream_coalesce_ms = max(0.0, float(stream_coalesce_ms))
         if params_fn is not None:
             params = params_fn()
         else:
@@ -94,7 +108,8 @@ class LLMDeployment:
                            prefill_chunk=prefill_chunk,
                            prefill_budget=prefill_budget, eos_id=eos_id,
                            temperature=temperature, top_k=top_k,
-                           top_p=top_p)
+                           top_p=top_p,
+                           prefix_cache_slots=max(0, int(prefix_cache_slots)))
         self.engine = InferenceEngine(self.model, params, cfg, mesh=mesh,
                                       seed=seed)
         self._metrics = _EngineMetrics()
@@ -106,17 +121,31 @@ class LLMDeployment:
                  temperature: Optional[float] = None,
                  eos_id: Optional[int] = None,
                  deadline_s: Optional[float] = None,
-                 resume_tokens=None):
-        """Streaming generator: yields one token id at a time. Invoked
-        with .options(stream=True) this rides the replica streaming
-        path; the finally-cancel frees the slot when the client drops
-        the iterator mid-generation (GeneratorExit lands here).
+                 resume_tokens=None,
+                 stream_coalesce_tokens: Optional[int] = None,
+                 stream_coalesce_ms: Optional[float] = None):
+        """Streaming generator: yields COALESCED chunks — lists of token
+        ids, up to ``stream_coalesce_tokens`` long, flushed at least
+        every ``stream_coalesce_ms`` — so one handle/replica/proxy
+        round-trip carries a batch instead of a single token. The first
+        token of the stream is always its own eager chunk (TTFT is
+        unaffected). Invoked with .options(stream=True) this rides the
+        replica streaming path and the handle layer unpacks chunks back
+        to per-token iteration (``__serve_coalesce_stream__``); the
+        finally-cancel frees the slot when the client drops the iterator
+        mid-generation (GeneratorExit lands here).
 
         resume_tokens: tokens a previous attempt already delivered —
         they re-prefill as part of the prompt (the chunked-prefill path
         makes this one budgeted admission, not a decode replay) and only
         the continuation is yielded."""
         from ray_tpu._private import events
+        coalesce_n = (self.stream_coalesce_tokens
+                      if stream_coalesce_tokens is None
+                      else max(1, int(stream_coalesce_tokens)))
+        flush_s = (self.stream_coalesce_ms
+                   if stream_coalesce_ms is None
+                   else max(0.0, float(stream_coalesce_ms))) / 1e3
         if resume_tokens:
             resume_tokens = [int(t) for t in resume_tokens]
             prompt_tokens = list(prompt_tokens) + resume_tokens
@@ -141,7 +170,16 @@ class LLMDeployment:
         prev_t: Optional[float] = None
         n_tokens = 0
         try:
-            for tok in handle:
+            while True:
+                try:
+                    if prev_t is None:
+                        # eager first chunk: exactly one token, flushed
+                        # the moment the engine emits it
+                        batch = [handle.next()]
+                    else:
+                        batch = handle.next_many(coalesce_n, flush_s)
+                except StopIteration:
+                    break
                 now = time.monotonic()
                 if prev_t is None:
                     ttft = now - handle.submitted_t
@@ -152,21 +190,28 @@ class LLMDeployment:
                         parent_span_id=req_span.span_id,
                         ttft_ms=round(ttft * 1e3, 3))
                 else:
-                    self._metrics.next_token(now - prev_t)
+                    # inter-token latency inside a coalesced chunk is
+                    # the per-token share of the batch gap
+                    self._metrics.next_token(
+                        (now - prev_t) / len(batch), n=len(batch))
                 prev_t = now
-                n_tokens += 1
-                yield tok
+                n_tokens += len(batch)
+                self._metrics.flushed()
+                yield batch
         finally:
             # client walked away OR stream completed; cancel is a no-op
             # on a finished request
             handle.cancel()
             reason = handle.finish_reason or "cancelled"
             self._metrics.finished(reason)
+            self._metrics.prefix(self.engine.prefix_cache)
             req_span.end(finish_reason=reason, tokens=n_tokens)
 
     def generate(self, prompt_tokens, **kw):
-        """Non-streaming convenience: returns the full token list."""
-        return list(self.__call__(prompt_tokens, **kw))
+        """Non-streaming convenience: returns the full token list
+        (coalesced chunks flattened)."""
+        return [t for chunk in self.__call__(prompt_tokens, **kw)
+                for t in chunk]
 
     # ------------------------------------------------------------- control
     def stats(self) -> Dict:
@@ -221,7 +266,18 @@ class _EngineMetrics:
                                "occupied KV slots")
         self.queue_depth = Gauge("serve_llm_queue_depth",
                                  "queued (unadmitted) requests")
+        self.hit_rate = Gauge("prefix_hit_rate",
+                              "radix-cache hit rate over request lookups")
+        self.tokens_saved = Gauge("prefix_tokens_saved",
+                                  "prompt tokens whose prefill the "
+                                  "radix cache skipped (cumulative)")
+        self.flush_rate = Gauge("stream_flushes_per_s",
+                                "coalesced stream chunks flushed per "
+                                "second (1s sliding window)")
+        self.flushes = Counter("serve_llm_stream_flushes_total",
+                               "coalesced stream chunks flushed")
         self._lock = threading.Lock()
+        self._flush_window: list = []      # monotonic stamps, last ~1s
 
     def on_step(self, stats: Dict):
         self.occupancy.set(stats["slots_occupied"])
@@ -231,9 +287,24 @@ class _EngineMetrics:
         self.ttft.observe(dt_s * 1000.0)
         self.tokens.inc()
 
-    def next_token(self, dt_s: float):
+    def next_token(self, dt_s: float, n: int = 1):
         self.tpot.observe(dt_s * 1000.0)
-        self.tokens.inc()
+        self.tokens.inc(n)
+
+    def flushed(self):
+        self.flushes.inc()
+        now = time.monotonic()
+        with self._lock:
+            self._flush_window.append(now)
+            cut = now - 1.0
+            while self._flush_window and self._flush_window[0] < cut:
+                self._flush_window.pop(0)
+            self.flush_rate.set(float(len(self._flush_window)))
+
+    def prefix(self, cache):
+        if cache is not None:
+            self.hit_rate.set(cache.hit_rate)
+            self.tokens_saved.set(float(cache.tokens_saved))
 
     def finished(self, reason: str):
         self.requests.inc(tags={"finish_reason": reason})

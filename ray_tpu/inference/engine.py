@@ -70,6 +70,13 @@ class EngineConfig:
     top_k: int = 0
     top_p: float = 1.0
     cache_dtype: Any = None       # default: model activation dtype
+    # radix/prefix KV cache (prefix_cache.py): extra cache-only slots of
+    # the SAME [n_layers, 1, max_len, Hkv, D] shape as decode slots,
+    # carved into prefill_chunk-aligned blocks that hold completed
+    # prefill spans. 0 disables. Admission with a trie hit copies the
+    # matched blocks instead of re-running prefill over them; the copy
+    # programs are fixed-shape, so the compile-once invariant holds.
+    prefix_cache_slots: int = 0
     # per-step time/FLOP attribution (util/profiling.py): emits
     # runtime_decode_step_mfu + compute/host-gap/data-wait phase gauges;
     # the observability-overhead bench toggles this off for its baseline
@@ -97,10 +104,18 @@ class InferenceEngine:
         if cfg.max_len > mcfg.max_seq_len:
             raise ValueError(f"max_len={cfg.max_len} exceeds the model's "
                              f"max_seq_len={mcfg.max_seq_len}")
+        self.prefix_cache = None
+        if cfg.prefix_cache_slots > 0:
+            from ray_tpu.inference.prefix_cache import RadixPrefixCache
+            self._blocks_per_slot = cfg.max_len // cfg.prefill_chunk
+            self.prefix_cache = RadixPrefixCache(
+                cfg.prefill_chunk,
+                cfg.prefix_cache_slots * self._blocks_per_slot)
         self.sched = Scheduler(cfg.n_slots, cfg.prefill_budget,
                                default_temperature=cfg.temperature,
                                eos_id=cfg.eos_id,
-                               chunk_size=cfg.prefill_chunk)
+                               chunk_size=cfg.prefill_chunk,
+                               prefix_cache=self.prefix_cache)
         self._lock = threading.RLock()
         self._work = threading.Condition(self._lock)
         self._stop = False
@@ -131,6 +146,17 @@ class InferenceEngine:
         self._pool_k = self._zeros(pool_shape, dtype)
         self._pool_v = self._zeros(pool_shape, dtype)
         self._cache_dtype = dtype
+        self._blocks_k = self._blocks_v = None
+        if self.prefix_cache is not None:
+            # block storage: prefix_cache_slots more rows of the same
+            # per-slot shape, replicated (blocks are read via copies
+            # into the replicated scratch cache, never attended over
+            # in place, so they need no batch sharding)
+            block_shape = (mcfg.n_layers, cfg.prefix_cache_slots,
+                           cfg.max_len, mcfg.n_kv_heads, mcfg.head_dim)
+            with self._mesh_ctx():
+                self._blocks_k = jnp.zeros(block_shape, dtype)
+                self._blocks_v = jnp.zeros(block_shape, dtype)
 
         # host-side slot state (fixed width, mirrors the device arrays)
         self._lengths = np.zeros((cfg.n_slots,), np.int32)
@@ -235,6 +261,38 @@ class InferenceEngine:
             insert, donate_argnums=(0, 1) if donate else ())
         self._decode_fn = jax.jit(
             decode, donate_argnums=(1, 2) if donate else ())
+
+        if self.prefix_cache is not None:
+            mcfg = self.model.cfg
+            span = (mcfg.n_layers, 1, cfg.prefill_chunk,
+                    mcfg.n_kv_heads, mcfg.head_dim)
+
+            def save_span(bk, bv, sk, sv, slot, dst, src):
+                # one completed prefill chunk: scratch[src:src+C] ->
+                # block storage (slot row, dst offset). Fixed span
+                # shape + traced scalar offsets = one compile, ever.
+                ck = jax.lax.dynamic_slice(sk, (0, 0, src, 0, 0), span)
+                cv = jax.lax.dynamic_slice(sv, (0, 0, src, 0, 0), span)
+                bk = jax.lax.dynamic_update_slice(bk, ck,
+                                                  (0, slot, dst, 0, 0))
+                bv = jax.lax.dynamic_update_slice(bv, cv,
+                                                  (0, slot, dst, 0, 0))
+                return bk, bv
+
+            def load_span(sk, sv, bk, bv, slot, src, dst):
+                # hit path: cached block -> this request's scratch; the
+                # suffix prefill then attends over it exactly as if the
+                # chunk had just been computed (bit-identical values).
+                ck = jax.lax.dynamic_slice(bk, (0, slot, src, 0, 0), span)
+                cv = jax.lax.dynamic_slice(bv, (0, slot, src, 0, 0), span)
+                sk = jax.lax.dynamic_update_slice(sk, ck, (0, 0, dst, 0, 0))
+                sv = jax.lax.dynamic_update_slice(sv, cv, (0, 0, dst, 0, 0))
+                return sk, sv
+
+            self._save_span_fn = jax.jit(
+                save_span, donate_argnums=(0, 1) if donate else ())
+            self._load_span_fn = jax.jit(
+                load_span, donate_argnums=(0, 1) if donate else ())
 
     # -------------------------------------------------------------- intake
     def submit(self, tokens, max_new_tokens: int = 64,
@@ -444,6 +502,11 @@ class InferenceEngine:
         if sk_sv is None:
             sk_sv = (self._zeros(self._scratch_shape, self._cache_dtype),
                      self._zeros(self._scratch_shape, self._cache_dtype))
+            if st.prefix_nodes:
+                # radix hit: the matched span's KV comes out of the
+                # block pool as device-side copies — no forward pass
+                # runs over [0, prefix_matched)
+                sk_sv = self._restore_prefix(st, *sk_sv)
         sk, sv = sk_sv
         prompt = st.request.tokens
         chunk = np.zeros((1, cfg.prefill_chunk), np.int32)
@@ -470,6 +533,8 @@ class InferenceEngine:
         pspan.end()
         if ch.is_last:
             slot = st.slot
+            if self.prefix_cache is not None:
+                self._populate_prefix(st, sk, sv)
             with self._mesh_ctx():
                 self._pool_k, self._pool_v = self._insert_fn(
                     self._pool_k, self._pool_v, sk, sv, np.int32(slot))
@@ -483,9 +548,47 @@ class InferenceEngine:
             self._scratch[st.rid] = (sk, sv)
             self.sched.advance_prefill(st, ch.length)
 
+    # ------------------------------------------------------- prefix cache
+    def _restore_prefix(self, st, sk, sv):
+        """Copy the matched trie blocks into this request's scratch
+        cache ([0, prefix_matched) chunk by chunk), then unpin them.
+        Runs once, on the request's first prefill chunk, under the
+        engine lock — eviction cannot race the copies."""
+        C = self.config.prefill_chunk
+        with self._mesh_ctx():
+            for i, node in enumerate(st.prefix_nodes):
+                bslot, boff = divmod(node.block, self._blocks_per_slot)
+                sk, sv = self._load_span_fn(
+                    sk, sv, self._blocks_k, self._blocks_v,
+                    np.int32(bslot), np.int32(boff * C), np.int32(i * C))
+        events.record_instant(
+            "engine.prefix_hit", category="engine",
+            trace_id=st.span.trace_id if st.span else None,
+            parent_span_id=st.span.span_id if st.span else None,
+            rid=st.rid, slot=st.slot, matched_tokens=st.prefix_matched,
+            prompt_tokens=len(st.request.tokens))
+        self.sched.unpin_prefix(st)
+        return sk, sv
+
+    def _populate_prefix(self, st, sk, sv):
+        """Miss path, at prefill completion: extend the trie over every
+        full chunk of the prompt and fill the newly allocated blocks
+        from scratch (already-present chunks are skipped — their KV is
+        identical by construction)."""
+        C = self.config.prefill_chunk
+        created = self.prefix_cache.insert(st.request.tokens)
+        if not created:
+            return
+        with self._mesh_ctx():
+            for off, block in created:
+                bslot, boff = divmod(block, self._blocks_per_slot)
+                self._blocks_k, self._blocks_v = self._save_span_fn(
+                    self._blocks_k, self._blocks_v, sk, sv,
+                    np.int32(bslot), np.int32(boff * C), np.int32(off))
+
     # -------------------------------------------------------------- stats
     def stats(self) -> Dict:
-        return {
+        out = {
             "n_slots": self.config.n_slots,
             "slots_occupied": self.sched.occupancy(),
             "slots_free": self.config.n_slots - self.sched.occupancy(),
@@ -496,3 +599,6 @@ class InferenceEngine:
             "decode_compile_count": self.decode_compile_count,
             "draining": self.sched.draining,
         }
+        if self.prefix_cache is not None:
+            out.update(self.prefix_cache.stats())
+        return out

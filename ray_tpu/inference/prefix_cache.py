@@ -1,0 +1,214 @@
+"""Radix/prefix KV cache bookkeeping for the continuous-batching engine.
+
+Shared prompts (system prompts, few-shot preambles, resumed streams)
+re-run prefill from scratch on every request even though the KV they
+produce is identical. This module keeps a **token trie over completed
+prefills**: each node covers exactly one ``prefill_chunk`` of tokens and
+references an immutable KV *block* — a ``prefill_chunk``-aligned span
+inside the engine's fixed-shape cache-slot arrays (the engine owns the
+device memory; this module owns only the addressing, ref-counts and LRU
+state, so it is pure host Python and unit-testable without JAX).
+
+On admission the scheduler asks for the longest chunk-aligned prefix
+already in the trie; the engine then *copies* the matched blocks into
+the request's scratch cache instead of running prefill over them —
+admission cost for the matched span drops from a forward pass to a
+device-side memcpy. Misses populate the trie when their prefill
+completes. The match is capped one token short of the full prompt so
+the final chunk always prefills (that pass samples the request's first
+token — the sampling path never changes, which is what keeps greedy
+output bit-identical hit vs miss).
+
+Blocks are ref-counted: a node matched by an in-flight request stays
+pinned until its span has been copied into that request's scratch, so
+LRU eviction under block pressure can never reuse memory a request is
+about to read. Eviction is leaf-only (an interior node's children are
+unreachable without it) and strictly LRU over unpinned leaves.
+
+Thread model: every call happens under the engine's step lock (the
+scheduler and engine already serialize there); nothing here locks.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+class TrieNode:
+    """One ``chunk_size``-token edge of the radix trie. ``block`` is the
+    engine-assigned block id whose KV span holds this chunk's keys and
+    values; ``pins`` counts in-flight requests that matched through this
+    node and have not yet copied it out."""
+
+    __slots__ = ("key", "block", "children", "parent", "pins", "stamp")
+
+    def __init__(self, key: Optional[Tuple[int, ...]], block: Optional[int],
+                 parent: Optional["TrieNode"]):
+        self.key = key
+        self.block = block
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "TrieNode"] = {}
+        self.pins = 0
+        self.stamp = 0
+
+    def __repr__(self):
+        return (f"TrieNode(block={self.block}, pins={self.pins}, "
+                f"children={len(self.children)})")
+
+
+class RadixPrefixCache:
+    """Host-side trie + block-pool accounting.
+
+    chunk_size: tokens per trie node / per block (the engine's
+        ``prefill_chunk`` — spans stay chunk-aligned so the fixed-shape
+        compile-once programs cover every copy).
+    n_blocks: total KV blocks the engine carved out of its cache-slot
+        arrays (``prefix_cache_slots * (max_len // prefill_chunk)``).
+    """
+
+    def __init__(self, chunk_size: int, n_blocks: int):
+        if chunk_size <= 0:
+            raise ValueError("chunk_size must be positive")
+        self.chunk_size = int(chunk_size)
+        self.n_blocks = int(n_blocks)
+        self._free: List[int] = list(range(self.n_blocks))
+        self._root = TrieNode(None, None, None)
+        self._clock = itertools.count(1)
+        # stats (exposed in engine.stats(); fed to the serve gauges)
+        self.lookups = 0
+        self.hits = 0
+        self.tokens_saved = 0
+        self.evictions = 0
+        self.blocks_cached = 0
+
+    # ------------------------------------------------------------- lookup
+    def match(self, tokens: Sequence[int]) -> Tuple[int, List[TrieNode]]:
+        """Longest chunk-aligned prefix of ``tokens`` present in the
+        trie, capped at ``len(tokens) - 1`` so at least one token always
+        runs prefill (the pass that samples the first generated token).
+        Matched nodes come back PINNED — the caller must ``release()``
+        them once their spans have been copied out."""
+        C = self.chunk_size
+        limit = max(0, (len(tokens) - 1)) // C
+        node = self._root
+        matched: List[TrieNode] = []
+        for c in range(limit):
+            child = node.children.get(
+                tuple(int(t) for t in tokens[c * C:(c + 1) * C]))
+            if child is None:
+                break
+            matched.append(child)
+            node = child
+        self.lookups += 1
+        if matched:
+            self.hits += 1
+            self.tokens_saved += len(matched) * C
+            stamp = next(self._clock)
+            for n in matched:
+                n.pins += 1
+                n.stamp = stamp
+        return len(matched) * C, matched
+
+    def release(self, nodes: Sequence[TrieNode]):
+        """Unpin a match (the spans are copied, eviction may proceed)."""
+        for n in nodes:
+            if n.pins > 0:
+                n.pins -= 1
+
+    # ------------------------------------------------------------- insert
+    def insert(self, tokens: Sequence[int]) -> List[Tuple[int, int]]:
+        """Extend the trie over every FULL chunk of ``tokens``. Returns
+        ``[(token_offset, block_id), ...]`` for the newly created nodes —
+        the caller must fill each block with the KV span at that offset
+        before the next engine step. Chunks already present are skipped
+        (their KV is identical by construction). Stops at the first
+        chunk for which no block can be allocated: the trie only ever
+        holds contiguous-from-root prefixes."""
+        C = self.chunk_size
+        node = self._root
+        created: List[Tuple[int, int]] = []
+        path: List[TrieNode] = []
+        try:
+            for c in range(len(tokens) // C):
+                key = tuple(int(t) for t in tokens[c * C:(c + 1) * C])
+                child = node.children.get(key)
+                if child is None:
+                    block = self._alloc()
+                    if block is None:
+                        break
+                    child = TrieNode(key, block, node)
+                    node.children[key] = child
+                    self.blocks_cached += 1
+                    created.append((c * C, block))
+                # pin the walked path so a later alloc in THIS insert
+                # can never evict a node we just created or rely on
+                child.pins += 1
+                path.append(child)
+                child.stamp = next(self._clock)
+                node = child
+        finally:
+            for n in path:
+                n.pins -= 1
+        return created
+
+    # ----------------------------------------------------------- eviction
+    def _alloc(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        victim = self._lru_unpinned_leaf()
+        if victim is None:
+            return None
+        self._detach(victim)
+        self.evictions += 1
+        return victim.block
+
+    def _lru_unpinned_leaf(self) -> Optional[TrieNode]:
+        best: Optional[TrieNode] = None
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            elif n.pins == 0 and (best is None or n.stamp < best.stamp):
+                best = n
+        return best
+
+    def _detach(self, node: TrieNode):
+        if node.parent is not None:
+            node.parent.children.pop(node.key, None)
+        node.parent = None
+        self.blocks_cached -= 1
+
+    def evict_blocks(self, n: int) -> int:
+        """Shed up to ``n`` LRU unpinned leaf blocks back to the free
+        list (slot-pressure hook). Returns the number actually freed."""
+        freed = 0
+        for _ in range(n):
+            victim = self._lru_unpinned_leaf()
+            if victim is None:
+                break
+            self._detach(victim)
+            self._free.append(victim.block)
+            self.evictions += 1
+            freed += 1
+        return freed
+
+    # -------------------------------------------------------------- stats
+    def __len__(self) -> int:
+        return self.blocks_cached
+
+    @property
+    def hit_rate(self) -> float:
+        return self.hits / self.lookups if self.lookups else 0.0
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "prefix_lookups": self.lookups,
+            "prefix_hits": self.hits,
+            "prefix_hit_rate": round(self.hit_rate, 4),
+            "prefix_tokens_saved": self.tokens_saved,
+            "prefix_blocks_cached": self.blocks_cached,
+            "prefix_blocks_free": len(self._free),
+            "prefix_evictions": self.evictions,
+        }

@@ -64,8 +64,13 @@ class RequestHandle:
         self.first_token_t: Optional[float] = None
         self.finished_t: Optional[float] = None
         self.error: Optional[BaseException] = None
+        # prompt tokens whose prefill the radix cache skipped (set at
+        # admission; 0 = miss or cache disabled). Serving probes split
+        # TTFT hit-vs-miss on this.
+        self.prefix_matched = 0
         self._q: "queue.Queue" = queue.Queue()
         self._drained = False
+        self._end_seen = False     # sentinel met inside next_many()
 
     # ------------------------------------------------------ engine side
     def _emit(self, token: int, now: float):
@@ -94,7 +99,8 @@ class RequestHandle:
         """Blocking next with an explicit timeout (raises queue.Empty).
         Safe to call past exhaustion: keeps raising StopIteration
         instead of blocking on an empty queue."""
-        if self._drained:
+        if self._drained or self._end_seen:
+            self._drained = True
             if self.error is not None:
                 raise self.error
             raise StopIteration
@@ -105,6 +111,35 @@ class RequestHandle:
                 raise self.error
             raise StopIteration
         return item
+
+    def next_many(self, max_tokens: int, flush_s: float = 0.0,
+                  timeout: Optional[float] = None) -> List[int]:
+        """Coalesced drain: block for ONE token (so the first token of a
+        batch is never delayed), then keep collecting already-emitted
+        tokens until ``max_tokens`` are gathered or ``flush_s`` elapses.
+        Returns a non-empty list; end-of-stream raises StopIteration on
+        the call AFTER the one that returned the final tokens — no token
+        is ever held back behind the flush timer once the engine
+        finished the request."""
+        first = self.next(timeout=timeout)   # raises at end of stream
+        out = [first]
+        deadline = time.monotonic() + max(0.0, flush_s)
+        while len(out) < max_tokens:
+            remaining = deadline - time.monotonic()
+            try:
+                if remaining > 0:
+                    item = self._q.get(timeout=remaining)
+                else:
+                    item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is _SENTINEL:
+                # finish mid-batch: deliver what we have NOW; the next
+                # call surfaces StopIteration (or the error)
+                self._end_seen = True
+                break
+            out.append(item)
+        return out
 
     def tokens(self) -> List[int]:
         """Drain to completion and return every generated token."""
@@ -132,6 +167,11 @@ class RequestState:
     generated: int = 0
     last_token: int = 0
     span: Optional[Any] = None    # flight-recorder engine.slot span
+    # radix-cache admission state: matched prefix length (its prefill
+    # is skipped — the engine copies the blocks instead) and the pinned
+    # trie nodes backing it (released once the copy lands in scratch)
+    prefix_matched: int = 0
+    prefix_nodes: Optional[List[Any]] = None
 
 
 @dataclasses.dataclass
@@ -155,8 +195,11 @@ class Scheduler:
 
     def __init__(self, n_slots: int, prefill_budget: int,
                  default_temperature: float = 0.0, eos_id: int = -1,
-                 chunk_size: Optional[int] = None):
+                 chunk_size: Optional[int] = None, prefix_cache=None):
         self.n_slots = n_slots
+        # optional RadixPrefixCache (prefix_cache.py): consulted once
+        # per request at admission; matched spans skip prefill entirely
+        self.prefix_cache = prefix_cache
         self.prefill_budget = max(1, int(prefill_budget))
         # static shape of one prefill call; a planned chunk never
         # exceeds it (the engine pads shorter chunks up to it)
@@ -257,6 +300,7 @@ class Scheduler:
     def _release(self, st: RequestState, reason: str, now: float,
                  error: Optional[BaseException] = None):
         st.status = "FINISHED"
+        self.unpin_prefix(st)
         freed_slot = st.slot
         if st.slot is not None:
             self._active.pop(st.slot, None)
@@ -296,9 +340,28 @@ class Scheduler:
             st = self._queue.pop(0)
             st.slot = self._free_slots.pop(0)
             st.status = "PREFILLING"
+            if self.prefix_cache is not None:
+                matched, nodes = self.prefix_cache.match(st.request.tokens)
+                if matched:
+                    # the matched span's prefill is SKIPPED: the engine
+                    # copies the pinned blocks into scratch before the
+                    # first planned chunk runs; planning starts at the
+                    # first uncached token
+                    st.prefill_pos = matched
+                    st.prefix_matched = matched
+                    st.prefix_nodes = nodes
+                    st.handle.prefix_matched = matched
             self._prefilling.append(st)
             budget -= self._plan_one(st, budget, chunks)
         return chunks
+
+    def unpin_prefix(self, st: RequestState):
+        """Matched blocks have been copied into the request's scratch:
+        the trie nodes may be evicted again. Idempotent; also called on
+        release so a cancelled mid-admission request never wedges a pin."""
+        if st.prefix_nodes and self.prefix_cache is not None:
+            self.prefix_cache.release(st.prefix_nodes)
+        st.prefix_nodes = None
 
     def _plan_one(self, st: RequestState, budget: int,
                   chunks: List[PrefillChunk]) -> int:

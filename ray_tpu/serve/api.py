@@ -23,6 +23,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                ray_actor_options: Optional[Dict] = None,
                autoscaling_config=None, slo_config=None,
                num_hosts: int = 1, resumable_streams: Optional[bool] = None,
+               coalesce_streams: Optional[bool] = None,
                preempt_grace_s: Optional[float] = None,
                topology: Optional[str] = None, **_ignored):
     def wrap(target):
@@ -31,12 +32,17 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
         # explicit kwarg overrides either way
         resumable = (getattr(target, "__serve_resumable__", False)
                      if resumable_streams is None else resumable_streams)
+        # likewise __serve_coalesce_stream__ = True: streams yield
+        # token-chunk lists that the handle layer unpacks per token
+        coalesced = (getattr(target, "__serve_coalesce_stream__", False)
+                     if coalesce_streams is None else coalesce_streams)
         cfg = DeploymentConfig(
             num_replicas=num_replicas,
             max_ongoing_requests=max_ongoing_requests,
             ray_actor_options=ray_actor_options,
             num_hosts=num_hosts, topology=topology,
-            resumable_streams=bool(resumable))
+            resumable_streams=bool(resumable),
+            coalesce_streams=bool(coalesced))
         if preempt_grace_s is not None:
             cfg.preempt_grace_s = float(preempt_grace_s)
         if autoscaling_config is not None:

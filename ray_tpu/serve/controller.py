@@ -808,7 +808,9 @@ class ServeController:
                     "replicas": list(dep["replicas"]),
                     "loads": list(dep.get("loads") or []),
                     "resumable": bool(dep["spec"]["config"]
-                                      .get("resumable_streams"))}
+                                      .get("resumable_streams")),
+                    "coalesced": bool(dep["spec"]["config"]
+                                      .get("coalesce_streams"))}
 
     def get_status(self) -> Dict:
         with self._lock:

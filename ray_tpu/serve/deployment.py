@@ -58,6 +58,12 @@ class DeploymentConfig:
     # there, so a stream severed by replica death restarts on a
     # survivor with zero dropped or duplicated chunks
     resumable_streams: bool = False
+    # coalesced streams (serve/handle.py): True when the callable opted
+    # in (``__serve_coalesce_stream__ = True``) — its streaming methods
+    # yield CHUNK LISTS (several tokens per frame) and the handle layer
+    # unpacks them back to per-item iteration, with delivered/skip
+    # accounting token-granular inside each chunk
+    coalesce_streams: bool = False
     # drain deadline handed to a replica on a preemption NOTICE (GCE
     # spot TPU-VMs get ~30s between notice and kill; leave headroom for
     # the forced reap). Plain retirement keeps using

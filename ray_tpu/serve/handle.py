@@ -89,48 +89,67 @@ class DeploymentResponseGenerator:
     replica's generator yields, with the core protocol's backpressure
     (round-5; reference: DeploymentResponseGenerator, serve/handle.py).
 
+    Coalesced deployments (``__serve_coalesce_stream__``) yield LISTS of
+    items per wire frame; with ``unpack=True`` this wrapper buffers each
+    frame and hands items out one at a time, so the public per-item
+    iteration is identical while the handle→router→replica round-trip
+    amortizes over the whole frame. ``next_batch()`` exposes the frame
+    boundary for egress paths (the proxy writes a frame's NDJSON lines
+    in one syscall). All delivery/dedupe accounting below is
+    ITEM-granular — a resume mid-frame never drops or duplicates the
+    frame's tail.
+
     Replica death mid-stream re-routes ONCE, like the unary
-    DeploymentResponse: ``resume(delivered, chunks)`` (installed by the
+    DeploymentResponse: ``resume(fetched, items)`` (installed by the
     handle) restarts the stream on the current replica set. Resumable
-    deployments get the delivered chunks back as ``resume_tokens`` and
+    deployments get the fetched items back as ``resume_tokens`` and
     continue in place; non-resumable ones restart from scratch and this
-    wrapper discards the first ``delivered`` chunks — either way the
-    consumer sees every chunk exactly once."""
+    wrapper discards the first ``fetched`` items — either way the
+    consumer sees every item exactly once."""
 
     def __init__(self, ref_gen, router, replica_idx, resume=None,
-                 record_chunks: bool = False):
+                 record_chunks: bool = False, unpack: bool = False):
         self._gen = ref_gen
         self._router = router
         self._idx = replica_idx
         self._got_first = False
         self._resume = resume
-        self._delivered = 0
-        # delivered chunks, kept only for resumable deployments (they
-        # are token ids there — small); non-resumable re-routes dedupe
-        # by count alone
+        self._unpack = unpack
+        self._buf: List = []          # fetched-but-undelivered items
+        self._delivered = 0           # items handed to the consumer
+        self._fetched = 0             # items pulled off the wire
+        # fetched items, kept only for resumable deployments (they are
+        # token ids there — small); non-resumable re-routes dedupe by
+        # count alone. Buffered items count as fetched: on a resume they
+        # are still delivered from the buffer, so the fresh stream must
+        # continue AFTER them.
         self._chunks: Optional[List] = [] if record_chunks else None
 
     def __iter__(self):
         return self
 
     def _fetch(self):
-        """One chunk off the underlying ref generator (StopIteration at
-        end of stream). Split out so the resume path and the skip-ahead
-        dedupe share it."""
+        """One wire frame off the underlying ref generator, unpacked to
+        a list of items (StopIteration at end of stream). Split out so
+        the resume path and the skip-ahead dedupe share it."""
         # 60s liveness bound: a replica generator wedged in user
         # code surfaces a TimeoutError instead of hanging the caller
         ref = self._gen.next(timeout=60)
-        return self._get(ref)
+        value = self._get(ref)
+        if self._unpack and isinstance(value, (list, tuple)):
+            return list(value)
+        return [value]
 
     @staticmethod
     def _get(ref):
         return ray_tpu.get(ref, timeout=60)
 
-    def __next__(self):
-        while True:
+    def _fill_buf(self):
+        """Fetch the next non-empty frame into the buffer, re-routing
+        once on replica death. Raises StopIteration at end of stream."""
+        while not self._buf:
             try:
-                value = self._fetch()
-                break
+                items = self._fetch()
             except StopIteration:
                 self._settle()
                 raise
@@ -140,7 +159,7 @@ class DeploymentResponseGenerator:
                     raise
                 resume, self._resume = self._resume, None   # one-shot
                 try:
-                    fresh, skip = resume(self._delivered, self._chunks)
+                    fresh, skip = resume(self._fetched, self._chunks)
                     self._adopt(fresh, skip)
                 except StopIteration:
                     self._settle()
@@ -148,6 +167,14 @@ class DeploymentResponseGenerator:
                 except Exception:
                     self._settle()
                     raise e   # surface the ORIGINAL death, not the retry
+                continue
+            self._fetched += len(items)
+            if self._chunks is not None:
+                self._chunks.extend(items)
+            self._buf.extend(items)
+
+    def __next__(self):
+        self._fill_buf()
         if not self._got_first:
             # client-observed first chunk (TTFT as the CALLER saw it,
             # network + queueing included — the engine-side first-token
@@ -156,22 +183,44 @@ class DeploymentResponseGenerator:
             from ray_tpu._private import events
             events.record_instant("serve.first_chunk", category="serve")
         self._delivered += 1
-        if self._chunks is not None:
-            self._chunks.append(value)
-        return value
+        return self._buf.pop(0)
+
+    def next_batch(self) -> List:
+        """Drain everything currently buffered (at least one item,
+        fetching a frame if needed) in one call — the coalesced-egress
+        counterpart of __next__. Raises StopIteration at end of
+        stream."""
+        self._fill_buf()
+        if not self._got_first:
+            self._got_first = True
+            from ray_tpu._private import events
+            events.record_instant("serve.first_chunk", category="serve")
+        batch, self._buf = self._buf, []
+        self._delivered += len(batch)
+        return batch
 
     def _adopt(self, fresh: "DeploymentResponseGenerator", skip: int):
         """Take over a freshly routed stream: steal its underlying
         generator + routing slot (neutering the donor so its __del__
         doesn't decrement our in-flight count), then discard the first
-        `skip` chunks — the ones a non-resumable restart re-produces."""
+        `skip` items — the ones a non-resumable restart re-produces.
+        Item-granular: a restart frame that straddles the skip boundary
+        keeps its tail."""
         self._settle()
         self._gen = fresh._gen
         self._idx = fresh._idx
         self._router = fresh._router
         fresh._router = None
-        for _ in range(skip):
-            self._fetch()
+        while skip > 0:
+            items = self._fetch()
+            if len(items) > skip:
+                self._buf.extend(items[skip:])
+                self._fetched += len(items) - skip
+                if self._chunks is not None:
+                    self._chunks.extend(items[skip:])
+                skip = 0
+            else:
+                skip -= len(items)
 
     def _settle(self):
         if self._router is not None:
@@ -262,6 +311,7 @@ class _Router:
         self.shared_load: Dict[int, int] = {}  # controller-probed depths
         self.version = -1
         self.resumable = False   # deployment streams accept resume_tokens
+        self.coalesced = False   # streams yield token-chunk lists
         self.lock = threading.Lock()
         self._last_refresh = 0.0
         self.model_map: Dict[str, int] = {}   # multiplexed model -> replica
@@ -274,6 +324,7 @@ class _Router:
         with self.lock:
             self._last_refresh = time.monotonic()
             self.resumable = bool(info.get("resumable"))
+            self.coalesced = bool(info.get("coalesced"))
             if info["version"] != self.version:
                 self.version = info["version"]
                 self.replicas = info["replicas"]
@@ -294,6 +345,7 @@ class _Router:
         with self.lock:
             self._last_refresh = now
             self.resumable = bool(info.get("resumable"))
+            self.coalesced = bool(info.get("coalesced"))
             if info["version"] != self.version:
                 self.version = info["version"]
                 self.replicas = info["replicas"]
@@ -301,18 +353,36 @@ class _Router:
                 self.model_map.clear()
             self.shared_load = dict(enumerate(info.get("loads") or []))
 
-    def pick(self, model_id: str = ""):
+    def pick(self, model_id: str = "", session_id: str = "",
+             avoid: Optional[set] = None):
         self.refresh()
         with self.lock:
             n = len(self.replicas)
             if n == 0:
                 raise RuntimeError(
                     f"deployment {self.deployment_name} has no replicas")
+            score = lambda i: (self.shared_load.get(i, 0)  # noqa: E731
+                               + self.inflight.get(i, 0))
+            avoid = avoid or set()
             if model_id and self.model_map.get(model_id, n) < n:
                 # sticky multiplex routing: the replica that loaded this
                 # model keeps serving it (reference: multiplexed replica
                 # preference in the pow-2 scheduler)
                 idx = self.model_map[model_id]
+            elif session_id:
+                # session affinity (ROADMAP 1c): hash the session onto a
+                # sticky replica so repeat prompts land where their
+                # prefix KV is cached. Draining replicas are detached
+                # from `replicas` by the controller, so the hash only
+                # ever lands on live ones; if the sticky pick already
+                # failed this call (stale view: drained/died under us),
+                # fall back to least-ongoing among the others.
+                import zlib
+                idx = zlib.crc32(str(session_id).encode()) % n
+                if idx in avoid:
+                    rest = [i for i in range(n) if i not in avoid]
+                    if rest:
+                        idx = min(rest, key=score)
             elif n == 1:
                 idx = 0
             else:
@@ -321,10 +391,13 @@ class _Router:
                 # in-flight count — many independent handles converge on
                 # one view instead of each degrading toward random
                 # (reference: pow_2_scheduler.py:52 queue-length probes)
-                a, b = random.sample(range(n), 2)
-                score = lambda i: (self.shared_load.get(i, 0)  # noqa: E731
-                                   + self.inflight.get(i, 0))
-                idx = a if score(a) <= score(b) else b
+                cand = [i for i in range(n) if i not in avoid] \
+                    or list(range(n))
+                if len(cand) >= 2:
+                    a, b = random.sample(cand, 2)
+                    idx = a if score(a) <= score(b) else b
+                else:
+                    idx = cand[0]
             if model_id:
                 self.model_map[model_id] = idx
             self.inflight[idx] = self.inflight.get(idx, 0) + 1
@@ -362,14 +435,17 @@ class DeploymentHandle:
         model_id = getattr(self, "_model_id", "")
         if model_id:
             kwargs = {**kwargs, "__serve_model_id": model_id}
+        session_id = getattr(self, "_session_id", "")
         stream = getattr(self, "_stream", False)
         last_err = None
+        avoid: set = set()    # replicas that already failed this call
         from ray_tpu._private import events
         for _ in range(retry + 1):
             with events.record_span("serve.route", category="serve",
                                     deployment=self.deployment_name,
                                     app=self.app_name) as route_span:
-                idx, replica = self._router.pick(model_id)
+                idx, replica = self._router.pick(model_id, session_id,
+                                                 avoid)
                 route_span.set(replica=idx)
             try:
                 if stream:
@@ -382,7 +458,8 @@ class DeploymentHandle:
                                                           kwargs, retry)
                     return DeploymentResponseGenerator(
                         ref_gen, self._router, idx, resume=resume,
-                        record_chunks=self._router.resumable)
+                        record_chunks=self._router.resumable,
+                        unpack=self._router.coalesced)
                 ref = replica.handle_request.remote(method, args, kwargs)
                 # one resubmit only: the retried response carries NO
                 # further resubmit, so a crash loop surfaces instead of
@@ -397,6 +474,7 @@ class DeploymentHandle:
                                           resubmit=resub)
             except Exception as e:
                 self._router._dec(idx)
+                avoid.add(idx)
                 self._router.refresh(force=True)
                 last_err = e
         raise last_err
@@ -430,14 +508,24 @@ class DeploymentHandle:
         return _MethodCaller(self, name)
 
     def options(self, *, multiplexed_model_id: str = "",
-                stream: bool = False, **_kw) -> "DeploymentHandle":
-        if not multiplexed_model_id and not stream:
+                stream: bool = False, session_id: str = "",
+                **_kw) -> "DeploymentHandle":
+        if not multiplexed_model_id and not stream and not session_id:
             return self
         clone = DeploymentHandle(self.deployment_name, self.app_name)
         clone._router = self._router          # share routing state
         if multiplexed_model_id:
             clone._model_id = multiplexed_model_id
-        clone._stream = stream
+        if session_id:
+            # sticky-session routing: calls through this handle hash to
+            # one replica so repeat prompts hit its prefix cache
+            clone._session_id = str(session_id)
+        # a handle derived twice (options().options()) keeps its traits
+        clone._stream = stream or getattr(self, "_stream", False)
+        if not session_id and getattr(self, "_session_id", ""):
+            clone._session_id = self._session_id
+        if not multiplexed_model_id and getattr(self, "_model_id", ""):
+            clone._model_id = self._model_id
         return clone
 
     def __reduce__(self):

@@ -115,6 +115,15 @@ class HttpProxy:
         else:
             payload = await request.text()
         handle = self._handle_for(app_name)
+        # session affinity: an explicit header (or a session_id field in
+        # a JSON payload) pins this request's routing to the replica the
+        # session hashes to — repeat prompts land where their prefix KV
+        # is cached (the payload is forwarded untouched)
+        session_id = request.headers.get("X-RayTPU-Session", "")
+        if not session_id and isinstance(payload, dict):
+            session_id = str(payload.get("session_id") or "")
+        if session_id:
+            handle = handle.options(session_id=session_id)
         # the request's root span: every downstream phase (replica task,
         # engine slot, first token) parents under it because the handle
         # call below submits inside its trace context
@@ -166,15 +175,23 @@ class HttpProxy:
                 with events.trace_context(span.trace_id, span.span_id):
                     gen = handle.options(stream=True).remote(payload)
                 n = 0
-                for chunk in gen:
+                # frame-granular drain: next_batch() hands back every
+                # item already buffered from one coalesced wire frame,
+                # so the writer emits a frame's NDJSON lines in ONE
+                # write instead of a syscall per token
+                while True:
+                    try:
+                        batch = gen.next_batch()
+                    except StopIteration:
+                        break
                     if cancelled.is_set():
                         gen.close()
                         loop.call_soon_threadsafe(q.put_nowait,
                                                   ("end", n))
                         return
                     loop.call_soon_threadsafe(q.put_nowait,
-                                              ("item", chunk))
-                    n += 1
+                                              ("batch", batch))
+                    n += len(batch)
                 loop.call_soon_threadsafe(q.put_nowait, ("end", n))
             except Exception as e:
                 if gen is not None:
@@ -191,9 +208,12 @@ class HttpProxy:
         try:
             while True:
                 kind, item = await q.get()
-                if kind == "item":
-                    await resp.write(
-                        (json.dumps(item, default=str) + "\n").encode())
+                if kind == "batch":
+                    # one write per coalesced frame, one NDJSON line per
+                    # item — the client-visible protocol is unchanged
+                    await resp.write("".join(
+                        json.dumps(v, default=str) + "\n"
+                        for v in item).encode())
                 elif kind == "error":
                     span.end(status=500, error=type(item).__name__)
                     await resp.write(

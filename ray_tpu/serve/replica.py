@@ -163,7 +163,8 @@ class Replica:
         # ended in the outer finally (which also runs on close())
         sspan = events.start_span("replica.stream", category="serve",
                                   method=method)
-        chunks = 0
+        chunks = 0      # wire frames yielded (coalesced batches count 1)
+        items = 0       # items inside them (tokens, for coalesced LLMs)
         try:
             fn = self._callable if self._is_function \
                 else getattr(self._callable, method)
@@ -187,6 +188,8 @@ class Replica:
                     finally:
                         multiplex._current_model_id.reset(tok)
                     chunks += 1
+                    items += (len(chunk)
+                              if isinstance(chunk, (list, tuple)) else 1)
                     yield chunk
             finally:
                 # consumer walked away (GeneratorExit lands on the yield
@@ -201,7 +204,7 @@ class Replica:
                     except Exception:
                         pass
         finally:
-            sspan.end(chunks=chunks)
+            sspan.end(chunks=chunks, items=items)
             with self._lock:
                 self._ongoing -= 1
 

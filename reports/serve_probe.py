@@ -57,16 +57,33 @@ def _model_cfg(name):
 
 
 def _workload(spec, rng):
-    """Mixed-length request set + Poisson arrival offsets (seconds)."""
+    """Mixed-length request set + Poisson arrival offsets (seconds).
+
+    With ``shared_prefixes`` = K > 0 the workload models N sessions over
+    K distinct system prompts: every request opens with one of K shared
+    ``prefix_len``-token prefixes (chosen uniformly) followed by a short
+    random suffix — the radix-cache shape (only the FIRST request per
+    prefix pays its prefill)."""
+    import numpy as np
     n = spec.get("n_requests", 24)
     plo, phi = spec.get("prompt_lens", [4, 48])
     nlo, nhi = spec.get("new_tokens", [8, 48])
     vocab = spec.get("vocab", 128)
+    k = int(spec.get("shared_prefixes", 0))
+    prefixes = []
+    if k > 0:
+        plen = int(spec.get("prefix_len", 64))
+        prefixes = [rng.integers(0, vocab, size=plen).astype("int32")
+                    for _ in range(k)]
+        plo, phi = spec.get("suffix_lens", [2, 12])
     reqs = []
-    for _ in range(n):
+    for i in range(n):
         p = int(rng.integers(plo, phi + 1))
+        body = rng.integers(0, vocab, size=p).astype("int32")
+        if prefixes:
+            body = np.concatenate([prefixes[int(rng.integers(k))], body])
         reqs.append({
-            "prompt": rng.integers(0, vocab, size=p).astype("int32"),
+            "prompt": body,
             "new": int(rng.integers(nlo, nhi + 1)),
         })
     rate = spec.get("arrival_rate_rps", 50.0)
@@ -77,7 +94,7 @@ def _workload(spec, rng):
 
 
 def _run_continuous(engine, reqs, arrivals):
-    """Submit at Poisson offsets; returns (tokens_per_s, ttfts_ms)."""
+    """Submit at Poisson offsets; returns (tokens_per_s, handles)."""
     handles = [None] * len(reqs)
 
     def submitter():
@@ -96,8 +113,16 @@ def _run_continuous(engine, reqs, arrivals):
     for h in handles:
         total += len(h.tokens())          # drains to completion
     wall = time.perf_counter() - t_start
-    ttfts = [h.ttft_s * 1000.0 for h in handles if h.ttft_s is not None]
-    return total / wall, ttfts
+    return total / wall, handles
+
+
+def _ttfts_ms(handles):
+    return [h.ttft_s * 1000.0 for h in handles if h.ttft_s is not None]
+
+
+def _p(vals, q):
+    vals = sorted(vals)
+    return vals[min(len(vals) - 1, int(len(vals) * q))] if vals else 0.0
 
 
 def _run_static(model, params, mesh, reqs, n_slots, vocab):
@@ -145,26 +170,42 @@ def run(spec):
     n_slots = spec.get("n_slots", 8)
     max_len = spec.get("max_len", min(256, cfg.max_seq_len))
     prefill_chunk = spec.get("prefill_chunk", 32)
+    shared_k = int(spec.get("shared_prefixes", 0))
+    # prefix workload: enough cache slots that every distinct shared
+    # prefix fits (K * prefix_len tokens of blocks), unless pinned
+    cache_slots = spec.get("prefix_cache_slots")
+    if cache_slots is None:
+        cache_slots = 0
+        if shared_k:
+            plen = int(spec.get("prefix_len", 64))
+            cache_slots = max(1, -(-shared_k * plen // max_len))
     params = model.init(jax.random.PRNGKey(0),
                         np.zeros((1, 8), np.int32))["params"]
-    engine = InferenceEngine(
-        model, params,
-        EngineConfig(n_slots=n_slots, max_len=max_len,
-                     prefill_chunk=prefill_chunk,
-                     prefill_budget=spec.get("prefill_budget",
-                                             2 * prefill_chunk)))
-    engine.start()
+
+    def build_engine(prefix_slots):
+        eng = InferenceEngine(
+            model, params,
+            EngineConfig(n_slots=n_slots, max_len=max_len,
+                         prefill_chunk=prefill_chunk,
+                         prefill_budget=spec.get("prefill_budget",
+                                                 2 * prefill_chunk),
+                         prefix_cache_slots=prefix_slots))
+        return eng.start()
+
+    engine = build_engine(int(cache_slots))
     rng = np.random.default_rng(spec.get("seed", 0))
     reqs, arrivals = _workload(spec, rng)
 
-    # warmup: compile all three engine programs on a short request
+    # warmup: compile all engine programs on a short request
     list(engine.submit(reqs[0]["prompt"][:4], max_new_tokens=2))
 
-    rates, all_ttfts = [], []
+    rates, all_handles = [], []
     for _ in range(spec.get("runs", 3)):
-        rate, ttfts = _run_continuous(engine, reqs, arrivals)
+        rate, handles = _run_continuous(engine, reqs, arrivals)
         rates.append(rate)
-        all_ttfts.extend(ttfts)
+        all_handles.extend(handles)
+    stats = engine.stats()
+    compile_count = stats["decode_compile_count"]
     engine.stop()
 
     mesh = make_mesh(MeshConfig(data=1, fsdp=1, seq=1, tensor=1),
@@ -175,20 +216,50 @@ def run(spec):
     rates.sort()
     med = rates[len(rates) // 2]
     spread = (rates[-1] - rates[0]) / med if med else 0.0
-    all_ttfts.sort()
-    p50 = all_ttfts[len(all_ttfts) // 2] if all_ttfts else 0.0
-    p95 = all_ttfts[int(len(all_ttfts) * 0.95)] if all_ttfts else 0.0
-    return {
+    all_ttfts = sorted(_ttfts_ms(all_handles))
+    result = {
         "model": spec.get("model", "tiny"), "n_slots": n_slots,
         "max_len": max_len, "n_requests": len(reqs),
         "arrival_rate_rps": spec.get("arrival_rate_rps", 50.0),
         "serve_tokens_per_s": round(med, 1),
         "spread": round(spread, 3),
         "runs": [round(r, 1) for r in rates],
-        "ttft_p50_ms": round(p50, 1), "ttft_p95_ms": round(p95, 1),
+        "ttft_p50_ms": round(_p(all_ttfts, 0.50), 1),
+        "ttft_p95_ms": round(_p(all_ttfts, 0.95), 1),
         "static_tokens_per_s": round(static_rate, 1),
         "vs_static": round(med / static_rate, 3) if static_rate else None,
+        "decode_compile_count": compile_count,
     }
+    if shared_k:
+        # hit/miss TTFT split (the radix cache's reason to exist: a hit
+        # skips the shared prefix's prefill entirely) + the same
+        # workload through a cache-DISABLED engine in the same entry
+        hit = _ttfts_ms([h for h in all_handles if h.prefix_matched])
+        miss = _ttfts_ms([h for h in all_handles if not h.prefix_matched])
+        p95_hit, p95_miss = _p(hit, 0.95), _p(miss, 0.95)
+        base = build_engine(0)
+        list(base.submit(reqs[0]["prompt"][:4], max_new_tokens=2))
+        base_rates = []
+        for _ in range(spec.get("runs", 3)):
+            r0, _h = _run_continuous(base, reqs, arrivals)
+            base_rates.append(r0)
+        base.stop()
+        base_rates.sort()
+        base_med = base_rates[len(base_rates) // 2]
+        result.update({
+            "shared_prefixes": shared_k,
+            "prefix_len": int(spec.get("prefix_len", 64)),
+            "prefix_cache_slots": int(cache_slots),
+            "prefix_hit_rate": stats.get("prefix_hit_rate", 0.0),
+            "prefix_tokens_saved": stats.get("prefix_tokens_saved", 0),
+            "ttft_p95_hit_ms": round(p95_hit, 1),
+            "ttft_p95_miss_ms": round(p95_miss, 1),
+            "ttft_hit_vs_miss_p95": round(p95_hit / p95_miss, 3)
+            if p95_miss else None,
+            "no_prefix_tokens_per_s": round(base_med, 1),
+            "vs_no_prefix": round(med / base_med, 3) if base_med else None,
+        })
+    return result
 
 
 if __name__ == "__main__":
