@@ -67,16 +67,19 @@ ray_tpu.shutdown()
 """
 
 
-def _multi_client(snippet, n_clients=4, duration=5.0):
+def _multi_client(snippet, n_clients=4, duration=5.0, env=None):
     """Reference's multi-client rows run N driver processes against one
     cluster (release/perf_metrics microbenchmark multi_client_*).
     Returns the per-client rates (one per process that reported)."""
+    import os
     import subprocess
     import ray_tpu
     addr = ray_tpu.get_gcs_address()
+    child_env = dict(os.environ, **(env or {}))
     procs = [subprocess.Popen(
         [sys.executable, "-c", snippet, addr, str(duration)],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=child_env)
         for _ in range(n_clients)]
     rates = []
     for p in procs:
@@ -95,15 +98,45 @@ def bench_multi_client_put_bandwidth(ray_tpu, duration=5.0):
     """Aggregate same-node put bandwidth of 4 concurrent clients, with
     the per-client rates and their spread — a contention regression must
     be attributable to a slow client, not averaged away (the striped
-    arena's whole point is that these clients no longer share a lock)."""
-    rates = _multi_client(_CLIENT_PUT_SNIPPET, duration=duration)
-    srt = sorted(rates)
-    med = srt[len(srt) // 2] if srt else 0.0
-    return {"value": sum(rates),
-            "per_client": [round(r, 3) for r in rates],
-            "client_spread": round((srt[-1] - srt[0]) / med, 3)
+    arena's whole point is that these clients no longer share a lock).
+
+    Two multi-core hardenings (the r05 0.113x-baseline investigation):
+
+    - The copy-pool thread budget is DIVIDED across the concurrent
+      clients. Each client defaults RAY_TPU_PUT_COPY_THREADS to
+      min(4, cpus), so 4 clients spawned 4x that many copy threads —
+      n_clients * threads oversubscribing the cores turns the parallel
+      memcpy into a context-switch storm precisely in the benchmark
+      meant to show put scaling. cpus // n_clients threads per client
+      keeps the aggregate at one copier per core.
+    - Accepted samples only: a per-client rate above this box's warm
+      memcpy ceiling is physically impossible (clock artifact under
+      oversubscription — same rule as the decode probe's roofline
+      filter); impossible samples are dropped from the aggregate,
+      spread, and the vs_box_ceiling ratio, and reported in
+      `rejected`."""
+    import os
+    cpus = os.cpu_count() or 1
+    n_clients = 4
+    per_client_threads = max(1, cpus // n_clients)
+    rates = _multi_client(
+        _CLIENT_PUT_SNIPPET, n_clients=n_clients, duration=duration,
+        env={"RAY_TPU_PUT_COPY_THREADS": str(per_client_threads)})
+    ceiling = bench_memcpy_ceiling(duration=1.0)
+    # accept up to the ceiling + 10% measurement slack; a single client
+    # can at best match one warm memcpy stream
+    accepted = sorted(r for r in rates if r <= ceiling * 1.1)
+    rejected = [round(r, 3) for r in rates if r > ceiling * 1.1]
+    med = accepted[len(accepted) // 2] if accepted else 0.0
+    value = sum(accepted)
+    return {"value": value,
+            "per_client": [round(r, 3) for r in accepted],
+            "rejected": rejected,
+            "client_spread": round((accepted[-1] - accepted[0]) / med, 3)
             if med else 0.0,
-            "n_clients": len(rates)}
+            "copy_threads_per_client": per_client_threads,
+            "vs_box_ceiling": round(value / ceiling, 3) if ceiling else None,
+            "n_clients": len(accepted)}
 
 V5E_PEAK_FLOPS = 197e12     # bf16
 MFU_BASELINE = 0.40         # BASELINE.json north star: >=40% MFU
@@ -574,6 +607,35 @@ def bench_transfer_gb_per_s():
     return {"skipped": True, "reason": last}
 
 
+def bench_weight_broadcast_gb_per_s():
+    """Weight-distribution bandwidth (reports/broadcast_probe.py): one
+    256 MB blob delivered to every node of a fresh 1-head + 3-node
+    local cluster through `ray_tpu.broadcast_weights()` (binomial relay
+    tree, spanning-arena receive regions, striped data plane) vs the
+    SEQUENTIAL point-to-point baseline in the same entry — `vs_p2p` is
+    the ratchet (the relay tree earns its keep at > 1.0: the source
+    sends O(log n) copies and subtree pushes overlap). Per-node arrival
+    rates come from the receivers' store.broadcast.arrival events.
+    Needs the cluster runtime (Python >= 3.12)."""
+    import os
+    import sys as _sys
+    if _sys.version_info < (3, 12):
+        return {"skipped": True,
+                "reason": "cluster runtime requires Python >= 3.12"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    runner = os.path.join(here, "reports", "broadcast_probe.py")
+    spec = {"size_mb": 256, "n_nodes": 3, "runs": 3}
+    last = "unknown"
+    for attempt in range(2):
+        if attempt:
+            time.sleep(5)
+        result, last = _run_probe(runner, spec, timeout=900)
+        if result is not None:
+            return result
+        log(f"broadcast probe failed: {last}")
+    return {"skipped": True, "reason": last}
+
+
 def bench_observability_overhead():
     """Observability cost guard (reports/trace_probe.py): put and
     decode-step throughput with the WHOLE plane enabled (span recorder
@@ -880,6 +942,30 @@ def main():
         log(f"transfer probe FAILED: {e}")
         results["transfer_gb_per_s"] = {"skipped": True,
                                         "reason": str(e)[:200]}
+
+    try:
+        bc = bench_weight_broadcast_gb_per_s()
+        if not bc.get("skipped"):
+            results["weight_broadcast_gb_per_s"] = {
+                "value": bc["weight_broadcast_gb_per_s"], "unit": "GB/s",
+                "vs_p2p": bc["vs_p2p"],
+                "p2p_gb_per_s": bc["p2p_gb_per_s"],
+                "size_mb": bc["size_mb"], "n_nodes": bc["n_nodes"],
+                "spread": bc["spread"], "runs": bc["runs"],
+                "p2p_runs": bc["p2p_runs"],
+                "per_node_arrival_gb_per_s":
+                    bc.get("per_node_arrival_gb_per_s"),
+                "streams_knob": "RAY_TPU_TRANSFER_STREAMS_LARGE"}
+            log(f"weight_broadcast_gb_per_s: "
+                f"{bc['weight_broadcast_gb_per_s']} "
+                f"(vs_p2p {bc['vs_p2p']}x)")
+        else:
+            results["weight_broadcast_gb_per_s"] = bc
+            log(f"broadcast probe skipped: {bc.get('reason')}")
+    except Exception as e:
+        log(f"broadcast probe FAILED: {e}")
+        results["weight_broadcast_gb_per_s"] = {"skipped": True,
+                                                "reason": str(e)[:200]}
 
     try:
         ceiling = bench_memcpy_ceiling()

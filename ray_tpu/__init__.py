@@ -262,6 +262,30 @@ def put(value: Any) -> ObjectRef:
     return _get_worker().put(value)
 
 
+def broadcast_weights(weights: Any, node_ids: Optional[Sequence[str]] = None,
+                      *, max_retries: int = 2) -> ObjectRef:
+    """Distribute one (multi-GB) weight blob to every node, fast.
+
+    One source ``put`` into a pinned arena span (objects larger than one
+    arena stripe land in a spanning allocation transparently), then a
+    log-depth binomial relay tree fans the sealed bytes out across the
+    cluster over the striped raw-socket data plane — senders stream
+    pinned memoryviews, receivers ``recv_into`` their own spanning
+    allocations, zero staging copies end to end. If a relay node dies
+    mid-subtree the root retries through the surviving holders.
+
+    ``weights`` may be any serializable value (a params pytree, a state
+    dict, raw bytes) or an existing :class:`ObjectRef`. Returns the ref;
+    consumers on every node ``ray_tpu.get`` it zero-copy from their
+    local arena. ``node_ids=None`` targets every node in the cluster.
+    """
+    w = _get_worker()
+    ref = weights if isinstance(weights, ObjectRef) else w.put(weights)
+    w.broadcast_weights(ref, list(node_ids) if node_ids is not None
+                        else None, max_retries=max_retries)
+    return ref
+
+
 def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
          timeout: Optional[float] = None):
     cc = _client()
@@ -364,7 +388,8 @@ def get_runtime_context():
 import ray_tpu.util as util  # noqa: E402  (public subpackage)
 
 __all__ = [
-    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "init", "shutdown", "is_initialized", "remote", "get", "put",
+    "broadcast_weights", "wait",
     "kill", "cancel", "timeline", "get_actor", "nodes", "cluster_resources",
     "available_resources", "ObjectRef", "ObjectRefGenerator",
     "ActorHandle", "ActorClass",

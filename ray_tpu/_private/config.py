@@ -124,6 +124,16 @@ _flag("transfer_stripe_min_bytes", int, 8 * 1024 * 1024,
       "Minimum bytes per stripe before a push fans out across an "
       "additional data-plane connection (small objects stay on one "
       "stream; striping overhead would dominate).")
+_flag("transfer_streams_large", int, 8,
+      "Stream count for weight-sized transfers: objects at or above "
+      "transfer_large_object_bytes stripe across this many data-plane "
+      "connections instead of transfer_streams (multi-GB weight "
+      "broadcasts want every core's kernel copy bandwidth; small "
+      "transfers keep the low default). <= transfer_streams disables "
+      "the escalation.")
+_flag("transfer_large_object_bytes", int, 256 * 1024 * 1024,
+      "Size threshold at which a transfer counts as weight-sized and "
+      "fans out across transfer_streams_large connections.")
 _flag("pull_inflight_bytes", int, 256 * 1024 * 1024,
       "Admission budget for concurrent inbound object transfers on one "
       "node; pulls past it queue FIFO (reference: PullManager "

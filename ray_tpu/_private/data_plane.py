@@ -78,6 +78,20 @@ class DataPlaneUnavailable(ConnectionError):
     the same negotiated receive state."""
 
 
+def adaptive_streams(size: int) -> int:
+    """Stream count for one transfer of `size` bytes: weight-sized
+    objects (>= cfg.transfer_large_object_bytes) escalate from the
+    cfg.transfer_streams default to cfg.transfer_streams_large — a
+    multi-GB broadcast wants every core's kernel-copy bandwidth, while
+    small transfers keep striping overhead off the wire. The escalation
+    is off whenever transfer_streams_large <= transfer_streams."""
+    streams = cfg.transfer_streams
+    large = cfg.transfer_streams_large
+    if large > streams and size >= cfg.transfer_large_object_bytes:
+        return large
+    return streams
+
+
 def stripe_ranges(size: int, streams: int, stripe_min: int) -> List[tuple]:
     """Split [0, size) into contiguous (offset, length) stripes: at most
     `streams`, each at least `stripe_min` bytes (except a small final
@@ -92,6 +106,36 @@ def stripe_ranges(size: int, streams: int, stripe_min: int) -> List[tuple]:
         ranges.append((off, length))
         off += length
     return ranges
+
+
+def binomial_split(targets: List[str]) -> List[tuple]:
+    """Binomial-tree fan-out plan: split `targets` into (head, rest)
+    pairs — the sender pushes to each head with `rest` delegated as its
+    relay subtree, so the source sends O(log n) copies instead of n.
+    Pure planning half of NodeManager.h_broadcast_object (unit-testable
+    on any interpreter)."""
+    plan = []
+    targets = list(targets)
+    while targets:
+        mid = (len(targets) + 1) // 2
+        plan.append((targets[0], targets[1:mid]))
+        targets = targets[mid:]
+    return plan
+
+
+def plan_rebroadcast(missing: List[str], holders: List[str]) -> List[tuple]:
+    """Retry plan after a relay node died mid-subtree: shard the nodes
+    that never received the object across every SURVIVING holder
+    (round-robin), each holder re-broadcasting its shard through its own
+    relay tree. Returns (holder, [targets]) pairs; empty when nothing is
+    missing or no holder survives."""
+    holders = [h for h in holders if h]
+    if not missing or not holders:
+        return []
+    shards: Dict[str, List[str]] = {h: [] for h in holders}
+    for i, node in enumerate(missing):
+        shards[holders[i % len(holders)]].append(node)
+    return [(h, nodes) for h, nodes in shards.items() if nodes]
 
 
 async def _recv_exact_into(loop, sock, view: memoryview, *,
@@ -341,7 +385,7 @@ class DataPlaneClient:
         DataPlaneUnavailable before any payload byte moved,
         DataPlaneError after (the receive state is then poisoned; the
         caller must error the push and let the pull side retry)."""
-        ranges = stripe_ranges(size, cfg.transfer_streams,
+        ranges = stripe_ranges(size, adaptive_streams(size),
                                cfg.transfer_stripe_min_bytes)
         socks = await self._acquire(addr, len(ranges))
         sent = [0]      # payload bytes this push put on the wire

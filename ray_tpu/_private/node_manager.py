@@ -177,6 +177,7 @@ class NodeManager:
             "push_chunk": self.h_push_chunk,
             "push_abort": self.h_push_abort,
             "broadcast_object": self.h_broadcast_object,
+            "has_object": self.h_has_object,
             "restore_object": self.h_restore_object,
             "spill_now": self.h_spill_now,
             "free_object": self.h_free_object,
@@ -1440,16 +1441,24 @@ class NodeManager:
                 fut.cancel()
 
     async def h_request_push(self, conn, oid: bytes, to_node: str,
-                             relay: Optional[List[str]] = None):
+                             relay: Optional[List[str]] = None,
+                             bcast: bool = False):
         """Holder side: stream `oid` to `to_node` with a bounded chunk
         window. `relay` rides along for tree broadcast — the receiver
-        re-broadcasts to its half of the target list after sealing.
+        re-broadcasts to its half of the target list after sealing;
+        `bcast` tags the transfer as part of a broadcast so arrival
+        instrumentation fires on every node of the tree.
 
         Control plane (`push_begin`) negotiates over the RPC connection;
         chunk bytes move on the binary data plane when the peer
-        advertises one (striped across `cfg.transfer_streams` raw
-        connections), falling back to msgpack chunks on the RPC
-        connection for peers that predate the data-plane advertisement."""
+        advertises one (striped across the adaptive stream count — see
+        data_plane.adaptive_streams), falling back to msgpack chunks on
+        the RPC connection for peers that predate the data-plane
+        advertisement."""
+        if relay:
+            # chaos: a relay node dying mid-subtree (the broadcast
+            # root's await must surface this and retry via survivors)
+            rpc._maybe_inject_failure("relay_push")
         buf = self.store.get(oid)
         if buf is None and oid in self.spilled:
             await self.h_restore_object(conn, oid)
@@ -1464,7 +1473,7 @@ class NodeManager:
             size = len(buf.data)
             status = await peer.call("push_begin", oid=oid, data_size=size,
                                      meta=bytes(buf.metadata),
-                                     relay=relay or [])
+                                     relay=relay or [], bcast=bcast)
             if status == "full":
                 raise RuntimeError(
                     f"receiver {to_node[:12]} has no room for "
@@ -1536,13 +1545,24 @@ class NodeManager:
             _check(await f)
 
     def h_push_begin(self, conn, oid: bytes, data_size: int, meta: bytes,
-                     relay: Optional[List[str]] = None):
+                     relay: Optional[List[str]] = None,
+                     bcast: bool = False):
         """Receiver side: allocate the arena region for an incoming push.
         Status: "ok" (send chunks), "have" (already present/receiving),
-        "full" (no arena room — the pusher must error, not silently skip)."""
+        "full" (no arena room — the pusher must error, not silently skip).
+
+        A weight-sized incoming object lands in a SPANNING arena
+        allocation transparently (store.create routes by size), so the
+        data plane's recv_into writes straight into the multi-stripe
+        region — zero staging copies end to end."""
         if self.store.contains(oid) or oid in self._receiving:
             return "have"
-        bufs = self.store.create(oid, data_size, len(meta))
+        try:
+            bufs = self.store.create(oid, data_size, len(meta))
+        except MemoryError:
+            # arena (or span window) exhausted even after eviction: the
+            # documented "full" status, not a raw remote error
+            return "full"
         if bufs is None:
             return "full"
         data, meta_view = bufs
@@ -1552,6 +1572,8 @@ class NodeManager:
         # (the 60s idle sweep stays as the backstop for silent stalls)
         self._receiving[oid] = {"data": data, "remaining": data_size,
                                 "relay": list(relay or []),
+                                "bcast": bool(bcast), "size": data_size,
+                                "t0": time.monotonic(),
                                 "ctrl": conn, "t": time.monotonic()}
         if data_size == 0:
             self._finish_receive(oid)
@@ -1603,12 +1625,28 @@ class NodeManager:
     def _finish_receive(self, oid: bytes):
         st = self._receiving.pop(oid)
         self.store.seal(oid)
+        if st.get("bcast"):
+            # per-node arrival instrumentation: one instant per tree
+            # node, carrying bytes + the relay fan-out it now owns
+            try:
+                from ray_tpu._private import events
+                dt = time.monotonic() - st.get("t0", st["t"])
+                size = st.get("size", 0)
+                events.record_instant(
+                    "store.broadcast.arrival", category="store",
+                    object_id=oid.hex()[:16], bytes=size,
+                    recv_s=round(dt, 6),
+                    gb_per_s=round(size / dt / 1e9, 3) if dt > 0 else None,
+                    relay_targets=len(st["relay"]))
+            except Exception:
+                pass
         done = self._recv_done.get(oid)
         if done is not None and not done.done():
             done.set_result(True)
         if st["relay"]:
             relay_task = asyncio.ensure_future(
-                self.h_broadcast_object(None, oid, st["relay"]))
+                self.h_broadcast_object(None, oid, st["relay"],
+                                        bcast=st.get("bcast", False)))
             self._tasks.append(relay_task)
             relay_task.add_done_callback(
                 lambda t: self._tasks.remove(t)
@@ -1617,24 +1655,33 @@ class NodeManager:
         return True
 
     async def h_broadcast_object(self, conn, oid: bytes,
-                                 targets: List[str]):
+                                 targets: List[str], bcast: bool = True):
         """Binomial-tree broadcast: push to the head of each half with the
         rest of that half delegated as `relay` — the source sends
         O(log n) copies instead of n (reference pattern:
         release object_store broadcast benchmarks; reference core is
-        point-to-point only)."""
+        point-to-point only). A relay failure anywhere in the subtree
+        propagates to this await (the completing chunk's ack defers past
+        the subtree), so the broadcast root observes partial delivery
+        and can retry via the surviving holders."""
+        from ray_tpu._private.data_plane import binomial_split
         targets = [t for t in targets if t != self.node_id]
-        pushes = []
-        while targets:
-            mid = (len(targets) + 1) // 2
-            head, rest = targets[0], targets[1:mid]
-            pushes.append(self.h_request_push(None, oid, head, relay=rest))
-            targets = targets[mid:]
+        pushes = [self.h_request_push(None, oid, head, relay=rest,
+                                      bcast=bcast)
+                  for head, rest in binomial_split(targets)]
         results = await asyncio.gather(*pushes, return_exceptions=True)
         errs = [r for r in results if isinstance(r, BaseException)]
         if errs:
             raise errs[0]
         return True
+
+    def h_has_object(self, conn, oid: bytes):
+        """Cheap holder probe (no restore side effects): does this node
+        hold `oid` sealed in its arena, or spilled on its disk? The
+        broadcast retry path uses it to census survivors after a relay
+        death."""
+        return {"in_store": self.store.contains(oid),
+                "spilled": oid in self.spilled}
 
     async def h_fetch_object(self, conn, oid: bytes, part: str = "meta",
                              offset: int = 0, length: int = 0):

@@ -82,6 +82,17 @@ class _Lib:
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
                 ctypes.c_int,
             ]
+            lib.rt_max_alloc_bytes.restype = ctypes.c_uint64
+            lib.rt_max_alloc_bytes.argtypes = [ctypes.c_void_p]
+            lib.rt_create_spanning.restype = ctypes.c_int64
+            lib.rt_create_spanning.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_int,
+            ]
+            lib.rt_is_span.restype = ctypes.c_int
+            lib.rt_is_span.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rt_span_stats.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
             cls._instance = super().__new__(cls)
             cls._instance.lib = lib
         return cls._instance
@@ -242,6 +253,12 @@ class ObjectStoreClient:
 
         Returns None if the object already exists. Raises MemoryError if the
         arena is full even after LRU eviction.
+
+        Objects larger than one arena stripe route to the SPANNING path
+        natively (contiguous whole stripes, see shm_store.cpp): callers
+        need no size awareness — the returned views simply cover the
+        multi-stripe region, so sharded checkpoints / weight blobs put
+        and ``recv_into`` exactly like small objects.
         """
         off = self._lib.rt_create(self._handle(), oid, data_size, meta_size,
                                   1 if evictable else 0)
@@ -344,12 +361,47 @@ class ObjectStoreClient:
         """Aggregate store stats. Lock-free on the native side (seqlock
         snapshots per stripe) — polling this never queues behind a
         client's create."""
-        arr = (ctypes.c_uint64 * 13)()
+        arr = (ctypes.c_uint64 * 17)()
         self._lib.rt_stats(self._handle(), arr)
         keys = ["bytes_in_use", "capacity", "num_objects", "num_evictions",
                 "bytes_evicted", "create_count", "get_hits", "get_misses",
                 "poisoned", "num_stripes", "stripe_repairs",
-                "create_fallbacks", "seal_count"]
+                "create_fallbacks", "seal_count", "num_spans",
+                "span_creates", "span_evictions", "span_repairs"]
+        return dict(zip(keys, arr))
+
+    def max_alloc_bytes(self) -> int:
+        """Largest payload (data+meta) the per-stripe allocator holds;
+        one byte more routes to the spanning path transparently."""
+        return int(self._lib.rt_max_alloc_bytes(self._handle()))
+
+    def is_span(self, oid: bytes) -> bool:
+        """True when oid names a live spanning (multi-stripe) object."""
+        return bool(self._lib.rt_is_span(self._handle(), oid))
+
+    def create_spanning(self, oid: bytes, data_size: int, meta_size: int = 0,
+                        evictable: bool = True):
+        """Force the spanning path regardless of size (tests exercise
+        span machinery without multi-GB arenas). Same contract as
+        ``create``."""
+        off = self._lib.rt_create_spanning(
+            self._handle(), oid, data_size, meta_size,
+            1 if evictable else 0)
+        if off == -17:  # EEXIST
+            return None
+        if off < 0:
+            raise MemoryError(f"spanning create failed (rc={off})")
+        data = self._view[off:off + data_size]
+        meta = self._view[off + data_size:off + data_size + meta_size]
+        return data, meta
+
+    def span_stats(self) -> dict:
+        """Span-plane snapshot (weight-distribution observability)."""
+        arr = (ctypes.c_uint64 * 8)()
+        self._lib.rt_span_stats(self._handle(), arr)
+        keys = ["live_spans", "span_bytes", "stripes_claimed",
+                "span_creates", "span_evictions", "span_repairs",
+                "broken_slots", "max_span_bytes"]
         return dict(zip(keys, arr))
 
     def num_stripes(self) -> int:

@@ -1731,6 +1731,129 @@ class CoreWorker:
             await self.pool.call(holder["address"], "broadcast_object",
                                  oid=ref.id, targets=targets)
 
+    def _broadcast_holder_node(self, ref: ObjectRef) -> Optional[str]:
+        entry = self.owned.get(ref.id)
+        loc = entry.get("location") if entry is not None else None
+        if loc is None and self.store is not None \
+                and self.store.contains(ref.id):
+            loc = self.node_id
+        return loc
+
+    async def broadcast_weights_async(self, ref: ObjectRef,
+                                      node_ids: Optional[List[str]] = None,
+                                      max_retries: int = 2) -> Dict:
+        """Weight-distribution plane: fan `ref`'s sealed (possibly
+        multi-GB spanning) object out to the target nodes through the
+        node managers' binomial relay tree over the striped data plane —
+        one source put, log-depth fan-out, receivers recv_into their own
+        (spanning) arena allocations, zero staging copies end to end.
+
+        A relay node dying mid-subtree surfaces at the root's await
+        (the completing chunk's ack defers past the subtree); the retry
+        then takes a census of who actually holds the object and
+        re-broadcasts the missing shard from EVERY surviving holder in
+        parallel — the tree heals around the dead relay instead of
+        restarting from the single source. Nodes that left the cluster
+        are dropped (membership is the GCS's problem, not the
+        broadcast's). Returns {"delivered", "skipped", "retries"}.
+        """
+        from ray_tpu._private import events
+        from ray_tpu._private.data_plane import plan_rebroadcast
+        loc = self._broadcast_holder_node(ref)
+        if loc is None:
+            raise ValueError(
+                "broadcast_weights requires a sealed shm object (inline "
+                "objects travel with their task specs)")
+        view = await self.gcs_call_async("get_cluster_view")
+        if node_ids is None:
+            node_ids = list(view)
+        targets = [n for n in node_ids if n != loc and n in view]
+        skipped = [n for n in node_ids if n != loc and n not in view]
+        nbytes = None
+        if self.store is not None and self.store.contains(ref.id):
+            buf = self.store.get(ref.id)
+            if buf is not None:
+                nbytes = len(buf.data)
+                buf.close()
+
+        async def _census(nodes):
+            """(have, missing, gone) among `nodes` right now."""
+            have, missing, gone = [], [], []
+            async def probe(n):
+                try:
+                    r = await self.pool.call(view[n]["address"],
+                                             "has_object", oid=ref.id)
+                    (have if (r or {}).get("in_store") or
+                     (r or {}).get("spilled") else missing).append(n)
+                except Exception:
+                    gone.append(n)
+            await asyncio.gather(*[probe(n) for n in nodes])
+            return have, missing, gone
+
+        async def _bcast_from(holder_node, tgts):
+            if holder_node == self.node_id:
+                await self.node_conn.call("broadcast_object", oid=ref.id,
+                                          targets=tgts)
+            else:
+                await self.pool.call(view[holder_node]["address"],
+                                     "broadcast_object", oid=ref.id,
+                                     targets=tgts)
+
+        with events.record_span(
+                "store.broadcast", category="store",
+                object_id=ref.id.hex()[:16], bytes=nbytes,
+                peers=len(targets)) as span:
+            retries = 0
+            last_err: Optional[BaseException] = None
+            remaining = list(targets)
+            for attempt in range(max_retries + 1):
+                if not remaining:
+                    break
+                try:
+                    if attempt == 0:
+                        await self._bcast_via_holder(ref, loc, remaining,
+                                                     view)
+                        remaining = []
+                        break
+                    retries += 1
+                    have, missing, gone = await _census(remaining)
+                    skipped.extend(gone)
+                    remaining = missing
+                    if not remaining:
+                        break
+                    plan = plan_rebroadcast(remaining, [loc] + have)
+                    await asyncio.gather(*[
+                        _bcast_from(h, tgts) for h, tgts in plan])
+                    remaining = []
+                except Exception as e:      # noqa: BLE001 — retried below
+                    last_err = e
+                    logger.warning(
+                        "broadcast of %s attempt %d failed (%s); "
+                        "retrying via surviving holders",
+                        ref.id.hex()[:16], attempt, e)
+            if remaining:
+                raise RuntimeError(
+                    f"broadcast_weights of {ref.id.hex()[:16]} could not "
+                    f"reach {len(remaining)} node(s) after {retries} "
+                    f"retries") from last_err
+            delivered = [n for n in targets if n not in skipped]
+            span.set(delivered=len(delivered), skipped=len(skipped),
+                     retries=retries)
+        return {"delivered": delivered, "skipped": skipped,
+                "retries": retries}
+
+    async def _bcast_via_holder(self, ref: ObjectRef, loc: str,
+                                targets: List[str], view: Dict):
+        if loc == self.node_id:
+            await self.node_conn.call("broadcast_object", oid=ref.id,
+                                      targets=targets)
+        else:
+            holder = view.get(loc)
+            if holder is None:
+                raise RuntimeError(f"holder node {loc[:12]} unknown")
+            await self.pool.call(holder["address"], "broadcast_object",
+                                 oid=ref.id, targets=targets)
+
     async def cancel_task_async(self, ref: ObjectRef, force: bool = False):
         task_id = ids.task_id_of_object(ref.id)
         pt = self.pending_tasks.get(task_id)
@@ -3074,6 +3197,10 @@ class Worker:
 
     def broadcast(self, ref, node_ids):
         return self._run(self.core.broadcast_async(ref, node_ids))
+
+    def broadcast_weights(self, ref, node_ids=None, max_retries=2):
+        return self._run(self.core.broadcast_weights_async(
+            ref, node_ids, max_retries=max_retries))
 
     def cancel(self, ref, force=False):
         return self._run(self.core.cancel_task_async(ref, force))

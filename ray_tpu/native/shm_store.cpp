@@ -40,8 +40,32 @@
 //     there are lost, equivalent to eviction). The other stripes keep
 //     serving throughout.
 //
+// Spanning objects (v3): an object larger than one stripe's heap cannot
+// live in any stripe, so weight-sized blobs (sharded checkpoints, RL
+// weight pushes, cold-start attach) take the SPANNING path instead:
+//
+//   - The span claims m = ceil(need / stripe_bytes) physically
+//     CONTIGUOUS whole stripes (stripe i+1's heap starts exactly where
+//     stripe i's ends, so the payload is one contiguous region). Claimed
+//     stripes are marked span_owner and excluded from normal creates,
+//     per-stripe eviction and segment probing; their resident objects
+//     are LRU-evicted during the claim (pinned residents fail the
+//     window and the claim slides to the next one).
+//   - Span descriptors live in a small header-level table guarded by
+//     their own robust process-shared mutex (spans are few and huge; a
+//     single lock is never the bottleneck). The entry/payload
+//     colocation rule extends naturally: the descriptor IS the entry,
+//     and crash repair frees or invalidates the WHOLE span atomically —
+//     a poisoned member stripe marks the span broken, and broken spans
+//     are reclaimed (all member stripes at once) by the span-mutex
+//     repair path, the gc sweep and allocation pressure. LRU pressure
+//     can evict a whole unpinned span but can never half-free one.
+//   - rt_create routes by size (need > one stripe -> span path), so the
+//     Python client and every put/transfer path gains multi-GB objects
+//     transparently; rt_create_spanning forces the path for tests.
+//
 // Layout:
-//   [Header incl. Stripe[] | ObjectTable (segmented) | striped data arena]
+//   [Header incl. Stripe[] + SpanDesc[] | ObjectTable | striped arena]
 //
 // Object lifecycle: CREATED (writer owns buffer) -> SEALED (immutable,
 // readable by all) -> deleted (deferred until pin_count drops to zero).
@@ -70,7 +94,7 @@
 namespace {
 
 constexpr uint64_t kMagic = 0x5250555453544f52ULL;  // "RPUTSTOR"
-constexpr uint32_t kVersion = 2;
+constexpr uint32_t kVersion = 3;
 constexpr uint32_t kIdLen = 20;
 constexpr uint32_t kTableCapacity = 1 << 16;  // 65536 entries total
 constexpr uint64_t kAlign = 64;
@@ -82,6 +106,18 @@ constexpr uint64_t kMinStripeBytes = 128ULL << 20;
 
 // Object states.
 enum : uint32_t { kEmpty = 0, kCreated = 1, kSealed = 2, kTombstone = 3 };
+
+// Span descriptor states. kSpanClaiming is only ever observed by crash
+// repair: a live claim holds the span mutex for its whole duration, so
+// any claiming slot seen by a span-mutex holder belongs to a dead writer.
+constexpr uint32_t kMaxSpans = 16;
+enum : uint32_t {
+  kSpanEmpty = 0,
+  kSpanClaiming = 1,
+  kSpanCreated = 2,
+  kSpanSealed = 3,
+  kSpanBroken = 4,
+};
 
 // --------------------------------------------------------------- atomics
 // Shared-memory fields are plain integers accessed through __atomic
@@ -152,6 +188,9 @@ struct alignas(64) Stripe {
   pthread_mutex_t mutex;     // robust, process-shared
   uint32_t mutating;         // a locked mutation is in progress
   uint32_t poisoned;         // set transiently when a holder died mid-mutation
+  uint32_t span_owner;       // 0 = none, else owning span slot + 1: the whole
+                             // heap slice belongs to that spanning object
+  uint32_t _pad1;
   uint64_t lockseq;          // seqlock: odd while a locked section is open
   uint64_t arena_off;        // base-relative start of this stripe's heap
   uint64_t arena_size;
@@ -169,6 +208,25 @@ struct alignas(64) Stripe {
   uint32_t seg_start, seg_len;  // entry-table segment [start, start+len)
 };
 
+// Descriptor for one spanning object: the payload occupies the whole
+// contiguous heap slices of stripes [first_stripe, first_stripe +
+// n_stripes). Descriptors mutate only under the header's robust span
+// mutex; `state` is the atomic publication field (release-stored so a
+// lock-free reader that observes CREATED/SEALED sees consistent fields).
+struct SpanDesc {
+  uint8_t id[kIdLen];
+  uint32_t state;        // atomic (see enum above)
+  uint32_t first_stripe;
+  uint32_t n_stripes;
+  uint32_t pin_count;    // mutated under the span mutex
+  uint32_t flags;        // bit0: delete-pending, bit1: not-evictable
+  uint32_t _pad;
+  uint64_t data_size;
+  uint64_t meta_size;
+  uint64_t seq;          // LRU stamp (header span_clock value at last touch)
+  uint64_t ctime_sec;    // CLOCK_MONOTONIC seconds at creation
+};
+
 struct Header {
   uint64_t magic;
   uint32_t version;
@@ -179,6 +237,15 @@ struct Header {
   uint32_t num_stripes;
   uint32_t _pad0;
   uint64_t fallback_count;   // atomic: creates re-homed off their hash stripe
+  // ------------------------------------------------ spanning allocation
+  pthread_mutex_t span_mutex;  // robust, process-shared; guards spans[]
+  uint32_t span_mutating;      // a locked span mutation is in progress
+  uint32_t _pad2;
+  uint64_t span_clock;         // LRU clock for spans (under span mutex)
+  uint64_t span_creates;       // lifetime spans successfully created
+  uint64_t span_evictions;     // whole spans reclaimed under LRU pressure
+  uint64_t span_repairs;       // atomic: crash repairs that broke a span
+  SpanDesc spans[kMaxSpans];
   Stripe stripes[kMaxStripes];
 };
 
@@ -408,14 +475,10 @@ class StripeGuard {
   Stripe* sp_;
 };
 
-// Rebuild one stripe after its lock holder died mid-mutation: wipe the
-// table segment, reset the heap to a single free block. Objects resident
-// in the stripe are lost (survivors observe them as evicted — the same
-// contract as LRU eviction of an unspilled object). Caller holds the
-// (freshly made-consistent) stripe mutex.
-void repair_stripe_locked(Store* s, uint32_t si) {
-  Stripe* sp = &s->hdr->stripes[si];
-  memset(&s->table[sp->seg_start], 0, sizeof(Entry) * (uint64_t)sp->seg_len);
+// Reset one stripe's heap to a single free block (fresh-store state).
+// Caller holds the stripe mutex. Used by crash repair and by span
+// claim/free, whose payload writes overwrite the heap's block headers.
+void reset_stripe_heap_locked(Store* s, Stripe* sp) {
   sp->free_head = kNone;
   Block* b = at(s, sp, 0);
   set_size(b, sp->arena_size, true);
@@ -425,6 +488,38 @@ void repair_stripe_locked(Store* s, uint32_t si) {
   sp->free_head = 0;
   sp->bytes_in_use = 0;
   sp->num_objects = 0;
+}
+
+// Rebuild one stripe after its lock holder died mid-mutation: wipe the
+// table segment, reset the heap to a single free block. Objects resident
+// in the stripe are lost (survivors observe them as evicted — the same
+// contract as LRU eviction of an unspilled object). Caller holds the
+// (freshly made-consistent) stripe mutex.
+void repair_stripe_locked(Store* s, uint32_t si) {
+  Stripe* sp = &s->hdr->stripes[si];
+  memset(&s->table[sp->seg_start], 0, sizeof(Entry) * (uint64_t)sp->seg_len);
+  reset_stripe_heap_locked(s, sp);
+  if (sp->span_owner) {
+    // the poisoned stripe held part of a spanning object: its payload is
+    // gone, so the WHOLE span must die — mark the descriptor broken
+    // (lock-free CAS loop: we hold only this stripe's mutex and must not
+    // take the span mutex here). The span-mutex repair path, the gc
+    // sweep, and allocation pressure all reclaim broken spans' remaining
+    // member stripes atomically.
+    uint32_t slot = sp->span_owner - 1;
+    if (slot < kMaxSpans) {
+      SpanDesc* d = &s->hdr->spans[slot];
+      for (;;) {
+        uint32_t st = ld32(&d->state);
+        if (st == kSpanEmpty || st == kSpanBroken) break;
+        if (cas32(&d->state, st, kSpanBroken)) {
+          add64(&s->hdr->span_repairs, 1, __ATOMIC_RELAXED);
+          break;
+        }
+      }
+    }
+    sp->span_owner = 0;
+  }
   sp->repairs++;
 }
 
@@ -501,6 +596,8 @@ int64_t with_entry_locked(Store* s, const uint8_t* id, F&& fn) {
 // freed. Only this stripe's clients can contend with the sweep.
 uint64_t evict_stripe_locked(Store* s, uint32_t si, uint64_t bytes) {
   Stripe* sp = &s->hdr->stripes[si];
+  if (sp->span_owner) return 0;  // span stripes have no per-entry LRU: a
+                                 // span is reclaimed whole or not at all
   std::vector<std::pair<uint64_t, uint32_t>> cands;  // (seq, idx)
   for (uint32_t i = sp->seg_start; i < sp->seg_start + sp->seg_len; ++i) {
     Entry* e = &s->table[i];
@@ -543,6 +640,251 @@ void chaos_maybe_crash_in_create() {
   if (after <= 0) return;
   static std::atomic<long> creates{0};
   if (creates.fetch_add(1) + 1 == after) kill(getpid(), SIGKILL);
+}
+
+// Span analog: spec "shm_span_create=N" SIGKILLs this process inside its
+// Nth spanning create AFTER at least one member stripe is claimed, while
+// holding BOTH the span mutex and that stripe's mutex — the worst-case
+// death the two-level repair (stripe EOWNERDEAD -> span broken; span
+// EOWNERDEAD -> claiming slots freed) must recover from.
+long chaos_crash_span_create_after() {
+  static long n = [] {
+    const char* raw = getenv("RAY_TPU_TESTING_SHM_FAILURE");
+    if (!raw) return 0L;
+    const char* p = strstr(raw, "shm_span_create=");
+    return p ? atol(p + sizeof("shm_span_create=") - 1) : 0L;
+  }();
+  return n;
+}
+
+void chaos_maybe_crash_in_span_create() {
+  long after = chaos_crash_span_create_after();
+  if (after <= 0) return;
+  static std::atomic<long> creates{0};
+  if (creates.fetch_add(1) + 1 == after) kill(getpid(), SIGKILL);
+}
+
+// ------------------------------------------------- spanning allocation
+// All span-table mutations run under the header's robust span mutex.
+// Lock order is span_mutex -> stripe mutex (one stripe at a time);
+// nothing takes the span mutex while holding a stripe mutex (stripe
+// crash repair only CASes span state lock-free), so the order is
+// deadlock-free.
+
+void span_free_locked(Store* s, uint32_t slot);
+
+class SpanGuard {
+ public:
+  explicit SpanGuard(Store* s) : s_(s) {
+    Header* h = s->hdr;
+    int rc = pthread_mutex_lock(&h->span_mutex);
+    bool dead = rc == EOWNERDEAD;
+    if (dead) pthread_mutex_consistent(&h->span_mutex);
+    bool need_repair = dead && ld32(&h->span_mutating);
+    st32(&h->span_mutating, 1);
+    if (dead && !need_repair) {
+      // holder died between lock and the mutating publish (or after
+      // clearing it): the table itself is consistent, but a claim may
+      // still be stranded — the sweep below is idempotent, run it too
+      need_repair = true;
+    }
+    if (need_repair) {
+      // a span-mutex holder died: any kSpanClaiming slot belongs to it
+      // (live claims hold the mutex end-to-end), and kSpanBroken slots
+      // are ownerless — free both, reclaiming ALL member stripes, so
+      // repair of a poisoned span is deterministic and whole-span.
+      for (uint32_t k = 0; k < kMaxSpans; ++k) {
+        uint32_t st = ld32(&h->spans[k].state);
+        if (st == kSpanClaiming || st == kSpanBroken) {
+          span_free_locked(s, k);
+          add64(&h->span_repairs, 1, __ATOMIC_RELAXED);
+        }
+      }
+    }
+  }
+  ~SpanGuard() {
+    st32(&s_->hdr->span_mutating, 0);
+    pthread_mutex_unlock(&s_->hdr->span_mutex);
+  }
+
+ private:
+  Store* s_;
+};
+
+// Lock-free span lookup: slot index of a live (claiming excluded) span
+// matching id, or -1. Publication via the release-store of state.
+int span_find(Store* s, const uint8_t* id) {
+  for (uint32_t k = 0; k < kMaxSpans; ++k) {
+    SpanDesc* d = &s->hdr->spans[k];
+    uint32_t st = ld32(&d->state);
+    if ((st == kSpanCreated || st == kSpanSealed) &&
+        memcmp(d->id, id, kIdLen) == 0)
+      return (int)k;
+  }
+  return -1;
+}
+
+// Free one span: unpublish the descriptor, then release every member
+// stripe (identified by span_owner, NOT the descriptor's range — a
+// crash mid-claim leaves the range unreliable but span_owner exact),
+// rebuilding each heap to fresh-store state. Caller holds the span
+// mutex. Idempotent: a stripe already reclaimed by its own crash
+// repair (span_owner cleared) is skipped.
+void span_free_locked(Store* s, uint32_t slot) {
+  Header* h = s->hdr;
+  SpanDesc* d = &h->spans[slot];
+  st32(&d->state, kSpanBroken);  // unpublish before the stripes die
+  for (uint32_t si = 0; si < h->num_stripes; ++si) {
+    if (ld32(&h->stripes[si].span_owner, __ATOMIC_RELAXED) != slot + 1)
+      continue;
+    StripeGuard g(s, si);
+    Stripe* sp = &h->stripes[si];
+    if (sp->span_owner != slot + 1) continue;  // reclaimed under us
+    sp->span_owner = 0;
+    reset_stripe_heap_locked(s, sp);
+  }
+  memset(d->id, 0, kIdLen);
+  d->data_size = d->meta_size = 0;
+  d->pin_count = 0;
+  d->flags = 0;
+  st32(&d->state, kSpanEmpty);
+}
+
+// A normal create met a span-owned stripe: if the owning span is dead
+// (broken/empty — e.g. a crashed claim whose repair ran elsewhere),
+// reclaim the stripe for normal allocation. Caller holds the stripe
+// mutex (racing span_free_locked serializes on it; both sides re-check
+// span_owner under the lock, so the reclaim happens exactly once).
+// Returns true when the stripe is usable for normal allocation.
+bool reclaim_dead_span_stripe_locked(Store* s, uint32_t si) {
+  Stripe* sp = &s->hdr->stripes[si];
+  uint32_t slot = sp->span_owner - 1;
+  uint32_t st = slot < kMaxSpans
+                    ? ld32(&s->hdr->spans[slot].state)
+                    : (uint32_t)kSpanEmpty;  // corrupt owner: reclaim
+  if (st != kSpanEmpty && st != kSpanBroken) return false;
+  sp->span_owner = 0;
+  reset_stripe_heap_locked(s, sp);
+  return true;
+}
+
+// Evict whole LRU spans (sealed + unpinned + evictable) until `bytes`
+// are freed; broken slots are reclaimed for free. Returns bytes freed.
+uint64_t span_evict_bytes(Store* s, uint64_t bytes) {
+  Header* h = s->hdr;
+  SpanGuard g(s);
+  uint64_t freed = 0;
+  for (uint32_t k = 0; k < kMaxSpans; ++k)
+    if (ld32(&h->spans[k].state) == kSpanBroken) span_free_locked(s, k);
+  for (;;) {
+    if (freed >= bytes) break;
+    int victim = -1;
+    uint64_t best_seq = ~0ULL;
+    for (uint32_t k = 0; k < kMaxSpans; ++k) {
+      SpanDesc* d = &h->spans[k];
+      if (ld32(&d->state) != kSpanSealed || d->pin_count > 0 ||
+          (d->flags & 2))
+        continue;
+      if (d->seq < best_seq) { best_seq = d->seq; victim = (int)k; }
+    }
+    if (victim < 0) break;
+    uint64_t sz = h->spans[victim].data_size + h->spans[victim].meta_size;
+    span_free_locked(s, (uint32_t)victim);
+    add64(&h->span_evictions, 1, __ATOMIC_RELAXED);
+    freed += sz;
+  }
+  return freed;
+}
+
+// Create a spanning object across `m` contiguous whole stripes. Caller
+// guarantees need > 0. Returns the base-relative payload offset or a
+// negative errno-style code (same contract as rt_create).
+int64_t span_create(Store* s, const uint8_t* id, uint64_t data_size,
+                    uint64_t meta_size, int evictable) {
+  Header* h = s->hdr;
+  uint64_t need = data_size + meta_size;
+  uint64_t stripe_sz = h->stripes[0].arena_size;
+  uint32_t m = (uint32_t)((need + stripe_sz - 1) / stripe_sz);
+  if (m == 0) m = 1;
+  if (m > h->num_stripes) return -ENOMEM;
+
+  SpanGuard g(s);
+  if (span_find(s, id) >= 0) return -EEXIST;
+  {  // best-effort dup check against the normal table (same contract as
+     // rt_create's lock-free re-home check)
+    uint64_t hsh = hash_id(id);
+    if (find_lockfree(s, id, hsh, stripe_of(s, hsh)) != kNil)
+      return -EEXIST;
+  }
+  int slot = -1;
+  for (int pass = 0; pass < 2 && slot < 0; ++pass) {
+    for (uint32_t k = 0; k < kMaxSpans; ++k) {
+      uint32_t st = ld32(&h->spans[k].state);
+      if (st == kSpanEmpty) { slot = (int)k; break; }
+      if (pass && st == kSpanBroken) {  // gc a dead slot and take it
+        span_free_locked(s, k);
+        slot = (int)k;
+        break;
+      }
+    }
+  }
+  if (slot < 0) return -ENFILE;
+
+  SpanDesc* d = &h->spans[slot];
+  memcpy(d->id, id, kIdLen);
+  d->data_size = data_size;
+  d->meta_size = meta_size;
+  d->pin_count = 0;
+  d->flags = evictable ? 0 : 2;
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  d->ctime_sec = (uint64_t)ts.tv_sec;
+  // publish CLAIMING before touching any stripe: if we die mid-claim,
+  // the span-mutex repair frees exactly this slot's claimed stripes
+  st32(&d->state, kSpanClaiming);
+
+  // Two passes over the candidate windows: first claim only windows
+  // whose residents LRU-evict cleanly; pinned/unsealed residents fail
+  // the window and the claim slides on. (The caller's spill+retry is
+  // the pressure valve when every window is blocked.)
+  for (uint32_t start = 0; start + m <= h->num_stripes; ++start) {
+    uint32_t claimed = 0;
+    for (; claimed < m; ++claimed) {
+      uint32_t si = start + claimed;
+      StripeGuard sg(s, si);
+      Stripe* sp = &h->stripes[si];
+      if (sp->span_owner && !reclaim_dead_span_stripe_locked(s, si)) break;
+      if (sp->bytes_in_use) evict_stripe_locked(s, si, sp->arena_size);
+      if (sp->bytes_in_use || sp->num_objects) break;
+      sp->span_owner = (uint32_t)slot + 1;
+      // claimed stripes read as fully used: stats, spill-pressure
+      // probes and sweep targeting all see the span's footprint
+      sp->bytes_in_use = sp->arena_size;
+      // chaos hook: die HERE — span mutex + this stripe's mutex held,
+      // descriptor CLAIMING, stripe marked but span unpublished
+      chaos_maybe_crash_in_span_create();
+    }
+    if (claimed == m) {
+      d->first_stripe = start;
+      d->n_stripes = m;
+      d->seq = ++h->span_clock;
+      st32(&d->state, kSpanCreated);  // release: publishes the span
+      add64(&h->span_creates, 1, __ATOMIC_RELAXED);
+      return (int64_t)h->stripes[start].arena_off;
+    }
+    // window failed: unwind this window's claims
+    for (uint32_t u = 0; u < claimed; ++u) {
+      StripeGuard sg(s, start + u);
+      Stripe* sp = &h->stripes[start + u];
+      if (sp->span_owner == (uint32_t)slot + 1) {
+        sp->span_owner = 0;
+        reset_stripe_heap_locked(s, sp);
+      }
+    }
+  }
+  memset(d->id, 0, kIdLen);
+  st32(&d->state, kSpanEmpty);
+  return -ENOMEM;
 }
 
 // ------------------------------------------------------------ copy pool
@@ -756,6 +1098,8 @@ void* rt_store_create(const char* path, uint64_t size, int stripes) {
   pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
   pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
 
+  pthread_mutex_init(&h->span_mutex, &attr);
+
   uint64_t stripe_sz = (h->arena_size / h->num_stripes) & ~(kAlign - 1);
   uint32_t seg_len = kTableCapacity / h->num_stripes;
   for (uint32_t i = 0; i < h->num_stripes; ++i) {
@@ -843,6 +1187,13 @@ int64_t rt_create(void* hs, const uint8_t* id, uint64_t data_size,
   uint32_t nstripes = s->hdr->num_stripes;
   uint32_t home = stripe_of(s, h);
 
+  // size-aware route: an object no single stripe can hold takes the
+  // spanning path (contiguous whole stripes) — the Python client and
+  // every transfer path gain multi-GB objects with no API change
+  if (align_up(need + kBlockHeader, kAlign) > s->hdr->stripes[0].arena_size)
+    return span_create(s, id, data_size, meta_size, evictable);
+  if (span_find(s, id) >= 0) return -EEXIST;
+
   // duplicate check for re-homed objects: best-effort lock-free (exact
   // within the home stripe below; a concurrent same-id double-create is
   // caller misuse and at worst wastes one block until delete)
@@ -851,16 +1202,21 @@ int64_t rt_create(void* hs, const uint8_t* id, uint64_t data_size,
     return -EEXIST;
 
   int64_t soft_rc = -ENOMEM;
-  for (int pass = 0; pass < 2; ++pass) {       // pass 0: no evict; 1: evict
+  // pass 0: no evict; 1: per-stripe LRU evict; 2: only reached when
+  // whole-span eviction freed stripes back to the normal allocator
+  for (int pass = 0; pass < 3; ++pass) {
+    if (pass == 2 && span_evict_bytes(s, need) == 0) break;
     for (uint32_t k = 0; k < nstripes; ++k) {
       uint32_t si = (home + k) % nstripes;
       Stripe* sp = &s->hdr->stripes[si];
       StripeGuard g(s, si);
+      if (sp->span_owner && !reclaim_dead_span_stripe_locked(s, si))
+        continue;  // the stripe belongs to a live spanning object
       if (probe_segment(s, si, id, h) != kNil) return -EEXIST;
       uint32_t slot = segment_slot(s, si, h);
       if (slot == kNil) { soft_rc = -ENFILE; continue; }
       uint64_t off = heap_alloc(s, sp, need);
-      if (off == kNone && pass == 1) {
+      if (off == kNone && pass >= 1) {
         evict_stripe_locked(s, si, need);
         off = heap_alloc(s, sp, need);
       }
@@ -897,6 +1253,20 @@ int rt_seal(void* hs, const uint8_t* id) {
   uint64_t h = hash_id(id);
   uint32_t home = stripe_of(s, h);
   uint32_t idx = find_lockfree(s, id, h, home);
+  if (idx == kNil && span_find(s, id) >= 0) {
+    // spanning object: CREATED -> SEALED under the span mutex (the
+    // lock cost is nothing next to the multi-GB payload copy)
+    SpanGuard g(s);
+    int k = span_find(s, id);
+    if (k < 0) return -ENOENT;
+    SpanDesc* d = &s->hdr->spans[k];
+    d->seq = ++s->hdr->span_clock;
+    if (!cas32(&d->state, kSpanCreated, kSpanSealed)) {
+      uint32_t now = ld32(&d->state);
+      return (now == kSpanSealed) ? -EINVAL : -ENOENT;
+    }
+    return 0;
+  }
   if (idx == kNil) {
     // confirm the miss under the locks before failing
     uint32_t n = s->hdr->num_stripes;
@@ -941,6 +1311,21 @@ int64_t rt_get(void* hs, const uint8_t* id, uint64_t* data_size,
     sp->get_hits++;
     return (int64_t)(sp->arena_off + e->offset);
   });
+  if (rc < 0 && span_find(s, id) >= 0) {
+    SpanGuard g(s);
+    int k = span_find(s, id);
+    if (k >= 0 && ld32(&s->hdr->spans[k].state) == kSpanSealed) {
+      SpanDesc* d = &s->hdr->spans[k];
+      *data_size = d->data_size;
+      *meta_size = d->meta_size;
+      if (pin) d->pin_count++;
+      d->seq = ++s->hdr->span_clock;
+      // span hits attribute to the head stripe (atomic: no stripe lock)
+      add64(&s->hdr->stripes[d->first_stripe].get_hits, 1,
+            __ATOMIC_RELAXED);
+      return (int64_t)s->hdr->stripes[d->first_stripe].arena_off;
+    }
+  }
   if (rc < 0) {
     uint32_t home = stripe_of(s, hash_id(id));
     add64(&s->hdr->stripes[home].get_misses, 1, __ATOMIC_RELAXED);
@@ -950,7 +1335,7 @@ int64_t rt_get(void* hs, const uint8_t* id, uint64_t* data_size,
 
 int rt_release(void* hs, const uint8_t* id) {
   Store* s = static_cast<Store*>(hs);
-  return (int)with_entry_locked(s, id, [&](uint32_t si, uint32_t idx) {
+  int rc = (int)with_entry_locked(s, id, [&](uint32_t si, uint32_t idx) {
     Entry* e = &s->table[idx];
     uint32_t st = ld32(&e->state);
     uint32_t pins = ld32(&e->pin_count, __ATOMIC_RELAXED);
@@ -958,10 +1343,25 @@ int rt_release(void* hs, const uint8_t* id) {
     if ((e->flags & 1) && pins <= 1) entry_free_from(s, si, idx, st);
     return (int64_t)0;
   });
+  if (rc == -ENOENT && span_find(s, id) >= 0) {
+    SpanGuard g(s);
+    int k = span_find(s, id);
+    if (k < 0) return -ENOENT;
+    SpanDesc* d = &s->hdr->spans[k];
+    if (d->pin_count > 0) d->pin_count--;
+    if ((d->flags & 1) && d->pin_count == 0) span_free_locked(s, (uint32_t)k);
+    return 0;
+  }
+  return rc;
 }
 
 int rt_contains(void* hs, const uint8_t* id) {
   Store* s = static_cast<Store*>(hs);
+  {
+    int k = span_find(s, id);
+    if (k >= 0)
+      return ld32(&s->hdr->spans[k].state) == kSpanSealed ? 1 : 0;
+  }
   uint64_t h = hash_id(id);
   uint32_t home = stripe_of(s, h);
   uint32_t idx = find_lockfree(s, id, h, home);
@@ -985,6 +1385,18 @@ int rt_contains(void* hs, const uint8_t* id) {
 // Delete (deferred if pinned). -ENOENT if absent.
 int rt_delete(void* hs, const uint8_t* id) {
   Store* s = static_cast<Store*>(hs);
+  if (span_find(s, id) >= 0) {
+    SpanGuard g(s);
+    int k = span_find(s, id);
+    if (k >= 0) {
+      SpanDesc* d = &s->hdr->spans[k];
+      if (d->pin_count > 0)
+        d->flags |= 1;  // delete-pending; release completes it
+      else
+        span_free_locked(s, (uint32_t)k);
+      return 0;
+    }
+  }
   return (int)with_entry_locked(s, id, [&](uint32_t si, uint32_t idx) {
     Entry* e = &s->table[idx];
     uint32_t st = ld32(&e->state);
@@ -1002,6 +1414,15 @@ int rt_delete(void* hs, const uint8_t* id) {
 // Abort an in-progress creation (writer failed before seal).
 int rt_abort(void* hs, const uint8_t* id) {
   Store* s = static_cast<Store*>(hs);
+  if (span_find(s, id) >= 0) {
+    SpanGuard g(s);
+    int k = span_find(s, id);
+    if (k >= 0) {
+      if (ld32(&s->hdr->spans[k].state) != kSpanCreated) return -EINVAL;
+      span_free_locked(s, (uint32_t)k);
+      return 0;
+    }
+  }
   return (int)with_entry_locked(s, id, [&](uint32_t si, uint32_t idx) {
     if (ld32(&s->table[idx].state) != kCreated) return (int64_t)-EINVAL;
     return entry_free_from(s, si, idx, kCreated) ? (int64_t)0
@@ -1029,6 +1450,22 @@ uint64_t rt_gc_unsealed(void* hs, uint64_t max_age_sec) {
         ++n;
     }
   }
+  {
+    // span pass: broken spans reclaim unconditionally (deterministic
+    // cleanup after a crash repair marked them); CREATED-but-unsealed
+    // spans age out exactly like entries. kSpanClaiming slots can only
+    // belong to a dead writer once we hold the span mutex — free them.
+    SpanGuard g(s);
+    for (uint32_t k = 0; k < kMaxSpans; ++k) {
+      SpanDesc* d = &s->hdr->spans[k];
+      uint32_t st = ld32(&d->state);
+      if (st == kSpanBroken || st == kSpanClaiming ||
+          (st == kSpanCreated && now - d->ctime_sec >= max_age_sec)) {
+        span_free_locked(s, k);
+        ++n;
+      }
+    }
+  }
   return n;
 }
 
@@ -1047,17 +1484,20 @@ uint64_t rt_evict(void* hs, uint64_t bytes) {
     StripeGuard g(s, si);
     freed += evict_stripe_locked(s, si, bytes - freed);
   }
+  if (freed < bytes)
+    freed += span_evict_bytes(s, bytes - freed);  // whole spans, never half
   return freed;
 }
 
 // Aggregate store stats, served lock-free from per-stripe seqlock
 // snapshots — a stats poll never queues behind a client's create.
-// out[13]: bytes_in_use, capacity, num_objects, num_evictions,
+// out[17]: bytes_in_use, capacity, num_objects, num_evictions,
 // bytes_evicted, create_count, get_hits, get_misses, poisoned,
-// num_stripes, stripe_repairs, create_fallbacks, seal_count.
+// num_stripes, stripe_repairs, create_fallbacks, seal_count,
+// num_spans, span_creates, span_evictions, span_repairs.
 void rt_stats(void* hs, uint64_t* out) {
   Store* s = static_cast<Store*>(hs);
-  memset(out, 0, 13 * sizeof(uint64_t));
+  memset(out, 0, 17 * sizeof(uint64_t));
   for (uint32_t si = 0; si < s->hdr->num_stripes; ++si) {
     StripeSnap sn;
     snapshot_stripe(s, si, &sn);
@@ -1075,6 +1515,16 @@ void rt_stats(void* hs, uint64_t* out) {
   }
   out[9] = s->hdr->num_stripes;
   out[11] = ld64(&s->hdr->fallback_count, __ATOMIC_RELAXED);
+  for (uint32_t k = 0; k < kMaxSpans; ++k) {
+    uint32_t st = ld32(&s->hdr->spans[k].state);
+    if (st == kSpanCreated || st == kSpanSealed) {
+      out[13]++;   // live spans
+      out[2]++;    // a span is a live object too
+    }
+  }
+  out[14] = ld64(&s->hdr->span_creates, __ATOMIC_RELAXED);
+  out[15] = ld64(&s->hdr->span_evictions, __ATOMIC_RELAXED);
+  out[16] = ld64(&s->hdr->span_repairs, __ATOMIC_RELAXED);
 }
 
 // Per-stripe stats (lock-free snapshot) for sweep targeting and bench
@@ -1116,13 +1566,73 @@ uint64_t rt_list_stripe(void* hs, uint32_t stripe, uint8_t* out,
 }
 
 // List up to max_n sealed object ids into out (max_n * kIdLen bytes).
-// Locks stripes one at a time — never the whole store.
+// Locks stripes one at a time — never the whole store. Sealed spanning
+// objects are appended after the per-stripe listings.
 uint64_t rt_list(void* hs, uint8_t* out, uint64_t max_n) {
   Store* s = static_cast<Store*>(hs);
   uint64_t n = 0;
   for (uint32_t si = 0; si < s->hdr->num_stripes && n < max_n; ++si)
     n += rt_list_stripe(hs, si, out + n * kIdLen, max_n - n);
+  for (uint32_t k = 0; k < kMaxSpans && n < max_n; ++k) {
+    SpanDesc* d = &s->hdr->spans[k];
+    if (ld32(&d->state) == kSpanSealed) {
+      memcpy(out + n * kIdLen, d->id, kIdLen);
+      ++n;
+    }
+  }
   return n;
+}
+
+// ------------------------------------------------- spanning-object ABI
+
+// Largest payload (data+meta) the per-stripe allocator can hold; one
+// byte more routes to the spanning path. Lets clients and benches pick
+// sizes that deterministically exercise either side.
+uint64_t rt_max_alloc_bytes(void* hs) {
+  Store* s = static_cast<Store*>(hs);
+  uint64_t sz = s->hdr->stripes[0].arena_size;
+  return (sz & ~(kAlign - 1)) - kBlockHeader;
+}
+
+// Force the spanning path regardless of size (tests exercise span
+// machinery without multi-GB arenas). Same contract as rt_create.
+int64_t rt_create_spanning(void* hs, const uint8_t* id, uint64_t data_size,
+                           uint64_t meta_size, int evictable) {
+  Store* s = static_cast<Store*>(hs);
+  if (data_size + meta_size == 0) return -EINVAL;
+  if (rt_contains(hs, id)) return -EEXIST;
+  return span_create(s, id, data_size, meta_size, evictable);
+}
+
+// 1 when id names a live spanning object (created or sealed).
+int rt_is_span(void* hs, const uint8_t* id) {
+  return span_find(static_cast<Store*>(hs), id) >= 0 ? 1 : 0;
+}
+
+// Span-plane snapshot (lock-free reads; counters are advisory).
+// out[8]: live_spans, span_bytes (data+meta of live spans),
+// stripes_claimed, span_creates, span_evictions, span_repairs,
+// broken_slots, max_span_bytes (whole-arena ceiling for one object).
+void rt_span_stats(void* hs, uint64_t* out) {
+  Store* s = static_cast<Store*>(hs);
+  Header* h = s->hdr;
+  memset(out, 0, 8 * sizeof(uint64_t));
+  for (uint32_t k = 0; k < kMaxSpans; ++k) {
+    SpanDesc* d = &h->spans[k];
+    uint32_t st = ld32(&d->state);
+    if (st == kSpanCreated || st == kSpanSealed) {
+      out[0]++;
+      out[1] += d->data_size + d->meta_size;
+    } else if (st == kSpanBroken) {
+      out[6]++;
+    }
+  }
+  for (uint32_t si = 0; si < h->num_stripes; ++si)
+    if (ld32(&h->stripes[si].span_owner, __ATOMIC_RELAXED)) out[2]++;
+  out[3] = ld64(&h->span_creates, __ATOMIC_RELAXED);
+  out[4] = ld64(&h->span_evictions, __ATOMIC_RELAXED);
+  out[5] = ld64(&h->span_repairs, __ATOMIC_RELAXED);
+  out[7] = (uint64_t)h->num_stripes * h->stripes[0].arena_size;
 }
 
 }  // extern "C"

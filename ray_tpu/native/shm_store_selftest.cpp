@@ -49,6 +49,12 @@ void rt_stripe_stats(void* hs, uint32_t stripe, uint64_t* out);
 uint64_t rt_list_stripe(void* hs, uint32_t stripe, uint8_t* out,
                         uint64_t max_n);
 void rt_write_parallel(void* dst, const void* src, uint64_t n, int threads);
+uint64_t rt_gc_unsealed(void* hs, uint64_t max_age_sec);
+uint64_t rt_max_alloc_bytes(void* hs);
+int64_t rt_create_spanning(void* hs, const uint8_t* id, uint64_t data_size,
+                           uint64_t meta_size, int evictable);
+int rt_is_span(void* hs, const uint8_t* id);
+void rt_span_stats(void* hs, uint64_t* out);
 }
 
 static constexpr int kIdLen = 20;
@@ -86,10 +92,25 @@ static int crash_child(const char* path) {
   return 8;  // survived 1000 creates: the chaos hook never fired
 }
 
+// Span crash-child mode: attempt a spanning create with the
+// shm_span_create chaos hook armed — dies holding the span mutex AND a
+// member stripe's mutex, mid-claim. Parent repairs via EOWNERDEAD on
+// both levels.
+static int span_crash_child(const char* path) {
+  void* h = rt_store_open(path);
+  if (!h) return 7;
+  uint8_t id[kIdLen];
+  make_id(id, 950001);
+  rt_create_spanning(h, id, 6 << 20, 0, 1);
+  return 8;  // survived: the chaos hook never fired
+}
+
 int main(int argc, char** argv) {
   std::string path = argc > 1 ? argv[1] : "/dev/shm/rt_selftest";
   if (argc > 2 && strcmp(argv[2], "crashchild") == 0)
     return crash_child(path.c_str());
+  if (argc > 2 && strcmp(argv[2], "spancrashchild") == 0)
+    return span_crash_child(path.c_str());
 
   const uint64_t kArena = 4 << 20;  // 4 MiB
   void* s = rt_store_create(path.c_str(), kArena, 1);  // v1 regime
@@ -138,7 +159,7 @@ int main(int argc, char** argv) {
     memset(base + o, (int)(n & 0xff), 64 << 10);
     CHECK(rt_seal(s, eid) == 0);
   }
-  uint64_t st[13];
+  uint64_t st[17];
   rt_stats(s, st);
   CHECK(st[3] > 0);       // evictions happened
   CHECK(st[8] == 0);      // not poisoned
@@ -294,7 +315,7 @@ int main(int argc, char** argv) {
     auto poller = [&] {
       void* h = rt_store_open(mpath.c_str());
       if (!h) { mfail++; return; }
-      uint64_t pst[13];
+      uint64_t pst[17];
       uint64_t polls = 0;
       while (!stop.load()) {
         rt_stats(h, pst);
@@ -330,7 +351,7 @@ int main(int argc, char** argv) {
       CHECK(rt_seal(ms, bid) == 0);
       CHECK(rt_get(ms, bid, &dsz, &msz, 1) > 0);  // hold the pin
     }
-    uint64_t fst[13];
+    uint64_t fst[17];
     rt_stats(ms, fst);
     CHECK(fst[11] >= 1);   // create_fallbacks
     CHECK(fst[8] == 0);
@@ -372,7 +393,7 @@ int main(int argc, char** argv) {
       int64_t g = rt_get(ms, rid, &dsz, &msz, 0);
       CHECK(g > 0 && mb[g] == 0x77);
     }
-    uint64_t rst[13];
+    uint64_t rst[17];
     rt_stats(ms, rst);
     CHECK(rst[10] >= 1);   // the poisoned stripe was repaired
     CHECK(rst[8] == 0);    // and is healthy again
@@ -384,9 +405,184 @@ int main(int argc, char** argv) {
     std::vector<uint8_t> ids(4096 * kIdLen);
     for (uint32_t i = 0; i < rt_num_stripes(ms); i++)
       total += rt_list_stripe(ms, i, ids.data(), 4096);
-    uint64_t lst[13];
+    uint64_t lst[17];
     rt_stats(ms, lst);
     CHECK(total <= lst[2]);  // sealed <= all live objects
+  }
+
+  // ===================== spanning-object sections ========================
+  // 4 MiB stripes: a 6 MiB object cannot exist in any one stripe, so
+  // rt_create must route it to the spanning path transparently.
+  {
+    uint8_t* mb = rt_store_base(ms);
+    const uint64_t kSpanSz = 6 << 20;
+    CHECK(rt_max_alloc_bytes(ms) < kSpanSz);
+    uint8_t sid[kIdLen];
+    make_id(sid, 400001);
+    int64_t so = rt_create(ms, sid, kSpanSz, 32, 1);
+    CHECK(so > 0);
+    CHECK(rt_is_span(ms, sid) == 1);
+    // fill data+meta across the stripe boundary with a position pattern
+    for (uint64_t i = 0; i < kSpanSz + 32; i += 4096)
+      mb[so + i] = (uint8_t)(i >> 12);
+    mb[so + kSpanSz + 31] = 0xEE;
+    CHECK(rt_seal(ms, sid) == 0);
+    CHECK(rt_contains(ms, sid) == 1);
+    uint64_t sd = 0, sm = 0;
+    int64_t sg = rt_get(ms, sid, &sd, &sm, 1);  // pin
+    CHECK(sg == so && sd == kSpanSz && sm == 32);
+    for (uint64_t i = 0; i < kSpanSz; i += 4096)
+      CHECK(mb[sg + i] == (uint8_t)(i >> 12));
+    CHECK(mb[sg + kSpanSz + 31] == 0xEE);
+
+    uint64_t sps[8];
+    rt_span_stats(ms, sps);
+    CHECK(sps[0] == 1);                 // one live span
+    CHECK(sps[1] == kSpanSz + 32);
+    CHECK(sps[2] == 2);                 // 6 MiB claims two 4 MiB stripes
+    uint64_t ast[17];
+    rt_stats(ms, ast);
+    CHECK(ast[13] == 1);                // surfaced in aggregate stats
+
+    // --- LRU pressure never half-frees a pinned span -------------------
+    // hammer normal puts well past remaining capacity: creates re-home
+    // and evict around the span; the span's bytes stay intact
+    for (uint64_t n = 0; n < 64; n++) {
+      uint8_t pid[kIdLen];
+      make_id(pid, 410000 + n);
+      int64_t o = rt_create(ms, pid, 1 << 20, 0, 1);
+      if (o <= 0) continue;
+      memset(mb + o, 0x33, 1 << 20);
+      CHECK(rt_seal(ms, pid) == 0);
+    }
+    rt_span_stats(ms, sps);
+    CHECK(sps[0] == 1 && sps[2] == 2);  // still whole
+    for (uint64_t i = 0; i < kSpanSz; i += 4096)
+      CHECK(mb[sg + i] == (uint8_t)(i >> 12));
+
+    // --- delete-pending while pinned, then whole-span reclaim ----------
+    CHECK(rt_delete(ms, sid) == 0);     // pinned: deferred
+    CHECK(rt_contains(ms, sid) == 1 || rt_is_span(ms, sid) == 1);
+    CHECK(rt_release(ms, sid) == 0);    // completes the delete
+    CHECK(rt_contains(ms, sid) == 0);
+    rt_span_stats(ms, sps);
+    CHECK(sps[0] == 0 && sps[2] == 0);  // every member stripe returned
+
+    // reclaimed stripes serve normal creates again
+    for (uint64_t n = 0; n < 16; n++) {
+      uint8_t pid[kIdLen];
+      make_id(pid, 420000 + n);
+      int64_t o = rt_create(ms, pid, 1 << 20, 0, 1);
+      CHECK(o > 0);
+      CHECK(rt_seal(ms, pid) == 0);
+    }
+  }
+
+  // --- explicit span path + eviction under whole-arena pressure ---------
+  {
+    uint8_t sid[kIdLen];
+    make_id(sid, 400002);
+    // force the span path for a small object (claims one whole stripe)
+    int64_t so = rt_create_spanning(ms, sid, 64 << 10, 0, 1);
+    CHECK(so > 0);
+    CHECK(rt_is_span(ms, sid) == 1);
+    memset(rt_store_base(ms) + so, 0x44, 64 << 10);
+    CHECK(rt_seal(ms, sid) == 0);
+    // rt_evict reclaims the unpinned span atomically when stripes alone
+    // can't satisfy the request
+    uint64_t freed = rt_evict(ms, (uint64_t)16 << 20);
+    CHECK(freed > 0);
+    CHECK(rt_contains(ms, sid) == 0);
+    uint64_t sps[8];
+    rt_span_stats(ms, sps);
+    CHECK(sps[0] == 0 && sps[2] == 0);
+    CHECK(sps[4] >= 1);                 // span_evictions counted
+  }
+
+  // --- EOWNERDEAD repair with a RESIDENT span ----------------------------
+  // a client SIGKILLed mid-rt_create holds a NORMAL stripe's mutex
+  // (creates skip span-owned stripes), so the resident span must survive
+  // the poisoned stripe's repair untouched.
+  {
+    uint8_t* mb = rt_store_base(ms);
+    uint8_t sid[kIdLen];
+    make_id(sid, 400003);
+    int64_t so = rt_create(ms, sid, 6 << 20, 0, 1);
+    CHECK(so > 0);
+    for (uint64_t i = 0; i < (6ULL << 20); i += 4096)
+      mb[so + i] = (uint8_t)(0x50 + (i >> 20));
+    CHECK(rt_seal(ms, sid) == 0);
+    uint64_t sd = 0, sm = 0;
+    CHECK(rt_get(ms, sid, &sd, &sm, 1) == so);  // hold a pin through it
+
+    pid_t pid = fork();
+    if (pid == 0) {
+      setenv("RAY_TPU_TESTING_SHM_FAILURE", "shm_create=3", 1);
+      execl(argv[0], argv[0], mpath.c_str(), "crashchild", (char*)nullptr);
+      _exit(9);
+    }
+    CHECK(pid > 0);
+    int wstatus = 0;
+    CHECK(waitpid(pid, &wstatus, 0) == pid);
+    CHECK(WIFSIGNALED(wstatus) && WTERMSIG(wstatus) == SIGKILL);
+
+    // survivors trigger the repair; the span is untouched
+    for (uint64_t n = 0; n < 64; n++) {
+      uint8_t rid[kIdLen];
+      make_id(rid, 430000 + n);
+      int64_t o = rt_create(ms, rid, 4096, 0, 1);
+      if (o > 0) {
+        memset(mb + o, 0x77, 4096);
+        CHECK(rt_seal(ms, rid) == 0);
+      }
+    }
+    CHECK(rt_contains(ms, sid) == 1);
+    for (uint64_t i = 0; i < (6ULL << 20); i += 4096)
+      CHECK(mb[so + i] == (uint8_t)(0x50 + (i >> 20)));
+    CHECK(rt_release(ms, sid) == 0);
+    CHECK(rt_delete(ms, sid) == 0);
+  }
+
+  // --- crash mid-SPAN-create: two-level EOWNERDEAD repair ---------------
+  // the child dies inside span_create holding the span mutex and a
+  // member stripe's mutex; survivors must free/invalidate the WHOLE
+  // half-claimed span deterministically and keep both planes serving.
+  {
+    pid_t pid = fork();
+    if (pid == 0) {
+      setenv("RAY_TPU_TESTING_SHM_FAILURE", "shm_span_create=1", 1);
+      execl(argv[0], argv[0], mpath.c_str(), "spancrashchild",
+            (char*)nullptr);
+      _exit(9);
+    }
+    CHECK(pid > 0);
+    int wstatus = 0;
+    CHECK(waitpid(pid, &wstatus, 0) == pid);
+    CHECK(WIFSIGNALED(wstatus) && WTERMSIG(wstatus) == SIGKILL);
+
+    // gc sweep runs the span-mutex EOWNERDEAD repair path
+    rt_gc_unsealed(ms, 0);
+    uint64_t sps[8];
+    rt_span_stats(ms, sps);
+    CHECK(sps[0] == 0);                 // no live span leaked
+    CHECK(sps[2] == 0);                 // no stripe left claimed
+    CHECK(sps[6] == 0);                 // no broken slot left behind
+
+    // both planes keep serving: a fresh span and fresh normal puts
+    uint8_t* mb = rt_store_base(ms);
+    uint8_t sid[kIdLen];
+    make_id(sid, 400004);
+    int64_t so = rt_create(ms, sid, 6 << 20, 0, 1);
+    CHECK(so > 0);
+    memset(mb + so, 0x66, 6 << 20);
+    CHECK(rt_seal(ms, sid) == 0);
+    uint64_t sd = 0, sm = 0;
+    CHECK(rt_get(ms, sid, &sd, &sm, 0) == so && sd == (6ULL << 20));
+    CHECK(rt_delete(ms, sid) == 0);
+    uint64_t hst[17];
+    rt_stats(ms, hst);
+    CHECK(hst[8] == 0);                 // healthy
+    CHECK(hst[16] >= 1);                // span repair counted
   }
 
   rt_store_close(ms);

@@ -42,6 +42,25 @@ class FaultTolerantRunnerSet(list):
     def set_on_restart(self, fn: Callable[[Any], None]) -> None:
         self._on_restart = fn
 
+    def broadcast_weights(self, weights) -> Any:
+        """Put `weights` once and pre-position the sealed blob on EVERY
+        node through the weight-distribution plane
+        (``ray_tpu.broadcast_weights``: spanning arena allocation for
+        multi-GB params, log-depth binomial relay fan-out over the
+        striped data plane) — so N runners' ``set_weights`` resolve
+        their arg from the local arena instead of N point-to-point
+        pulls off the learner's node. Returns the ObjectRef to pass to
+        ``foreach("set_weights", ref)``. Falls back to a plain put when
+        the broadcast plane is unavailable (client mode, degraded
+        cluster) — runners then pull point-to-point as before."""
+        import ray_tpu
+        try:
+            return ray_tpu.broadcast_weights(weights)
+        except Exception:
+            logger.warning("weight broadcast unavailable; falling back "
+                           "to point-to-point weight pulls", exc_info=True)
+            return ray_tpu.put(weights)
+
     def replace(self, runner) -> Optional[Any]:
         """Runner observed dead: recreate it in its slot; returns the
         replacement. Returns None if the runner was ALREADY replaced (a

@@ -57,10 +57,13 @@ class Algorithm:
             restart_enabled=config.restart_failed_env_runners)
         self._build_learner(cfg_dict, obs_dim, action_dim)
         # restarted runners immediately receive the CURRENT weights (a
-        # fresh actor would otherwise sample one round at init weights)
+        # fresh actor would otherwise sample one round at init weights);
+        # the re-push rides the broadcast plane — the blob is already on
+        # the restart node's arena, so set_weights resolves locally
         self.env_runners.set_on_restart(
             lambda r: ray_tpu.get(
-                r.set_weights.remote(ray_tpu.put(self.get_weights())),
+                r.set_weights.remote(
+                    self.env_runners.broadcast_weights(self.get_weights())),
                 timeout=300))
         self.iteration = 0
         self._sync_weights()
@@ -70,8 +73,10 @@ class Algorithm:
         self.learner_group = LearnerGroup(cfg_dict, obs_dim, action_dim)
 
     def _sync_weights(self):
-        import ray_tpu
-        weights_ref = ray_tpu.put(self.learner_group.get_weights())
+        # one broadcast instead of num_env_runners point-to-point pulls:
+        # every runner's set_weights arg is already in its node's arena
+        weights_ref = self.env_runners.broadcast_weights(
+            self.learner_group.get_weights())
         self.env_runners.foreach("set_weights", weights_ref, timeout=300)
 
     def training_step(self) -> Dict:

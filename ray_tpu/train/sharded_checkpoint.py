@@ -47,3 +47,60 @@ def abstract_like(state: Any, shardings: Optional[Any] = None) -> Any:
     if shardings is None:
         return jax.tree.map(lambda x: mk(x, x.sharding), state)
     return jax.tree.map(mk, state, shardings)
+
+
+# ------------------------------------------------- broadcast-backed restore
+# Cold-start/elastic-restart shape: ONE host reads the checkpoint off
+# storage, then the weight-distribution plane fans the host-memory tree
+# out to every node as a single sealed (spanning, if multi-GB) arena
+# object over the log-depth relay tree — N-1 hosts hit their local arena
+# instead of N hosts hammering the checkpoint bucket, and the restore
+# cost is one storage read + one broadcast regardless of fleet size.
+
+def restore_and_broadcast(path: str, abstract_state: Any = None,
+                          node_ids: Optional[Any] = None):
+    """Restore a checkpoint on THIS host and pre-position it cluster-wide
+    via ``ray_tpu.broadcast_weights``. Returns the ObjectRef every other
+    host passes to :func:`restore_from_broadcast`.
+
+    ``abstract_state=None`` restores raw (numpy) leaves — the right form
+    for broadcasting, since device placement happens per-host at attach
+    time anyway. With an abstract tree the restored (host-side) arrays
+    are broadcast as-is."""
+    import numpy as np
+
+    import ray_tpu
+    if abstract_state is None:
+        state = restore_host_arrays(path)
+    else:
+        state = restore_sharded(path, abstract_state)
+        # pull shards to host memory so the broadcast payload is plain
+        # buffers, not device handles
+        state = jax.tree.map(np.asarray, state)
+    return ray_tpu.broadcast_weights(state, node_ids=node_ids)
+
+
+def restore_host_arrays(path: str) -> Any:
+    """Read a checkpoint into host (numpy) arrays with no sharding
+    placement — the broadcastable form of the state."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(path)
+
+
+def restore_from_broadcast(ref, abstract_state: Any = None) -> Any:
+    """Materialize a broadcast checkpoint on this host: a zero-copy get
+    from the local arena (the broadcast already landed the bytes here),
+    then optional placement onto this host's shardings."""
+    import ray_tpu
+    state = ray_tpu.get(ref)
+    if abstract_state is None:
+        return state
+
+    def place(x, ab):
+        sh = getattr(ab, "sharding", None)
+        if sh is None:
+            return jax.numpy.asarray(x, dtype=ab.dtype)
+        return jax.device_put(jax.numpy.asarray(x, dtype=ab.dtype), sh)
+    return jax.tree.map(place, state, abstract_state)

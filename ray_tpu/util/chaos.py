@@ -114,6 +114,54 @@ class ShmCreateKiller:
                 "from the shm_create injection)")
 
 
+class ShmSpanCreateKiller(ShmCreateKiller):
+    """Arms a (child) process to SIGKILL itself mid-SPANNING-create —
+    inside the native span claim loop, while it holds BOTH the arena's
+    span mutex and a member stripe's mutex, with the descriptor still
+    CLAIMING and at least one stripe already marked span-owned. The
+    worst-case death of the weight-distribution plane: survivors must
+    repair on two levels (stripe EOWNERDEAD marks the span broken; span
+    EOWNERDEAD frees every claimed member stripe) and the half-claimed
+    span must be freed or invalidated WHOLE — never half.
+
+    Spec: ``RAY_TPU_TESTING_SHM_FAILURE="shm_span_create=N"`` (the Nth
+    spanning create of the armed process dies). Same ``env()`` /
+    ``assert_killed`` usage as :class:`ShmCreateKiller`."""
+
+    def spec(self) -> str:
+        return f"shm_span_create={self.nth_create}"
+
+
+class BroadcastRelayKiller:
+    """Injects relay-node failure into tree broadcasts: every
+    ``h_request_push`` that carries a non-empty relay list (i.e. an
+    interior node of the binomial broadcast tree) fails with the given
+    probability, so the root's await observes a dead subtree and must
+    retry through the surviving holders. Leaf pushes (empty relay) are
+    untouched — exactly the partial-delivery shape a mid-broadcast relay
+    death leaves behind.
+
+    Spec: ``RAY_TPU_TESTING_RPC_FAILURE="relay_push=p"``; the env must
+    be set BEFORE the daemon tree spawns (the spec is parsed once per
+    process)."""
+
+    SPEC_ENV = "RAY_TPU_TESTING_RPC_FAILURE"
+
+    def __init__(self, probability: float = 1.0):
+        if not 0.0 < probability <= 1.0:
+            raise ValueError("probability must be in (0, 1]")
+        self.probability = probability
+
+    def spec(self) -> str:
+        return f"relay_push={self.probability}"
+
+    def env(self, base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+        e = dict(base if base is not None else os.environ)
+        prior = e.get(self.SPEC_ENV)
+        e[self.SPEC_ENV] = f"{prior},{self.spec()}" if prior else self.spec()
+        return e
+
+
 class ServeReplicaKiller:
     """Kill serve replica actors mid-request (streaming included) and
     let the controller's reconcile loop replace them — the serving

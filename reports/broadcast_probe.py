@@ -1,0 +1,138 @@
+"""Weight-broadcast bandwidth probe (bench.py subprocess).
+
+Measures `ray_tpu.broadcast_weights()` delivering one weight-sized blob
+from a head-node put to every other node of a fresh local cluster via
+the binomial relay tree over the striped data plane, against the
+SEQUENTIAL point-to-point baseline (one `broadcast_object(ref, [node])`
+per target, awaited in turn — the shape of the old per-runner weight
+push). The ratio is the bench entry's `vs_p2p` ratchet.
+
+Reported rates are aggregate delivery bandwidth (payload bytes * nodes
+reached / wall seconds until EVERY node holds the object); per-node
+arrival rates ride along from the `store.broadcast.arrival` runtime
+events each receiver records.
+
+Usage: python broadcast_probe.py --one '{"size_mb": 256, "n_nodes": 3,
+                                         "runs": 3}'
+Prints one line: RESULT {json}
+"""
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _arrival_rates(wm, oid_hex):
+    """Per-node recv GB/s from the receivers' arrival instants."""
+    try:
+        rows = wm.global_worker.gcs_call(
+            "list_task_events", kind="runtime_event", limit=20000)
+    except Exception:
+        return []
+    rates = []
+    for r in rows:
+        if r.get("name") == "store.broadcast.arrival" and \
+                (r.get("attrs") or {}).get("object_id") == oid_hex:
+            gbps = (r.get("attrs") or {}).get("gb_per_s")
+            if gbps:
+                rates.append(float(gbps))
+    return rates
+
+
+def run(spec):
+    size_mb = int(spec.get("size_mb", 256))
+    n_nodes = int(spec.get("n_nodes", 3))
+    runs = int(spec.get("runs", 3))
+    import numpy as np
+
+    import ray_tpu
+    import ray_tpu.experimental
+    import ray_tpu._private.worker as wm
+    from ray_tpu.cluster_utils import Cluster
+
+    nbytes = size_mb * 1024 * 1024
+    store = max(3 * nbytes, 256 * 1024 * 1024)
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1,
+                                      "object_store_memory": store})
+    targets = [cluster.add_node(num_cpus=1, object_store_memory=store)
+               for _ in range(n_nodes)]
+    ray_tpu.init(address=cluster.address)
+    info = {}
+    bc_rates, p2p_rates, per_node = [], [], []
+    try:
+        cluster.wait_for_nodes()
+        target_ids = [t.node_id for t in targets]
+        blob = np.ones(nbytes, dtype=np.uint8)
+        ref = ray_tpu.put(blob)
+        view = wm.global_worker.gcs_call("get_cluster_view")
+
+        def free_remote_copies():
+            for nid in target_ids:
+                wm.global_worker._run(
+                    wm.global_worker.core.node_conn.call(
+                        "free_remote_object", oid=ref.id, node_id=nid))
+            time.sleep(0.1)
+
+        def holders():
+            n = 0
+            for nid in target_ids:
+                r = wm.global_worker._run(wm.global_worker.core.pool.call(
+                    view[nid]["address"], "has_object", oid=ref.id))
+                n += bool((r or {}).get("in_store"))
+            return n
+
+        # --- relay-tree broadcast -------------------------------------
+        for rep in range(runs + 1):        # +1 warmup (connections)
+            t0 = time.perf_counter()
+            ray_tpu.broadcast_weights(ref, node_ids=target_ids)
+            dt = time.perf_counter() - t0
+            if holders() != len(target_ids):
+                raise RuntimeError("broadcast did not reach every node")
+            if rep:
+                bc_rates.append(nbytes * len(target_ids) / dt / 1e9)
+            free_remote_copies()
+        per_node = _arrival_rates(wm, ref.id.hex()[:16])
+
+        # --- sequential point-to-point baseline -----------------------
+        for rep in range(runs + 1):
+            t0 = time.perf_counter()
+            for nid in target_ids:
+                ray_tpu.experimental.broadcast_object(ref, [nid])
+            dt = time.perf_counter() - t0
+            if rep:
+                p2p_rates.append(nbytes * len(target_ids) / dt / 1e9)
+            free_remote_copies()
+
+        st = wm.global_worker.core.store.stats()
+        info["spanning_put"] = bool(st.get("num_spans"))
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+    if not bc_rates or not p2p_rates:
+        raise RuntimeError(
+            f"no samples (bcast={bc_rates}, p2p={p2p_rates})")
+    bc_rates.sort()
+    p2p_rates.sort()
+    bc_med = bc_rates[len(bc_rates) // 2]
+    p2p_med = p2p_rates[len(p2p_rates) // 2]
+    spread = (bc_rates[-1] - bc_rates[0]) / bc_med if bc_med else 0.0
+    return {"weight_broadcast_gb_per_s": round(bc_med, 3),
+            "p2p_gb_per_s": round(p2p_med, 3),
+            "vs_p2p": round(bc_med / p2p_med, 3) if p2p_med else 0.0,
+            "size_mb": size_mb, "n_nodes": n_nodes,
+            "spread": round(spread, 3),
+            "runs": [round(r, 3) for r in bc_rates],
+            "p2p_runs": [round(r, 3) for r in p2p_rates],
+            "per_node_arrival_gb_per_s": sorted(per_node),
+            **info}
+
+
+if __name__ == "__main__":
+    spec = json.loads(sys.argv[sys.argv.index("--one") + 1])
+    print("RESULT " + json.dumps(run(spec)), flush=True)

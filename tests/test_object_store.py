@@ -324,3 +324,163 @@ def test_kill_mid_create_repairs_stripe(striped_store):
         assert bytes(buf.data) == b"\x33" * 2048
         buf.close()
     assert s.stats()["poisoned"] == 0
+
+
+# ------------------------------------------------- spanning allocation
+# Objects larger than one stripe (64 MiB arena / 4 stripes = 16 MiB)
+# route to the spanning path: contiguous whole stripes, one descriptor,
+# whole-span eviction/repair. ISSUE 11 acceptance: put/get/pin/evict/
+# crash-repair above one stripe size.
+
+from ray_tpu.util.chaos import ShmSpanCreateKiller  # noqa: E402
+
+
+def test_spanning_put_get_roundtrip(striped_store):
+    s = striped_store
+    blob = bytes(range(256)) * (20 * 1024 * 1024 // 256)   # 20 MiB
+    assert len(blob) > s.max_alloc_bytes()
+    assert s.put_bytes(oid(80001), blob, metadata=b"span-meta")
+    assert s.is_span(oid(80001))
+    assert s.contains(oid(80001))
+    buf = s.get(oid(80001))
+    assert bytes(buf.data) == blob
+    assert buf.metadata == b"span-meta"
+    st = s.stats()
+    assert st["num_spans"] == 1
+    assert st["span_creates"] >= 1
+    sp = s.span_stats()
+    assert sp["live_spans"] == 1
+    assert sp["stripes_claimed"] == 2      # 20 MiB over 16 MiB stripes
+    assert sp["span_bytes"] == len(blob) + len(b"span-meta")
+    buf.close()
+    s.delete(oid(80001))
+    assert not s.contains(oid(80001))
+    assert s.span_stats()["stripes_claimed"] == 0   # whole span returned
+
+
+def test_spanning_zero_copy_numpy(striped_store):
+    s = striped_store
+    arr = np.arange(5 * 1024 * 1024, dtype=np.float32)     # 20 MiB
+    s.put_bytes(oid(80002), arr.tobytes())
+    buf = s.get(oid(80002))
+    out = np.frombuffer(buf.data, dtype=np.float32)
+    np.testing.assert_array_equal(out, arr)
+    buf.close()
+
+
+def test_spanning_pin_survives_lru_pressure(striped_store):
+    """LRU pressure never half-frees a span: normal creates evict
+    AROUND a pinned span; the span's bytes stay intact throughout."""
+    s = striped_store
+    blob = b"\x5a" * (20 * 1024 * 1024)
+    assert s.put_bytes(oid(80010), blob)
+    pin = s.get(oid(80010))
+    # hammer well past the remaining two stripes' capacity
+    for i in range(24):
+        s.put_bytes(oid(80100 + i), b"\x11" * (4 * 1024 * 1024))
+    assert s.contains(oid(80010))
+    sp = s.span_stats()
+    assert sp["live_spans"] == 1 and sp["stripes_claimed"] == 2
+    assert bytes(pin.data[:8]) == b"\x5a" * 8
+    assert bytes(pin.data[-8:]) == b"\x5a" * 8
+    pin.close()
+    s.delete(oid(80010))
+
+
+def test_spanning_eviction_is_atomic(striped_store):
+    """An unpinned sealed span is reclaimed WHOLE under pressure, and
+    its stripes rejoin the normal allocator."""
+    s = striped_store
+    assert s.put_bytes(oid(80020), b"\x66" * (20 * 1024 * 1024))
+    assert s.is_span(oid(80020))
+    freed = s.evict(64 * 1024 * 1024)
+    assert freed >= 20 * 1024 * 1024
+    assert not s.contains(oid(80020))
+    sp = s.span_stats()
+    assert sp["live_spans"] == 0 and sp["stripes_claimed"] == 0
+    assert sp["span_evictions"] >= 1
+    assert s.stats()["num_spans"] == 0
+    # reclaimed stripes serve normal puts again
+    for i in range(8):
+        assert s.put_bytes(oid(80200 + i), b"\x44" * (1024 * 1024))
+
+
+def test_create_spanning_forced_and_abort(striped_store):
+    """rt_create_spanning exercises span machinery with small objects;
+    abort of an unsealed span returns every claimed stripe."""
+    s = striped_store
+    bufs = s.create_spanning(oid(80030), 4096, 4)
+    assert bufs is not None
+    data, meta = bufs
+    data[:] = b"\x77" * 4096
+    meta[:] = b"mm.."
+    assert s.is_span(oid(80030))
+    assert not s.contains(oid(80030))       # unsealed: not visible
+    s.abort(oid(80030))
+    assert not s.is_span(oid(80030))
+    assert s.span_stats()["stripes_claimed"] == 0
+    # duplicate detection across planes: a sealed span blocks a normal
+    # create of the same id
+    assert s.create_spanning(oid(80031), 1024) is not None
+    s.seal(oid(80031))
+    assert s.create(oid(80031), 64) is None
+    s.delete(oid(80031))
+
+
+def test_max_alloc_boundary_routes_exactly(striped_store):
+    s = striped_store
+    cap = s.max_alloc_bytes()
+    assert s.put_bytes(oid(80040), b"a" * cap)
+    assert not s.is_span(oid(80040))        # fits one stripe: normal path
+    s.delete(oid(80040))
+    assert s.put_bytes(oid(80041), b"b" * (cap + 1))
+    assert s.is_span(oid(80041))            # one byte over: spanning path
+    s.delete(oid(80041))
+
+
+def _chaos_span_loop(path, spec):
+    # arm BEFORE the first native create (spec parsed once per process)
+    os.environ[ShmSpanCreateKiller.SPEC_ENV] = spec
+    from ray_tpu._private.object_store import ObjectStoreClient as Client
+    c = Client(path)
+    try:
+        c.create_spanning((8_500_000).to_bytes(20, "big"),
+                          20 * 1024 * 1024, 0)
+    except Exception:
+        pass
+    os._exit(3)  # survived the spanning create: the injection never fired
+
+
+def test_kill_mid_spanning_create_repairs_whole_span(striped_store):
+    """ISSUE 11 chaos: a client SIGKILLed inside span_create — span
+    mutex + a member stripe's mutex held, descriptor CLAIMING — must
+    leave survivors able to free/invalidate the WHOLE half-claimed span
+    and keep both allocation planes serving."""
+    s = striped_store
+    for i in range(8):
+        assert s.put_bytes(oid(81000 + i), b"\x22" * 1024)
+    killer = ShmSpanCreateKiller(nth_create=1)
+    ctx = multiprocessing.get_context("spawn")
+    victim = ctx.Process(target=_chaos_span_loop,
+                         args=(s.path, killer.spec()))
+    victim.start()
+    killer.assert_killed(victim)
+    # the gc sweep runs both repair levels (EOWNERDEAD on span mutex +
+    # poisoned member stripe)
+    s.gc_unsealed(0)
+    sp = s.span_stats()
+    assert sp["live_spans"] == 0
+    assert sp["stripes_claimed"] == 0       # nothing half-claimed leaks
+    assert sp["broken_slots"] == 0
+    # both planes keep serving: a fresh span and fresh normal puts
+    assert s.put_bytes(oid(81100), b"\x88" * (20 * 1024 * 1024))
+    assert s.is_span(oid(81100))
+    buf = s.get(oid(81100))
+    assert bytes(buf.data[:4]) == b"\x88" * 4
+    buf.close()
+    s.delete(oid(81100))
+    for i in range(16):
+        assert s.put_bytes(oid(81200 + i), b"\x99" * 4096)
+    st = s.stats()
+    assert st["poisoned"] == 0
+    assert st["span_repairs"] >= 1
