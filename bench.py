@@ -639,10 +639,12 @@ def bench_weight_broadcast_gb_per_s():
 def bench_observability_overhead():
     """Observability cost guard (reports/trace_probe.py): put and
     decode-step throughput with the WHOLE plane enabled (span recorder
-    + metrics gauges + step profiler) vs all-off, plus the latency of a
-    windowed p95 query against a populated time-series ring. The
-    instrumentation only earns its keep if it is effectively free —
-    within_budget asserts < 5% on both paths."""
+    + metrics gauges + step profiler + object-lifetime ledger) vs
+    all-off, plus the latency of a windowed p95 query against a
+    populated time-series ring and of a `list_objects` join against a
+    populated 10k-object ledger. The instrumentation only earns its
+    keep if it is effectively free — within_budget asserts < 5% on
+    both paths."""
     import os
     here = os.path.dirname(os.path.abspath(__file__))
     runner = os.path.join(here, "reports", "trace_probe.py")
@@ -1170,6 +1172,12 @@ def main():
                     "value": rec["metrics_query_ms"], "unit": "ms",
                     "query": "p95 over 30s window, populated ring"}
                 log(f"metrics_query_ms: {rec['metrics_query_ms']}")
+            if rec.get("memory_query_ms") is not None:
+                results["memory_query_ms"] = {
+                    "value": rec["memory_query_ms"], "unit": "ms",
+                    "query": "p95 list_objects join vs populated "
+                             "10k-object ledger"}
+                log(f"memory_query_ms: {rec['memory_query_ms']}")
         else:
             results["observability_overhead"] = rec
             log(f"observability overhead probe skipped: "

@@ -224,6 +224,31 @@ _flag("metrics_ts_max_samples", int, 600,
 _flag("metrics_ts_max_series", int, 4096,
       "Total (metric, tags, worker) series the GCS time-series plane "
       "retains; new series past the cap are counted and dropped.")
+# Observability: object-lifetime ledger (GCS object_ledger table)
+_flag("ledger_enabled", bool, True,
+      "Maintain per-object provenance records (creator, owner, size, "
+      "placement, lifecycle timestamps, location set) in the GCS "
+      "object_ledger table. Workers record create/seal/free events; node "
+      "managers reconcile presence + pin counts at "
+      "ledger_report_interval_s. Off = `ray_tpu memory` falls back to "
+      "the local arena + owned-table view only.")
+_flag("ledger_leak_after_s", float, 30.0,
+      "A sealed, resident object with no pins whose owner exited (or "
+      "reports zero references) older than this is flagged as leaked by "
+      "the GCS ledger sweep (gauge store_leaked_bytes + store.leak "
+      "instants + an eviction hint to the holding node's sweep).")
+_flag("ledger_sweep_interval_s", float, 5.0,
+      "Period of the GCS leak-detector sweep over the object ledger "
+      "(0 disables the loop; the ledger_sweep handler still works).")
+_flag("ledger_report_interval_s", float, 5.0,
+      "Period of each node manager's arena census push into the object "
+      "ledger (presence, pin counts, stripe/span placement). The census "
+      "is the authority for an object's current location set — LRU "
+      "evictions emit no event and are reconciled here.")
+_flag("ledger_max_entries", int, 20000,
+      "Object-ledger table capacity in the GCS; past it, freed rows are "
+      "retired first, then the oldest rows (same bounded-ring discipline "
+      "as the task-event sink).")
 # NOTE: RPC chaos injection is configured through rpc.py's own
 # RAY_TPU_TESTING_RPC_FAILURE spec string ("method=prob"), not a flag here.
 

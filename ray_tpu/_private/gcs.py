@@ -80,6 +80,13 @@ class GcsServer:
         # rows) share this ring with task rows; sized so a burst of
         # engine-step spans can't evict the whole task timeline
         self.max_task_events = 20000
+        # object-lifetime ledger (ledger.py write side): one provenance
+        # row per object id, merged from worker event deltas and node-
+        # manager arena censuses. Bounded like the task-event ring —
+        # freed rows retire first, then the oldest.
+        self.object_ledger: Dict[str, Dict] = {}
+        self._ledger_exited: set = set()   # worker ids that died/exited
+        self._ledger_sweeper: Optional[asyncio.Task] = None
         # time-series plane over report_metrics pushes (metrics_ts.py):
         # bounded per-series rings answering windowed queries (rate /
         # percentiles) that the latest-snapshot table cannot
@@ -127,6 +134,10 @@ class GcsServer:
             "list_metric_series": self.h_list_metric_series,
             "dump_metric_series": self.h_dump_metric_series,
             "list_task_events": self.h_list_task_events,
+            "update_object_ledger": self.h_update_object_ledger,
+            "list_object_ledger": self.h_list_object_ledger,
+            "ledger_sweep": self.h_ledger_sweep,
+            "ledger_stats": self.h_ledger_stats,
             "ping": lambda conn: "pong",
         }
         self.server = rpc.Server(handlers, name="gcs")
@@ -152,6 +163,9 @@ class GcsServer:
                                 DEPENDENCIES_UNREADY):
                 asyncio.ensure_future(self._schedule_actor(aid, delay=1.0))
         self._death_checker = asyncio.ensure_future(self._check_node_deaths())
+        if cfg.ledger_sweep_interval_s > 0:
+            self._ledger_sweeper = asyncio.ensure_future(
+                self._ledger_sweep_loop())
         self._snapshot_task = None
         if self.persist_path:
             self._snapshot_task = asyncio.ensure_future(self._snapshot_loop())
@@ -277,6 +291,8 @@ class GcsServer:
     async def stop(self):
         if self._death_checker:
             self._death_checker.cancel()
+        if self._ledger_sweeper:
+            self._ledger_sweeper.cancel()
         if getattr(self, "_snapshot_task", None):
             self._snapshot_task.cancel()
             self._snapshot_task = None
@@ -691,6 +707,283 @@ class GcsServer:
                 break
         return out
 
+    # -------------------------------------------------------- object ledger
+    # Provenance table keyed by object id (reference: `ray memory` joins
+    # the plasma store view with per-worker reference tables; the state
+    # observability tables keep object rows in the GCS the same way).
+    # Writers: worker put/free event deltas (ledger.py ring) and node-
+    # manager arena censuses. The census is authoritative for the
+    # location set — LRU eviction and crash repair emit no event.
+    _LEDGER_ROW_DEFAULTS = {
+        "owner": None, "owner_worker": None, "creator_worker": None,
+        "creator_task": None, "size": 0, "meta_size": 0,
+        "is_span": False, "stripe": None,
+        "created_ts": None, "sealed_ts": None, "spilled_ts": None,
+        "restored_ts": None, "evicted_ts": None, "freed_ts": None,
+        "owner_refs": None, "leaked": False, "leak_ts": None,
+        "last_seq": 0, "dropped": 0,
+    }
+
+    def _ledger_row(self, oid: str) -> Dict:
+        led = self.object_ledger
+        row = led.get(oid)
+        if row is None:
+            if len(led) >= cfg.ledger_max_entries:
+                # retire a freed row if one sits near the front; else the
+                # oldest row goes (bounded-ring discipline, task-event
+                # sink style)
+                victim = None
+                for n, k in enumerate(led):
+                    if led[k].get("freed_ts") is not None:
+                        victim = k
+                        break
+                    if n >= 64:
+                        break
+                led.pop(victim if victim is not None else next(iter(led)))
+            row = led[oid] = {"object_id": oid, "locations": {},
+                              **self._LEDGER_ROW_DEFAULTS}
+        return row
+
+    def h_update_object_ledger(self, conn, records: Optional[List[Dict]] = None,
+                               census: Optional[Dict] = None,
+                               node_id: Optional[str] = None,
+                               worker_id: Optional[str] = None):
+        """Merge per-process lifecycle deltas and/or one node's arena
+        census into the object_ledger table. Records apply in seq order
+        per object (stale duplicates from a re-flushed batch are
+        idempotent); the census reconciles presence + pins for
+        `node_id`, including silent removals (LRU eviction)."""
+        for rec in records or ():
+            self._ledger_apply(rec, node_id, worker_id)
+        if census is not None and node_id:
+            self._ledger_census(census, node_id)
+        return True
+
+    def _ledger_apply(self, rec: Dict, node_id: Optional[str],
+                      worker_id: Optional[str]):
+        ev = rec.get("event")
+        ts = rec.get("ts")
+        if ts is None:     # 0.0 is a valid (test-pinned) timestamp
+            ts = time.time()
+        if ev == "worker_exit":
+            wid = rec.get("worker_id") or worker_id
+            if wid:
+                self._ledger_exited.add(wid)
+            return
+        oid = rec.get("object_id")
+        if not oid:
+            return
+        row = self._ledger_row(oid)
+        row["last_seq"] = max(row["last_seq"], int(rec.get("seq") or 0))
+        if rec.get("dropped"):
+            row["dropped"] += int(rec["dropped"])
+        node = rec.get("node_id") or node_id
+        if ev == "created":
+            row["size"] = int(rec.get("size") or row["size"])
+            row["meta_size"] = int(rec.get("meta_size") or row["meta_size"])
+            row["owner"] = rec.get("owner") or row["owner"]
+            row["owner_worker"] = (rec.get("owner_worker") or worker_id
+                                   or row["owner_worker"])
+            row["creator_worker"] = (rec.get("owner_worker") or worker_id
+                                     or row["creator_worker"])
+            row["creator_task"] = rec.get("task_id") or row["creator_task"]
+            if rec.get("is_span"):
+                row["is_span"] = True
+            row["created_ts"] = row["created_ts"] or ts
+            if rec.get("sealed"):
+                row["sealed_ts"] = row["sealed_ts"] or ts
+            if node:
+                row["locations"].setdefault(node, {"pins": 0, "since": ts})
+        elif ev == "sealed":
+            row["sealed_ts"] = row["sealed_ts"] or ts
+        elif ev == "location_add":
+            if node:
+                row["locations"].setdefault(node, {"pins": 0, "since": ts})
+        elif ev == "location_remove":
+            if node:
+                row["locations"].pop(node, None)
+        elif ev == "spilled":
+            row["spilled_ts"] = ts
+            if node:
+                row["locations"].pop(node, None)
+                row.setdefault("spilled_on", [])
+                if node not in row["spilled_on"]:
+                    row["spilled_on"].append(node)
+        elif ev == "restored":
+            row["restored_ts"] = ts
+            if node:
+                row["locations"].setdefault(node, {"pins": 0, "since": ts})
+                if node in row.get("spilled_on", ()):
+                    row["spilled_on"].remove(node)
+        elif ev == "evicted":
+            row["evicted_ts"] = ts
+            if node:
+                row["locations"].pop(node, None)
+        elif ev == "freed":
+            row["freed_ts"] = ts
+            row["leaked"] = False
+            if node:
+                row["locations"].pop(node, None)
+        elif ev == "refs":
+            row["owner_refs"] = rec.get("refs")
+
+    def _ledger_census(self, census: Dict, node_id: str):
+        now = time.time()
+        present = census.get("objects") or {}
+        for oid, info in present.items():
+            row = self._ledger_row(oid)
+            loc = row["locations"].setdefault(node_id, {"pins": 0,
+                                                        "since": now})
+            loc["pins"] = int(info.get("pins") or 0)
+            if not row["size"]:
+                row["size"] = int(info.get("size") or 0)
+            if info.get("is_span"):
+                row["is_span"] = True
+            if row.get("stripe") is None and info.get("stripe") is not None:
+                row["stripe"] = int(info["stripe"])
+            if row["sealed_ts"] is None and info.get("sealed", True):
+                # pre-ledger or foreign-writer object: census discovers
+                # it; age then counts from first sighting, not creation
+                row["sealed_ts"] = now - float(info.get("age_s") or 0.0)
+        for oid, row in self.object_ledger.items():
+            if node_id in row["locations"] and oid not in present:
+                row["locations"].pop(node_id, None)
+                if row["freed_ts"] is None and row["spilled_ts"] is None:
+                    # silent removal: LRU eviction / crash repair
+                    row["evicted_ts"] = now
+        spilled = census.get("spilled") or ()
+        for oid in spilled:
+            row = self.object_ledger.get(oid)
+            if row is not None:
+                row.setdefault("spilled_on", [])
+                if node_id not in row["spilled_on"]:
+                    row["spilled_on"].append(node_id)
+
+    def h_list_object_ledger(self, conn, limit: int = 1000,
+                             node_id: Optional[str] = None,
+                             leaked: Optional[bool] = None,
+                             live_only: bool = False):
+        """Dump provenance rows, newest-first. Filters: node_id (appears
+        in the row's location set or spilled_on), leaked=True (flagged
+        by the sweep), live_only (resident somewhere, not freed)."""
+        out = []
+        for row in reversed(list(self.object_ledger.values())):
+            if node_id is not None and node_id not in row["locations"] \
+                    and node_id not in row.get("spilled_on", ()):
+                continue
+            if leaked is not None and bool(row.get("leaked")) != leaked:
+                continue
+            if live_only and (row["freed_ts"] is not None
+                              or not row["locations"]):
+                continue
+            out.append(row)
+            if len(out) >= limit:
+                break
+        return out
+
+    def h_ledger_stats(self, conn):
+        leaked = [r for r in self.object_ledger.values() if r.get("leaked")]
+        return {"entries": len(self.object_ledger),
+                "exited_workers": len(self._ledger_exited),
+                "leaked_objects": len(leaked),
+                "leaked_bytes": sum(
+                    (r.get("size") or 0) * max(1, len(r["locations"]))
+                    for r in leaked)}
+
+    async def h_ledger_sweep(self, conn, now: Optional[float] = None):
+        """One leak-detector pass: a sealed, resident object with zero
+        pins whose owner exited (or reports zero references), older than
+        cfg.ledger_leak_after_s, is flagged. Exports store_leaked_bytes /
+        store_leaked_objects gauges, emits a `store.leak` runtime-event
+        instant per newly flagged object, and sends the holding nodes an
+        eviction hint their pressured-stripe sweep consumes first.
+        `now` pins the clock for deterministic tests."""
+        now = time.time() if now is None else now
+        leak_after = cfg.ledger_leak_after_s
+        leaked_bytes = 0
+        leaked_count = 0
+        newly: List[Dict] = []
+        for row in self.object_ledger.values():
+            if row["freed_ts"] is not None or not row["locations"]:
+                row["leaked"] = False
+                continue
+            sealed = row["sealed_ts"]
+            if sealed is None:
+                continue   # unsealed orphans are gc_unsealed's problem
+            if any(int(l.get("pins") or 0) > 0
+                   for l in row["locations"].values()):
+                row["leaked"] = False
+                continue
+            owner_gone = (row.get("owner_worker") in self._ledger_exited
+                          if row.get("owner_worker") else False)
+            if not owner_gone and row.get("owner_refs") != 0:
+                continue
+            if now - sealed < leak_after:
+                continue
+            nbytes = (row.get("size") or 0) * max(1, len(row["locations"]))
+            leaked_bytes += nbytes
+            leaked_count += 1
+            if not row.get("leaked"):
+                row["leaked"] = True
+                row["leak_ts"] = now
+                newly.append(row)
+        try:
+            from ray_tpu.util.metrics import gauge_snapshot
+            self.h_report_metrics(None, "gcs-ledger", [
+                gauge_snapshot("store_leaked_bytes", float(leaked_bytes),
+                               "bytes held by leaked objects (sealed, "
+                               "ownerless, unpinned past "
+                               "ledger_leak_after_s)"),
+                gauge_snapshot("store_leaked_objects", float(leaked_count),
+                               "objects currently flagged as leaked"),
+            ], ts=now)
+        except Exception:
+            logger.exception("leak gauge export failed")
+        hints: Dict[str, List[str]] = {}
+        for row in newly:
+            import os as _os
+            self.h_add_task_events(None, [{
+                "task_id": f"leak-{row['object_id'][:16]}-{int(now)}",
+                "kind": "runtime_event", "event_kind": "instant",
+                "type": "RUNTIME_EVENT", "name": "store.leak",
+                "category": "store", "state": "RUNNING", "ts": now,
+                "trace_id": _os.urandom(16).hex(),
+                "span_id": _os.urandom(8).hex(), "parent_span_id": None,
+                "node_id": next(iter(row["locations"]), None),
+                "worker_id": "gcs-ledger",
+                "attrs": {"object_id": row["object_id"],
+                          "bytes": row.get("size") or 0,
+                          "owner": row.get("owner"),
+                          "owner_worker": row.get("owner_worker"),
+                          "age_s": round(now - row["sealed_ts"], 3),
+                          "nodes": list(row["locations"])}}])
+            for node in row["locations"]:
+                hints.setdefault(node, []).append(row["object_id"])
+        for node, oids in hints.items():
+            node_conn = self.node_conns.get(node)
+            if node_conn is not None and not node_conn.closed:
+                asyncio.ensure_future(
+                    self._safe_evict_hint(node_conn, oids))
+        return {"leaked_objects": leaked_count,
+                "leaked_bytes": leaked_bytes,
+                "newly_flagged": [r["object_id"] for r in newly]}
+
+    async def _safe_evict_hint(self, conn, oids: List[str]):
+        try:
+            await conn.notify("ledger_evict_hint", oids=oids)
+        except Exception:
+            logger.debug("evict hint to node failed", exc_info=True)
+
+    async def _ledger_sweep_loop(self):
+        while True:
+            await asyncio.sleep(cfg.ledger_sweep_interval_s)
+            if not self.object_ledger:
+                continue
+            try:
+                await self.h_ledger_sweep(None)
+            except Exception:
+                logger.exception("ledger sweep failed")
+
     # --------------------------------------------------------------- pubsub
     def h_report_metrics(self, conn, worker_id: str, metrics: list,
                          node_id: Optional[str] = None,
@@ -746,6 +1039,9 @@ class GcsServer:
             getattr(self, "metrics", {}).pop(wid, None)
             node_of.pop(wid, None)
             self.metrics_ts.drop_worker(wid)
+            # objects owned by this node's workers just lost their owner
+            # — the ledger sweep treats them as leak candidates
+            self._ledger_exited.add(wid)
 
     def h_drop_worker_metrics(self, conn, worker_id: str):
         """Node managers report crashed/killed workers here so their
@@ -757,6 +1053,9 @@ class GcsServer:
         getattr(self, "metrics", {}).pop(worker_id, None)
         getattr(self, "metrics_node", {}).pop(worker_id, None)
         self.metrics_ts.drop_worker(worker_id)
+        # crashed/killed worker: its owned-table died with it, so its
+        # sealed objects have zero owner references by definition
+        self._ledger_exited.add(worker_id)
         return True
 
     def h_subscribe(self, conn, channel: str):

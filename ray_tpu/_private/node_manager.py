@@ -34,7 +34,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from ray_tpu._private import rpc, scheduling
+from ray_tpu._private import ledger, rpc, scheduling
 from ray_tpu._private.config import cfg
 from ray_tpu._private.object_store import ObjectStoreClient, parallel_write
 
@@ -148,6 +148,12 @@ class NodeManager:
         # queued lease demand, reported in heartbeats for the autoscaler
         self._pending_demand: List[Dict[str, float]] = []
         self._spill_mutex = threading.Lock()
+        # leaked objects the GCS ledger sweep told us to reclaim under
+        # pressure (consumed first by the spill pass — deleting a leaked
+        # object frees bytes without disk IO). Mutated from the owner
+        # loop (hint handler) and read from the spill executor thread;
+        # individual set ops are GIL-atomic and the hints are advisory.
+        self._evict_hints: set = set()
         # pid -> [(path, stream_name, offset), ...] for the log monitor
         self._log_files: Dict[int, list] = {}
         # compiled-DAG channel mirrors this daemon writes into
@@ -183,6 +189,7 @@ class NodeManager:
             "free_object": self.h_free_object,
             "free_remote_object": self.h_free_remote_object,
             "get_node_info": self.h_get_node_info,
+            "ledger_evict_hint": self.h_ledger_evict_hint,
             "channel_push": self.h_channel_push,
             "channel_publish": self.h_channel_publish,
             "channel_close": self.h_channel_close,
@@ -208,6 +215,7 @@ class NodeManager:
                 "prepare_bundle": self.h_prepare_bundle,
                 "commit_bundle": self.h_commit_bundle,
                 "return_bundle": self.h_return_bundle,
+                "ledger_evict_hint": self.h_ledger_evict_hint,
                 "pubsub": self.h_pubsub,
             }, name="nm->gcs", retries=20)
         resp = await self.gcs.call(
@@ -253,6 +261,23 @@ class NodeManager:
         _events.set_identity(node_id=self.node_id,
                              worker_id=f"nm-{self.node_id[:12]}")
         _events.set_sink(_ship_events)
+
+        # object-lifetime ledger: same daemon-sink pattern — this
+        # process's spill/restore/evict/arrival deltas ship over the
+        # node manager's own GCS connection
+        def _ship_ledger(batch):
+            gcs = self.gcs
+            if gcs is None or gcs.closed:
+                raise ConnectionError("gcs connection down")
+            asyncio.run_coroutine_threadsafe(
+                gcs.notify("update_object_ledger", records=batch,
+                           node_id=self.node_id,
+                           worker_id=f"nm-{self.node_id[:12]}"), _loop)
+
+        ledger.set_enabled(cfg.ledger_enabled)
+        ledger.set_identity(node_id=self.node_id,
+                            worker_id=f"nm-{self.node_id[:12]}")
+        ledger.set_sink(_ship_ledger)
         self._tasks = [
             asyncio.ensure_future(self._log_monitor_loop()),
             asyncio.ensure_future(self._heartbeat_loop()),
@@ -261,6 +286,7 @@ class NodeManager:
             asyncio.ensure_future(self._memory_monitor_loop()),
             asyncio.ensure_future(self._spill_loop()),
             asyncio.ensure_future(self._metrics_push_loop()),
+            asyncio.ensure_future(self._ledger_census_loop()),
         ]
         logger.info("node manager %s at %s (store %s, %s)",
                     self.node_id[:12], self.address, self.store_path,
@@ -429,6 +455,40 @@ class NodeManager:
                 rows.append(gauge_snapshot(
                     "store_bytes_in_use", st["bytes_in_use"],
                     "shared-memory arena bytes in use", tags))
+                rows.append(gauge_snapshot(
+                    "store_capacity_bytes", st["capacity"],
+                    "shared-memory arena capacity", tags))
+                rows.append(gauge_snapshot(
+                    "store_objects", st["num_objects"],
+                    "live objects in the arena", tags))
+                # span residency + worst-stripe occupancy/fragmentation:
+                # the `ray_tpu status --watch` memory pane reads these
+                # from the TS plane (they previously reached only
+                # get_node_info)
+                sp = self.store.span_stats()
+                rows.append(gauge_snapshot(
+                    "store_live_spans", sp["live_spans"],
+                    "live spanning (multi-stripe) objects", tags))
+                rows.append(gauge_snapshot(
+                    "store_span_bytes", sp["span_bytes"],
+                    "bytes held by spanning objects", tags))
+                rows.append(gauge_snapshot(
+                    "store_stripes_claimed", sp["stripes_claimed"],
+                    "stripes claimed whole by spanning objects", tags))
+                util_max, hole_max = 0.0, 0
+                for i in range(self.store.num_stripes()):
+                    ss = self.store.stripe_stats(i)
+                    if ss["capacity"]:
+                        util_max = max(util_max,
+                                       ss["bytes_in_use"] / ss["capacity"])
+                    fr = self.store.stripe_frag(i)
+                    hole_max = max(hole_max, fr["largest_hole"])
+                rows.append(gauge_snapshot(
+                    "store_stripe_max_utilization", round(util_max, 4),
+                    "occupancy fraction of the fullest stripe", tags))
+                rows.append(gauge_snapshot(
+                    "store_largest_hole_bytes", hole_max,
+                    "largest single free block across stripes", tags))
             except Exception:
                 pass
         if self._data_server is not None:
@@ -1625,6 +1685,11 @@ class NodeManager:
     def _finish_receive(self, oid: bytes):
         st = self._receiving.pop(oid)
         self.store.seal(oid)
+        # a transfer arrival extends the object's location set (size and
+        # placement reconcile via the census; this makes the new copy
+        # visible to `ray_tpu memory` within a flush, not a census tick)
+        ledger.record(oid, "location_add", node_id=self.node_id,
+                      size=st.get("size", 0))
         if st.get("bcast"):
             # per-node arrival instrumentation: one instant per tree
             # node, carrying bytes + the relay fan-out it now owns
@@ -1698,6 +1763,97 @@ class NodeManager:
         finally:
             buf.close()
 
+    # -------------------------------------------------------- object ledger
+    def _ledger_census_payload(self) -> Optional[Dict]:
+        """One arena census for the GCS object ledger: every sealed
+        resident object's pins, size, and stripe/span placement, plus
+        the spilled set. Runs on an executor thread (object_info takes
+        one stripe lock per object). The census is the ledger's
+        authority for the location set — LRU eviction and crash repair
+        reclaim objects without any event firing, and this reconciles
+        them."""
+        if self.store is None:
+            return None
+        now = self.store.now_sec()
+        objects = {}
+        for oid in self.store.list_objects():
+            info = self.store.object_info(oid)
+            if info is None or not info["sealed"]:
+                continue
+            objects[oid.hex()] = {
+                "pins": info["pins"],
+                "size": info["data_size"] + info["meta_size"],
+                "is_span": info["is_span"], "stripe": info["stripe"],
+                "age_s": max(0, now - info["ctime_sec"])}
+        return {"objects": objects,
+                "spilled": [o.hex() for o in self.spilled]}
+
+    async def _ledger_census_loop(self):
+        loop = asyncio.get_event_loop()
+        while True:
+            interval = cfg.ledger_report_interval_s
+            if interval <= 0 or not ledger.enabled():
+                await asyncio.sleep(5.0)
+                continue
+            await asyncio.sleep(interval)
+            try:
+                census = await loop.run_in_executor(
+                    None, self._ledger_census_payload)
+                if census is not None:
+                    await self.gcs.notify(
+                        "update_object_ledger", census=census,
+                        node_id=self.node_id)
+            # rtlint: disable=RT004 — best-effort census on a fixed
+            # cadence; the next tick re-reports the full arena state
+            # (no data loss) and the heartbeat loop owns GCS reconnect
+            except Exception:
+                pass
+
+    def h_ledger_evict_hint(self, conn, oids):
+        """GCS leak sweep → this node: `oids` (hex) are leaked objects
+        resident here. They are NOT reclaimed eagerly — the pressured-
+        stripe spill pass consumes them first, so a false positive
+        costs nothing unless the arena is actually short on bytes."""
+        for o in oids or ():
+            try:
+                self._evict_hints.add(bytes.fromhex(o))
+            except ValueError:
+                pass
+        return True
+
+    def _consume_evict_hints(self, pressured: set, global_hot: bool) -> int:
+        """Reclaim leaked objects from pressured stripes before spilling
+        healthy ones: deleting a leaked object frees bytes with no disk
+        IO, and nobody can read it again (owner gone, zero pins — the
+        sweep re-verifies pins here in case it was re-pinned since
+        flagging). Returns bytes freed."""
+        if not self._evict_hints:
+            return 0
+        freed = 0
+        for oid in list(self._evict_hints):
+            try:
+                info = self.store.object_info(oid)
+            except OSError:   # store closed under shutdown race
+                return freed
+            if info is None:
+                self._evict_hints.discard(oid)   # already gone
+                continue
+            if info["pins"]:
+                continue
+            if not global_hot and not info["is_span"] \
+                    and info["stripe"] not in pressured:
+                continue   # the hint waits for ITS stripe's pressure
+            try:
+                self.store.delete(oid)
+            except Exception:
+                continue
+            self._evict_hints.discard(oid)
+            nbytes = info["data_size"] + info["meta_size"]
+            freed += nbytes
+            ledger.record(oid, "evicted", node_id=self.node_id,
+                          reason="leak_hint", size=nbytes)
+        return freed
+
     # --------------------------------------------------------------- spilling
     async def _spill_loop(self):
         """The node-manager arena sweep: spill LRU sealed objects to disk
@@ -1756,6 +1912,9 @@ class NodeManager:
         n = 0
         spilled_bytes = 0
         t0 = time.time()
+        # leak hints first: reclaimed leaked bytes may relieve the
+        # pressure before any healthy object pays disk IO
+        hint_freed = self._consume_evict_hints(set(pressured), global_hot)
         for si in pressured:
             for oid in self.store.list_stripe(si):
                 freed = self._spill_one(oid, _os)
@@ -1779,7 +1938,7 @@ class NodeManager:
                 "store.spill", t0, time.time(), category="store",
                 objects=n, bytes=spilled_bytes,
                 bytes_in_use=st["bytes_in_use"], capacity=cap,
-                stripes=len(pressured))
+                stripes=len(pressured), leak_hint_bytes=hint_freed)
         return n
 
     def _spill_one(self, oid: bytes, _os) -> Optional[int]:
@@ -1790,6 +1949,8 @@ class NodeManager:
             # already on disk (a restored copy) — just drop the resident
             # copy; the native store defers the delete if clients pin it
             self.store.delete(oid)
+            ledger.record(oid, "location_remove", node_id=self.node_id,
+                          reason="spill_drop")
             return 0
         buf = self.store.get(oid)
         if buf is None:
@@ -1813,6 +1974,7 @@ class NodeManager:
             buf.close()
         self.spilled[oid] = path
         self.store.delete(oid)
+        ledger.record(oid, "spilled", node_id=self.node_id, size=nbytes)
         return nbytes
 
     async def h_spill_now(self, conn):
@@ -1865,6 +2027,8 @@ class NodeManager:
                 mview[:] = meta
             self.store.seal(oid)
             rspan.end(ok=True, bytes=len(data) + len(meta))
+            ledger.record(oid, "restored", node_id=self.node_id,
+                          size=len(data) + len(meta))
             return True
         except Exception:
             logger.exception("restore of %s failed", oid.hex()[:16])
@@ -1882,6 +2046,8 @@ class NodeManager:
                 os.unlink(path)
             except OSError:
                 pass
+        ledger.record(oid, "freed", node_id=self.node_id)
+        self._evict_hints.discard(oid)
         return True
 
     async def h_free_remote_object(self, conn, oid: bytes, node_id: str):
@@ -1903,7 +2069,19 @@ class NodeManager:
         if self.store is not None:
             st = self.store.stats()
             info["store"] = {"bytes_in_use": st["bytes_in_use"],
-                             "num_objects": st.get("num_objects")}
+                             "num_objects": st.get("num_objects"),
+                             "capacity": st.get("capacity"),
+                             "num_stripes": st.get("num_stripes"),
+                             "num_spans": st.get("num_spans"),
+                             "spilled_objects": len(self.spilled),
+                             "evict_hints": len(self._evict_hints)}
+            # per-stripe live/free/largest-hole + span residency: the
+            # machine-readable occupancy view (`ray_tpu memory --nodes`,
+            # dashboard /api/memory)
+            try:
+                info["store"]["fragmentation"] = self.store.fragmentation()
+            except Exception:
+                pass
         if self._data_server is not None:
             info["data_plane"] = {
                 "address": self.data_plane_address,

@@ -93,6 +93,15 @@ class _Lib:
             lib.rt_is_span.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
             lib.rt_span_stats.argtypes = [
                 ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+            lib.rt_object_info.restype = ctypes.c_int64
+            lib.rt_object_info.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint64)]
+            lib.rt_stripe_frag.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_uint64)]
+            lib.rt_now_sec.restype = ctypes.c_uint64
+            lib.rt_now_sec.argtypes = []
             cls._instance = super().__new__(cls)
             cls._instance.lib = lib
         return cls._instance
@@ -265,20 +274,40 @@ class ObjectStoreClient:
         if off == -17:  # EEXIST
             return None
         if off < 0:
-            # arena exhaustion is the event that triggers synchronous
-            # spills upstream — mark it on the flight-recorder timeline
-            # so spill spans line up with the allocation that forced them
-            try:
-                from ray_tpu._private import events
-                events.record_instant(
-                    "store.arena_full", category="store",
-                    object_id=oid.hex()[:16], requested=data_size, rc=off)
-            except Exception:
-                pass
-            raise MemoryError(f"object store create failed (rc={off})")
+            raise self._arena_full(oid, data_size, off)
         data = self._view[off:off + data_size]
         meta = self._view[off + data_size:off + data_size + meta_size]
         return data, meta
+
+    def _arena_full(self, oid: bytes, requested: int,
+                    rc: int, spanning: bool = False) -> MemoryError:
+        """Arena exhaustion is the event that triggers synchronous spills
+        upstream — mark it on the flight-recorder timeline (so spill
+        spans line up with the allocation that forced them) WITH the
+        fragmentation breakdown attached, and raise a MemoryError whose
+        message carries the same per-stripe live/free/largest-hole view
+        so bug reports are self-diagnosing."""
+        summary = self._frag_summary(requested)
+        try:
+            from ray_tpu._private import events
+            attrs = {"object_id": oid.hex()[:16], "requested": requested,
+                     "rc": rc, "spanning": spanning}
+            try:
+                frag = self.fragmentation()
+                attrs["stripes"] = [
+                    [st["stripe"], st["live"], st["free"],
+                     st["largest_hole"]] for st in frag["stripes"]]
+                attrs["spans"] = frag["spans"]
+            except Exception:
+                pass
+            events.record_instant("store.arena_full", category="store",
+                                  **attrs)
+        except Exception:
+            pass
+        kind = "spanning create" if spanning else "object store create"
+        return MemoryError(
+            f"{kind} failed (rc={rc}): {summary}" if summary
+            else f"{kind} failed (rc={rc})")
 
     def seal(self, oid: bytes) -> None:
         rc = self._lib.rt_seal(self._handle(), oid)
@@ -390,7 +419,7 @@ class ObjectStoreClient:
         if off == -17:  # EEXIST
             return None
         if off < 0:
-            raise MemoryError(f"spanning create failed (rc={off})")
+            raise self._arena_full(oid, data_size, off, spanning=True)
         data = self._view[off:off + data_size]
         meta = self._view[off + data_size:off + data_size + meta_size]
         return data, meta
@@ -406,6 +435,73 @@ class ObjectStoreClient:
 
     def num_stripes(self) -> int:
         return int(self._lib.rt_num_stripes(self._handle()))
+
+    def now_sec(self) -> int:
+        """CLOCK_MONOTONIC seconds — the base of object ctime stamps, so
+        `now_sec() - info["ctime_sec"]` is an object's age."""
+        return int(self._lib.rt_now_sec())
+
+    def object_info(self, oid: bytes) -> Optional[dict]:
+        """Per-object probe for the observability surface: size, pin
+        count, placement, age base — WITHOUT pinning, touching LRU, or
+        reading the payload (contrast `get`, which does all three).
+        None when the object is not live."""
+        arr = (ctypes.c_uint64 * 8)()
+        rc = self._lib.rt_object_info(self._handle(), oid, arr)
+        if rc < 0:
+            return None
+        return {"data_size": int(arr[0]), "meta_size": int(arr[1]),
+                "pins": int(arr[2]), "stripe": int(arr[3]),
+                "ctime_sec": int(arr[4]), "is_span": bool(arr[5]),
+                "sealed": bool(arr[6]), "flags": int(arr[7])}
+
+    def stripe_frag(self, stripe: int) -> dict:
+        """Free-list walk of one stripe: total free bytes, the largest
+        single hole (the biggest create the stripe could serve), and
+        the free-block count. Span-claimed stripes report zero free."""
+        arr = (ctypes.c_uint64 * 4)()
+        self._lib.rt_stripe_frag(self._handle(), stripe, arr)
+        return {"free_bytes": int(arr[0]), "largest_hole": int(arr[1]),
+                "free_blocks": int(arr[2]), "bytes_in_use": int(arr[3])}
+
+    def fragmentation(self) -> dict:
+        """Machine-readable occupancy breakdown: per-stripe live/free/
+        largest-hole plus span residency — what an "arena full" error
+        attaches so bug reports are self-diagnosing."""
+        stripes = []
+        for i in range(self.num_stripes()):
+            ss = self.stripe_stats(i)
+            fr = self.stripe_frag(i)
+            stripes.append({
+                "stripe": i, "capacity": int(ss["capacity"]),
+                "live": int(ss["bytes_in_use"]),
+                "free": fr["free_bytes"],
+                "largest_hole": fr["largest_hole"],
+                "free_blocks": fr["free_blocks"],
+                "objects": int(ss["num_objects"])})
+        return {"stripes": stripes, "spans": self.span_stats()}
+
+    def _frag_summary(self, requested: int) -> str:
+        """Compact one-line breakdown for MemoryError messages (capped
+        at 8 stripes; the full dict rides the store.arena_full
+        instant)."""
+        try:
+            frag = self.fragmentation()
+        except Exception:
+            return ""
+        parts = [f"requested={requested}"]
+        for st in frag["stripes"][:8]:
+            parts.append(
+                f"s{st['stripe']}[live={st['live']} free={st['free']} "
+                f"hole={st['largest_hole']}]")
+        if len(frag["stripes"]) > 8:
+            parts.append(f"(+{len(frag['stripes']) - 8} stripes)")
+        sp = frag["spans"]
+        if sp.get("live_spans"):
+            parts.append(f"spans[{sp['live_spans']} live, "
+                         f"{sp['span_bytes']}B, "
+                         f"{sp['stripes_claimed']} stripes claimed]")
+        return " ".join(parts)
 
     def stripe_stats(self, stripe: int) -> dict:
         """Lock-free per-stripe snapshot (sweep targeting, bench

@@ -38,7 +38,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import cloudpickle
 
-from ray_tpu._private import ids, rpc, serialization
+from ray_tpu._private import ids, ledger, rpc, serialization
 from ray_tpu._private.config import cfg
 from ray_tpu._private.markers import off_loop
 from ray_tpu._private.object_ref import ObjectRef
@@ -335,6 +335,10 @@ class CoreWorker:
             cfg.apply(await self.gcs.call("get_system_config") or {})
         except rpc.RpcError:
             pass   # older GCS without the handler
+        # one head-side ledger_enabled governs the cluster; identity is
+        # pinned so flushes from executor threads never guess it
+        ledger.set_enabled(cfg.ledger_enabled)
+        ledger.set_identity(node_id=self.node_id, worker_id=self.worker_id)
         if self.node_address:
             self.node_conn = await rpc.connect(
                 self.node_address, handlers={
@@ -444,6 +448,10 @@ class CoreWorker:
             self.object_events.pop(oid, None)
             entry.pop("contained", None)  # drops nested refs -> their unrefs
             loc = entry.get("location")
+            if ledger.enabled() and entry.get("complete"):
+                # the owner released its last reference: close the
+                # object's provenance row (leak sweep skips freed rows)
+                ledger.record(oid, "freed", node_id=loc)
             if loc == self.node_id and self.store is not None:
                 try:
                     self.store.delete(oid)
@@ -718,6 +726,23 @@ class CoreWorker:
                 entry = self.owned.get(oid)
                 if entry is not None:
                     entry["location"] = self.node_id
+                if ledger.enabled() and bufs is not None:
+                    # provenance for the object-lifetime ledger: one
+                    # record covers create+seal (current_task_id is a
+                    # loop-side field read advisorily from put threads).
+                    # A failure here must never trip the wire fallback
+                    # below — the shm put already succeeded.
+                    try:
+                        span = self.store.is_span(oid)
+                    except OSError:
+                        span = False
+                    tid = self.current_task_id
+                    ledger.record_put(
+                        oid, size=s.data_size(), meta_size=len(meta),
+                        owner=self.address, owner_worker=self.worker_id,
+                        node_id=self.node_id,
+                        task_id=tid.hex() if tid else None,
+                        is_span=span)
             except Exception:
                 logger.exception("shm put failed; falling back to memory store")
                 # rtlint: disable=RT003 — GIL-atomic publish (see above)
@@ -3042,6 +3067,22 @@ class CoreWorker:
                     await asyncio.wait_for(
                         self.gcs.notify("add_task_events", events=ev_rows),
                         1.0)
+            except Exception:
+                pass
+            # ledger: announce this worker's exit (its owned-table dies
+            # with it — sealed objects it leaves behind become leak
+            # candidates) and ship any buffered provenance records
+            try:
+                if ledger.enabled():
+                    ledger.record(b"", "worker_exit",
+                                  worker_id=self.worker_id)
+                batch = ledger.drain()
+                if batch:
+                    await asyncio.wait_for(
+                        self.gcs.notify("update_object_ledger",
+                                        records=batch,
+                                        node_id=self.node_id,
+                                        worker_id=self.worker_id), 1.0)
             except Exception:
                 pass
             # final metrics push (mirror of the task-event flush above):

@@ -29,6 +29,7 @@ class DashboardServer:
         r.add_get("/api/actors", self._actors)
         r.add_get("/api/tasks", self._tasks)
         r.add_get("/api/timeline", self._timeline)
+        r.add_get("/api/memory", self._memory)
         r.add_get("/api/runtime_events", self._runtime_events)
         r.add_get("/api/placement_groups", self._pgs)
         r.add_get("/api/jobs", self._jobs)
@@ -145,6 +146,27 @@ class DashboardServer:
         def fetch():
             import ray_tpu
             return ray_tpu.timeline()
+        return web.json_response(await self._in_thread(fetch))
+
+    async def _memory(self, request):
+        """Cluster memory observability: object rows (arena truth joined
+        with object-ledger provenance — owner, size, stripe/span
+        placement, pins, age, leak flag) plus per-node occupancy/
+        fragmentation and ledger totals. ?limit=N bounds the object
+        list; ?leaked=1 restricts it to leak-detector hits."""
+        from aiohttp import web
+        from ray_tpu.util import state
+        try:
+            limit = int(request.query.get("limit", 1000))
+        except ValueError:
+            return web.json_response({"error": "bad limit"}, status=400)
+        leaked_only = request.query.get("leaked") in ("1", "true", "yes")
+
+        def fetch():
+            rows = state.list_objects(limit=limit)
+            if leaked_only:
+                rows = [r for r in rows if r.get("leaked")]
+            return {"objects": rows, "summary": state.memory_summary()}
         return web.json_response(await self._in_thread(fetch))
 
     async def _runtime_events(self, request):

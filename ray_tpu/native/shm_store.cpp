@@ -1583,6 +1583,91 @@ uint64_t rt_list(void* hs, uint8_t* out, uint64_t max_n) {
   return n;
 }
 
+// -------------------------------------------------- observability ABI
+// Read-only widening for the object-lifetime ledger and the memory
+// observability surface (`ray_tpu memory`): per-object provenance
+// probes, a free-list fragmentation walk, and the monotonic clock the
+// ctime stamps are taken against (so readers can turn ctime_sec into an
+// age without guessing the clock base).
+
+// CLOCK_MONOTONIC seconds — the base of every ctime_sec stamp.
+uint64_t rt_now_sec(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec;
+}
+
+// Per-object info probe — no pin, no LRU touch, no payload access.
+// out[8]: data_size, meta_size, pin_count, stripe (owning stripe, or a
+// span's first stripe), ctime_sec, is_span, sealed, flags.
+// Returns 0 on a live object, -ENOENT otherwise. Spans are read
+// lock-free (advisory snapshot, same contract as rt_span_stats);
+// entries confirm under the owning stripe's lock so a racing free can't
+// hand back a reused slot's fields.
+int64_t rt_object_info(void* hs, const uint8_t* id, uint64_t* out) {
+  Store* s = static_cast<Store*>(hs);
+  memset(out, 0, 8 * sizeof(uint64_t));
+  {
+    int k = span_find(s, id);
+    if (k >= 0) {
+      SpanDesc* d = &s->hdr->spans[k];
+      uint32_t st = ld32(&d->state);
+      if (st == kSpanCreated || st == kSpanSealed) {
+        out[0] = d->data_size;
+        out[1] = d->meta_size;
+        out[2] = ld32(&d->pin_count, __ATOMIC_RELAXED);
+        out[3] = d->first_stripe;
+        out[4] = d->ctime_sec;
+        out[5] = 1;
+        out[6] = st == kSpanSealed ? 1 : 0;
+        out[7] = d->flags;
+        return 0;
+      }
+    }
+  }
+  return with_entry_locked(s, id, [&](uint32_t si, uint32_t idx) {
+    Entry* e = &s->table[idx];
+    out[0] = e->data_size;
+    out[1] = e->meta_size;
+    out[2] = ld32(&e->pin_count, __ATOMIC_RELAXED);
+    out[3] = si;
+    out[4] = e->ctime_sec;
+    out[5] = 0;
+    out[6] = ld32(&e->state) == kSealed ? 1 : 0;
+    out[7] = e->flags;
+    return (int64_t)0;
+  });
+}
+
+// Fragmentation walk of ONE stripe's free list (under its lock — this
+// is a diagnostic path polled at census cadence, not a hot path).
+// out[4]: free_bytes (sum of free block sizes incl. headers),
+// largest_hole (largest free block, i.e. the biggest single allocation
+// the stripe could serve +/- header/alignment), free_blocks,
+// bytes_in_use. A stripe claimed by a spanning object reports zero
+// free bytes — its heap belongs to the span wholesale.
+void rt_stripe_frag(void* hs, uint32_t stripe, uint64_t* out) {
+  Store* s = static_cast<Store*>(hs);
+  memset(out, 0, 4 * sizeof(uint64_t));
+  if (stripe >= s->hdr->num_stripes) return;
+  Stripe* sp = &s->hdr->stripes[stripe];
+  StripeGuard g(s, stripe);
+  if (sp->span_owner) {
+    out[3] = sp->arena_size;
+    return;
+  }
+  uint64_t off = sp->free_head;
+  while (off != kNone) {
+    Block* b = at(s, sp, off);
+    uint64_t sz = blk_size(b);
+    out[0] += sz;
+    if (sz > out[1]) out[1] = sz;
+    out[2]++;
+    off = b->next_free;
+  }
+  out[3] = sp->bytes_in_use;
+}
+
 // ------------------------------------------------- spanning-object ABI
 
 // Largest payload (data+meta) the per-stripe allocator can hold; one

@@ -140,6 +140,48 @@ def _metrics_table(state, window: float, max_rows: int = 40) -> str:
     return "\n".join(lines)
 
 
+# (metric, agg, label) rows of the --watch memory pane: arena occupancy
+# + span/stripe stats + leak gauge + the PR 5/11 data-plane counters
+# (previously these reached only /metrics and get_node_info)
+_MEMORY_PANE_ROWS = [
+    ("store_bytes_in_use", "latest", "arena bytes in use"),
+    ("store_capacity_bytes", "latest", "arena capacity"),
+    ("store_objects", "latest", "live objects"),
+    ("store_live_spans", "latest", "spanning objects"),
+    ("store_span_bytes", "latest", "bytes in spans"),
+    ("store_stripes_claimed", "latest", "stripes claimed by spans"),
+    ("store_stripe_max_utilization", "latest", "fullest stripe fraction"),
+    ("store_largest_hole_bytes", "latest", "largest free hole"),
+    ("store_leaked_bytes", "latest", "leaked bytes (ledger sweep)"),
+    ("store_leaked_objects", "latest", "leaked objects"),
+    ("data_plane_bytes_in_total", "rate", "data-plane B/s in"),
+    ("data_plane_bytes_out_total", "rate", "data-plane B/s out"),
+    ("data_plane_chunks_in_total", "rate", "data-plane chunks/s in"),
+    ("data_plane_chunks_out_total", "rate", "data-plane chunks/s out"),
+    ("data_plane_active_conns", "latest", "data-plane connections"),
+    ("data_plane_receiving", "latest", "receives in progress"),
+]
+
+
+def _memory_pane(state, window: float) -> str:
+    """Memory/data-plane pane for `status --watch`: windowed values of
+    the store + transfer gauges over the GCS time-series plane."""
+    lines = [f"{'MEMORY / DATA PLANE':<40} {'AGG':<7} {'VALUE':>14}"]
+    shown = 0
+    for name, agg, label in _MEMORY_PANE_ROWS:
+        try:
+            v = state.query_metrics(name, window, agg)["value"]
+        except Exception:
+            v = None
+        if v is None:
+            continue
+        shown += 1
+        lines.append(f"{label:<40} {agg:<7} {_fmt_metric(v):>14}")
+    if not shown:
+        lines.append("  (no store metrics pushed yet)")
+    return "\n".join(lines)
+
+
 def cmd_status(args):
     import ray_tpu
     from ray_tpu.util import state
@@ -161,6 +203,8 @@ def cmd_status(args):
             print(f"ray_tpu status --watch  (refresh {interval:.1f}s, "
                   f"window {window:.0f}s, ctrl-c to exit)\n")
             print(json.dumps(summary, default=str))
+            print()
+            print(_memory_pane(state, window))
             print()
             print(table)
             sys.stdout.flush()
@@ -219,24 +263,118 @@ def cmd_timeline(args):
     print(f"wrote {out} (open in chrome://tracing or Perfetto)")
 
 
+def _fmt_bytes(n) -> str:
+    n = n or 0
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TB"
+
+
+def _memory_sorted(rows, sort: str):
+    """Deterministic ordering for the memory table. sort: size (desc),
+    age (desc — oldest first is what a leak hunt wants), node."""
+    if sort == "age":
+        return sorted(rows, key=lambda r: -(r.get("age_s") or 0.0))
+    if sort == "node":
+        return sorted(rows, key=lambda r: (str(r.get("node_id") or "~"),
+                                           -(r.get("size_bytes") or 0)))
+    return sorted(rows, key=lambda r: -(r.get("size_bytes") or 0))
+
+
+def _memory_grouped(rows, by: str):
+    """Aggregate object rows by owner | node | kind: object count,
+    total bytes, pinned count, leaked bytes per group."""
+    groups = {}
+    for r in rows:
+        if by == "node":
+            key = str(r.get("node_id") or "-")
+        elif by == "owner":
+            key = str(r.get("owner") or "-")
+        else:
+            key = str(r.get("kind") or "-")
+        g = groups.setdefault(key, {"group": key, "objects": 0,
+                                    "bytes": 0, "pinned": 0,
+                                    "leaked_bytes": 0})
+        g["objects"] += 1
+        g["bytes"] += r.get("size_bytes") or 0
+        if r.get("pins"):
+            g["pinned"] += 1
+        if r.get("leaked"):
+            g["leaked_bytes"] += r.get("size_bytes") or 0
+    return sorted(groups.values(), key=lambda g: -g["bytes"])
+
+
+def _format_memory_rows(rows) -> str:
+    lines = [f"{'OBJECT ID':<34} {'KIND':<10} {'SIZE':>10} {'PINS':>5} "
+             f"{'AGE':>8} {'SPAN':>5} {'LEAK':>5}  OWNER / NODES"]
+    for r in rows:
+        age = r.get("age_s")
+        owner = r.get("owner") or r.get("location") or "-"
+        nodes = ",".join(n[:8] for n in r.get("locations") or ())
+        if not nodes and r.get("node_id"):
+            nodes = str(r["node_id"])[:8]
+        lines.append(
+            f"{r.get('object_id', '?'):<34} {r.get('kind', '?'):<10} "
+            f"{_fmt_bytes(r.get('size_bytes')):>10} "
+            f"{r.get('pins') if r.get('pins') is not None else '-':>5} "
+            f"{f'{age:.0f}s' if age is not None else '-':>8} "
+            f"{'yes' if r.get('is_span') else '-':>5} "
+            f"{'LEAK' if r.get('leaked') else '-':>5}  "
+            f"{str(owner)[:24]} @{nodes or '-'}")
+    return "\n".join(lines)
+
+
 def cmd_memory(args):
-    """Object-store + ownership dump for this node/process (reference:
-    `ray memory` — store contents merged with the core worker's refcount
-    table)."""
+    """Cluster memory observability (reference: `ray memory` + the state
+    observability object table): every live object with owner, size,
+    placement (stripe/span), pin count, and age — local arena truth
+    joined with GCS object-ledger provenance. `--leaked` shows only
+    objects flagged by the leak detector; `--group-by owner|node|kind`
+    aggregates; `--nodes` appends per-node occupancy/fragmentation."""
     import ray_tpu
     from ray_tpu.util import state
     ray_tpu.init(address=_load_address(args))
-    rows = state.list_objects()
-    total = 0
-    print(f"{'OBJECT ID':<34} {'KIND':<10} {'SIZE':>10} "
-          f"{'PINS':>5} {'BORROWERS':>9}  LOCATION")
-    for r in rows:
-        size = r.get("size_bytes") or 0
-        total += size
-        print(f"{r.get('object_id', '?'):<34} {r.get('kind', '?'):<10} "
-              f"{size:>10} {r.get('task_pins', 0):>5} "
-              f"{r.get('borrowers', 0):>9}  {r.get('location') or '-'}")
-    print(f"-- {len(rows)} entries, {total / 1e6:.1f} MB in local shm")
+    rows = state.list_objects(limit=args.limit)
+    if args.leaked:
+        rows = [r for r in rows if r.get("leaked")]
+    total = sum(r.get("size_bytes") or 0 for r in rows)
+    leaked = sum(r.get("size_bytes") or 0 for r in rows if r.get("leaked"))
+    if args.group_by:
+        groups = _memory_grouped(rows, args.group_by)
+        print(f"{'GROUP':<40} {'OBJECTS':>8} {'BYTES':>12} "
+              f"{'PINNED':>7} {'LEAKED':>12}")
+        for g in groups:
+            print(f"{g['group'][:40]:<40} {g['objects']:>8} "
+                  f"{_fmt_bytes(g['bytes']):>12} {g['pinned']:>7} "
+                  f"{_fmt_bytes(g['leaked_bytes']):>12}")
+    else:
+        print(_format_memory_rows(_memory_sorted(rows, args.sort)))
+    print(f"-- {len(rows)} objects, {_fmt_bytes(total)} total"
+          + (f", {_fmt_bytes(leaked)} leaked" if leaked else ""))
+    if getattr(args, "nodes", False):
+        summary = state.memory_summary()
+        for n in summary["nodes"]:
+            st = n.get("store") or {}
+            print(f"\nnode {n['node_id'][:12]}: "
+                  f"{_fmt_bytes(st.get('bytes_in_use'))} / "
+                  f"{_fmt_bytes(st.get('capacity'))} in use, "
+                  f"{st.get('num_objects', '?')} objects, "
+                  f"{st.get('num_spans', 0)} spans, "
+                  f"{st.get('spilled_objects', 0)} spilled")
+            for s in (st.get("fragmentation") or {}).get("stripes", []):
+                print(f"  stripe {s['stripe']}: live "
+                      f"{_fmt_bytes(s['live'])} / "
+                      f"{_fmt_bytes(s['capacity'])}, free "
+                      f"{_fmt_bytes(s['free'])}, largest hole "
+                      f"{_fmt_bytes(s['largest_hole'])}, "
+                      f"{s['objects']} objects")
+        led = summary.get("ledger")
+        if led:
+            print(f"ledger: {led['entries']} rows, "
+                  f"{led['leaked_objects']} leaked "
+                  f"({_fmt_bytes(led['leaked_bytes'])})")
 
 
 def cmd_submit(args):
@@ -393,8 +531,22 @@ def main(argv=None):
     pt.add_argument("--output", "-o", default=None)
     pt.set_defaults(fn=cmd_timeline)
 
-    pm = sub.add_parser("memory")
+    pm = sub.add_parser(
+        "memory", help="cluster object/memory observability "
+        "(arena truth joined with object-ledger provenance)")
     pm.add_argument("--address", default=None)
+    pm.add_argument("--sort", choices=["size", "age", "node"],
+                    default="size",
+                    help="row ordering (size desc, age desc, node)")
+    pm.add_argument("--group-by", dest="group_by",
+                    choices=["owner", "node", "kind"], default=None,
+                    help="aggregate instead of listing per object")
+    pm.add_argument("--leaked", action="store_true",
+                    help="only objects flagged by the leak detector")
+    pm.add_argument("--limit", type=int, default=1000)
+    pm.add_argument("--nodes", action="store_true",
+                    help="append per-node occupancy + per-stripe "
+                         "fragmentation (live/free/largest hole)")
     pm.set_defaults(fn=cmd_memory)
 
     pj = sub.add_parser("submit")

@@ -85,11 +85,16 @@ def _measure_decode(iters: int, enabled: bool) -> float:
 
 def _measure_put(iters: int, enabled: bool, use_ray: bool) -> float:
     """Puts/s (or bare span-records/s without a runtime), with a span
-    wrapped around every op when the recorder is enabled."""
+    wrapped around every op when the recorder is enabled. The enabled
+    side also turns the object-lifetime LEDGER on, so each real put
+    pays its provenance record (create+seal delta) — the honest
+    ledger-on cost the <5% guard must cover."""
     import numpy as np
 
     from ray_tpu._private import events
+    from ray_tpu._private import ledger
     events.set_enabled(enabled)
+    ledger.set_enabled(enabled)
     try:
         if use_ray:
             import ray_tpu
@@ -111,9 +116,46 @@ def _measure_put(iters: int, enabled: bool, use_ray: bool) -> float:
                     pass
             dt = time.perf_counter() - t0
         events.drain()
+        ledger.drain()
         return iters / dt
     finally:
         events.set_enabled(True)
+        ledger.set_enabled(True)
+
+
+def _measure_memory_query(n_objects: int = 10000, n_queries: int = 50):
+    """p95 latency (ms) of a `list_objects`-shaped query against a
+    populated 10k-object ledger: the GCS table dump plus the state-API
+    merge join — the `ray_tpu memory` steady state."""
+    import statistics
+
+    from ray_tpu._private.gcs import GcsServer
+    from ray_tpu.util.state import _merge_object_rows
+    g = GcsServer()
+    census = {}
+    for i in range(n_objects):
+        oid = f"{i:010x}" + "00" * 15
+        g.h_update_object_ledger(None, records=[{
+            "object_id": oid, "event": "created", "ts": float(i),
+            "seq": i + 1, "size": 4096 + i, "meta_size": 0,
+            "owner": f"w:{i % 64}", "owner_worker": f"w{i % 64}",
+            "node_id": f"n{i % 4}", "task_id": None, "is_span": False,
+            "sealed": True}])
+        census.setdefault(f"n{i % 4}", {})[oid] = {
+            "pins": i % 3, "size": 4096 + i, "is_span": False,
+            "stripe": i % 8, "age_s": float(i % 600)}
+    for node, objs in census.items():
+        g.h_update_object_ledger(None, census={"objects": objs},
+                                 node_id=node)
+    lat = []
+    for _ in range(n_queries):
+        t0 = time.perf_counter()
+        rows = g.h_list_object_ledger(None, limit=1000)
+        merged = _merge_object_rows([], {}, rows, 1000, now=0.0)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    assert len(merged) == 1000
+    lat.sort()
+    return round(lat[int(0.95 * (len(lat) - 1))], 4)
 
 
 def _measure_metrics_query(n_pushes: int = 300, n_queries: int = 200):
@@ -202,9 +244,11 @@ def run(spec: dict) -> dict:
         "runs": runs,
         "decode_runs_on": [round(v, 1) for v in dec_on],
         "decode_runs_off": [round(v, 1) for v in dec_off],
-        # enabled side = recorder + metrics gauges + step profiler
-        "plane": "recorder+metrics+profiler",
+        # enabled side = recorder + metrics gauges + step profiler +
+        # object-lifetime ledger (put path records provenance)
+        "plane": "recorder+metrics+profiler+ledger",
         "metrics_query_ms": _measure_metrics_query(),
+        "memory_query_ms": _measure_memory_query(),
     }
     if use_ray:
         # a real put (~100us+ of serialization + arena copy) is the op
