@@ -496,13 +496,13 @@ def bench_serve_tokens_per_s(tpu_ok: bool = False):
             {"model": "tpu-1b", "n_slots": 8, "max_len": 512,
              "prefill_chunk": 64, "n_requests": 32,
              "prompt_lens": [16, 128], "new_tokens": [16, 128],
-             "arrival_rate_rps": 50.0, "runs": 3},
+             "arrival_rate_rps": 50.0, "runs": 3, "disagg": 1},
             {"model": "tiny", "n_slots": 8, "n_requests": 24,
-             "new_tokens": [4, 64], "runs": 3},
+             "new_tokens": [4, 64], "runs": 3, "disagg": 1},
         ]
     else:
         ladder = [{"model": "tiny", "n_slots": 8, "n_requests": 24,
-                   "new_tokens": [4, 64], "runs": 3}]
+                   "new_tokens": [4, 64], "runs": 3, "disagg": 1}]
     last = "unknown"
     for attempt in range(2):
         if attempt:
@@ -535,7 +535,7 @@ def bench_serve_prefix_tokens_per_s(tpu_ok: bool = False):
     base = {"n_slots": 8, "n_requests": 24, "runs": 3,
             "shared_prefixes": 4, "prefix_len": 128,
             "suffix_lens": [2, 12], "new_tokens": [4, 32],
-            "arrival_rate_rps": 50.0}
+            "arrival_rate_rps": 50.0, "disagg": 1}
     if tpu_ok:
         ladder = [dict(base, model="tpu-1b", max_len=512,
                        prefill_chunk=64),
@@ -1072,6 +1072,15 @@ def main():
                 "static_tokens_per_s": srv["static_tokens_per_s"],
                 "vs_static": srv["vs_static"],
                 "vs_r05_ratchet": vs_r05,
+                # disagg-vs-colocated split (serve/disagg.py): the same
+                # workload through a prefill-tier/decode-tier pair with
+                # real KV hand-off framing; `value` stays the colocated
+                # figure so the r05 ratchet compares like with like
+                "disagg_tokens_per_s": srv.get("disagg_tokens_per_s"),
+                "vs_colocated": srv.get("vs_colocated"),
+                "kv_handoffs": srv.get("kv_handoffs"),
+                "disagg_decode_compile_count":
+                    srv.get("disagg_decode_compile_count"),
                 "spread": srv["spread"], "runs": srv["runs"]}
             log(f"serve_tokens_per_s: {srv['serve_tokens_per_s']} "
                 f"({srv['model']}, vs_static {srv['vs_static']}x, "
@@ -1108,6 +1117,15 @@ def main():
                 "no_prefix_tokens_per_s": pfx.get("no_prefix_tokens_per_s"),
                 "vs_no_prefix": pfx.get("vs_no_prefix"),
                 "decode_compile_count": pfx.get("decode_compile_count"),
+                # cluster cache view (serve/disagg.py): hit rate of the
+                # decode tier's combined local+imported cache, plus the
+                # hand-off volume that built it
+                "cluster_prefix_hit_rate":
+                    pfx.get("cluster_prefix_hit_rate"),
+                "disagg_tokens_per_s": pfx.get("disagg_tokens_per_s"),
+                "vs_colocated": pfx.get("vs_colocated"),
+                "kv_handoffs": pfx.get("kv_handoffs"),
+                "remote_prefix_tokens": pfx.get("remote_prefix_tokens"),
                 "spread": pfx.get("spread"), "runs": pfx.get("runs")}
             log(f"serve_prefix_tokens_per_s: {pfx['serve_tokens_per_s']} "
                 f"(hit_rate {pfx.get('prefix_hit_rate')}, vs_no_prefix "

@@ -249,6 +249,25 @@ _flag("ledger_max_entries", int, 20000,
       "Object-ledger table capacity in the GCS; past it, freed rows are "
       "retired first, then the oldest rows (same bounded-ring discipline "
       "as the task-event sink).")
+# Disaggregated serving: cluster-wide prefix routing (serve/disagg.py)
+_flag("prefix_summary_interval_s", float, 2.0,
+      "Cadence at which a prefix-routed serving replica publishes its "
+      "radix-trie summary (top-K path fingerprints) to the GCS "
+      "prefix_summaries table.")
+_flag("prefix_summary_ttl_s", float, 10.0,
+      "A prefix summary older than this is expired at read time — a "
+      "dead replica stops attracting cluster-prefix routes within one "
+      "TTL without explicit teardown.")
+_flag("prefix_summary_top_k", int, 128,
+      "Fingerprints per published trie summary (most recently touched "
+      "first); ~8 bytes each on the wire, so the default is ~1KB per "
+      "replica per publish.")
+# Object store: spanning-object spill (weight-distribution plane)
+_flag("span_spill_min_idle_s", float, 5.0,
+      "A sealed, unpinned spanning object younger than this is never "
+      "spilled by the pressure sweep (a weight blob mid-broadcast is "
+      "briefly unpinned between the relay write and the first consumer "
+      "attach; age-gating keeps the sweep off that window).")
 # NOTE: RPC chaos injection is configured through rpc.py's own
 # RAY_TPU_TESTING_RPC_FAILURE spec string ("method=prob"), not a flag here.
 

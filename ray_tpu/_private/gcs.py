@@ -87,6 +87,11 @@ class GcsServer:
         self.object_ledger: Dict[str, Dict] = {}
         self._ledger_exited: set = set()   # worker ids that died/exited
         self._ledger_sweeper: Optional[asyncio.Task] = None
+        # cluster-wide prefix routing (serve/disagg.py): one compact trie
+        # summary per serving replica (top-K path fingerprints), expiring
+        # after cfg.prefix_summary_ttl_s so dead replicas fall out of
+        # routing within one TTL without explicit teardown
+        self.prefix_summaries: Dict[str, Dict] = {}
         # time-series plane over report_metrics pushes (metrics_ts.py):
         # bounded per-series rings answering windowed queries (rate /
         # percentiles) that the latest-snapshot table cannot
@@ -138,6 +143,8 @@ class GcsServer:
             "list_object_ledger": self.h_list_object_ledger,
             "ledger_sweep": self.h_ledger_sweep,
             "ledger_stats": self.h_ledger_stats,
+            "publish_prefix_summary": self.h_publish_prefix_summary,
+            "get_prefix_summaries": self.h_get_prefix_summaries,
             "ping": lambda conn: "pong",
         }
         self.server = rpc.Server(handlers, name="gcs")
@@ -983,6 +990,47 @@ class GcsServer:
                 await self.h_ledger_sweep(None)
             except Exception:
                 logger.exception("ledger sweep failed")
+
+    # ------------------------------------------------- prefix summaries
+    def h_publish_prefix_summary(self, conn, replica_id: str, fps: list,
+                                 chunk: int, blocks: Optional[int] = None,
+                                 deployment: Optional[str] = None):
+        """One serving replica's trie summary (serve/disagg.py): top-K
+        path fingerprints of its radix prefix cache. Last write wins per
+        replica; rows expire at read time after cfg.prefix_summary_ttl_s
+        so a dead replica stops attracting routes within one TTL. The
+        table is bounded: past 1024 replicas the stalest rows retire."""
+        if not replica_id:
+            return False
+        self.prefix_summaries[replica_id] = {
+            "replica_id": replica_id,
+            "fps": [int(f) for f in (fps or [])][:cfg.prefix_summary_top_k],
+            "chunk": int(chunk), "blocks": blocks,
+            "deployment": deployment, "ts": time.time()}
+        if len(self.prefix_summaries) > 1024:
+            for rid in sorted(self.prefix_summaries,
+                              key=lambda r:
+                              self.prefix_summaries[r]["ts"])[:64]:
+                self.prefix_summaries.pop(rid, None)
+        return True
+
+    def h_get_prefix_summaries(self, conn, ids: Optional[list] = None,
+                               deployment: Optional[str] = None):
+        """Live (non-expired) summary rows, optionally filtered to the
+        replica ids a router currently routes to. Expired rows are
+        pruned here — publication is the only other write path."""
+        now = time.time()
+        ttl = cfg.prefix_summary_ttl_s
+        for rid in [r for r, row in self.prefix_summaries.items()
+                    if now - row["ts"] > ttl]:
+            self.prefix_summaries.pop(rid, None)
+        rows = list(self.prefix_summaries.values())
+        if ids is not None:
+            want = set(ids)
+            rows = [r for r in rows if r["replica_id"] in want]
+        if deployment:
+            rows = [r for r in rows if r.get("deployment") == deployment]
+        return rows
 
     # --------------------------------------------------------------- pubsub
     def h_report_metrics(self, conn, worker_id: str, metrics: list,

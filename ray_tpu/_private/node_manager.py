@@ -1915,6 +1915,25 @@ class NodeManager:
         # leak hints first: reclaimed leaked bytes may relieve the
         # pressure before any healthy object pays disk IO
         hint_freed = self._consume_evict_hints(set(pressured), global_hot)
+        # idle spanning objects next (ROADMAP item 4 leftover): spans
+        # live outside every stripe's entry segment, so the per-stripe
+        # walk below can NEVER reach them — before this pass a multi-GB
+        # idle blob sat unspillable while its claimed stripes read as
+        # 100% full forever. One span spill frees whole stripes at once,
+        # so run it before any healthy per-stripe object pays disk IO.
+        span_n = 0
+        if global_hot:
+            span_n, span_bytes = self._spill_idle_spans(
+                _os, target_frac * cap)
+            n += span_n
+            spilled_bytes += span_bytes
+            if span_n:
+                st = self.store.stats()
+                if st["bytes_in_use"] < target_frac * cap:
+                    self._record_spill_span(t0, n, spilled_bytes, cap,
+                                            len(pressured), hint_freed,
+                                            span_n)
+                    return n
         for si in pressured:
             for oid in self.store.list_stripe(si):
                 freed = self._spill_one(oid, _os)
@@ -1930,16 +1949,61 @@ class NodeManager:
                 if st["bytes_in_use"] < target_frac * cap:
                     break
         if n:
-            # the span is recorded only for passes that moved something
-            # — the 1s poll's no-op passes would be pure timeline noise
-            from ray_tpu._private import events
-            st = self.store.stats()
-            events.record_complete(
-                "store.spill", t0, time.time(), category="store",
-                objects=n, bytes=spilled_bytes,
-                bytes_in_use=st["bytes_in_use"], capacity=cap,
-                stripes=len(pressured), leak_hint_bytes=hint_freed)
+            self._record_spill_span(t0, n, spilled_bytes, cap,
+                                    len(pressured), hint_freed, span_n)
         return n
+
+    def _record_spill_span(self, t0, n, spilled_bytes, cap, stripes,
+                           hint_freed, span_n):
+        # the span is recorded only for passes that moved something
+        # — the 1s poll's no-op passes would be pure timeline noise
+        from ray_tpu._private import events
+        st = self.store.stats()
+        events.record_complete(
+            "store.spill", t0, time.time(), category="store",
+            objects=n, bytes=spilled_bytes,
+            bytes_in_use=st["bytes_in_use"], capacity=cap,
+            stripes=stripes, leak_hint_bytes=hint_freed,
+            spans=span_n)
+
+    def _spill_idle_spans(self, _os, target_bytes: float = 0.0):
+        """Spill idle spanning objects under GLOBAL pressure: sealed,
+        zero pins, older than cfg.span_spill_min_idle_s. Global-only on
+        purpose — a span's claimed stripes always read as full, so
+        per-stripe pressure would spill every idle span on every sweep
+        even in an otherwise empty arena; global bytes_in_use (which
+        counts claimed stripes whole) is the signal that the normal
+        allocator actually needs those stripes back. Whole-span delete
+        frees every member stripe atomically; restore reloads through
+        the ordinary size-aware create (spanning route included)."""
+        n = freed = 0
+        try:
+            spans = self.store.list_spans()
+        except OSError:
+            return 0, 0
+        if not spans:
+            return 0, 0
+        rows = []
+        now = self.store.now_sec()
+        for oid in spans:
+            info = self.store.object_info(oid)
+            if info is None or not info["sealed"] or info["pins"]:
+                continue
+            age = now - info["ctime_sec"]
+            if age < cfg.span_spill_min_idle_s:
+                continue
+            rows.append((age, oid))
+        rows.sort(reverse=True)           # oldest (idlest) first
+        for _age, oid in rows:
+            b = self._spill_one(oid, _os)
+            if b is None:
+                continue
+            n += 1
+            freed += b
+            if target_bytes and \
+                    self.store.stats()["bytes_in_use"] < target_bytes:
+                break
+        return n, freed
 
     def _spill_one(self, oid: bytes, _os) -> Optional[int]:
         """Spill one sealed object (or drop the resident copy of an

@@ -518,6 +518,14 @@ class ObjectStoreClient:
         raw = buf.raw
         return [raw[i * ID_LEN:(i + 1) * ID_LEN] for i in range(n)]
 
+    def list_spans(self, max_n: int = 65536) -> list:
+        """Sealed spanning-object ids. rt_list appends sealed spans
+        after the per-stripe listings (spans live in the header-level
+        span table, not any stripe's entry segment — which is why the
+        per-stripe spill sweep never sees them); filter them back out
+        via the lock-free rt_is_span probe."""
+        return [o for o in self.list_objects(max_n) if self.is_span(o)]
+
     def list_stripe(self, stripe: int, max_n: int = 65536) -> list:
         """Sealed object ids resident in one stripe."""
         buf = ctypes.create_string_buffer(max_n * ID_LEN)

@@ -161,12 +161,9 @@ class LLMDeployment:
             "engine.request", category="serve",
             prompt_tokens=len(prompt_tokens),
             max_new_tokens=int(max_new_tokens))
-        with events.trace_context(req_span.trace_id, req_span.span_id):
-            handle = self.engine.submit(prompt_tokens,
-                                        max_new_tokens=max_new_tokens,
-                                        temperature=temperature,
-                                        eos_id=eos_id,
-                                        deadline_s=deadline_s)
+        handle = self._submit_request(prompt_tokens, max_new_tokens,
+                                      temperature, eos_id, deadline_s,
+                                      req_span)
         prev_t: Optional[float] = None
         n_tokens = 0
         try:
@@ -206,6 +203,21 @@ class LLMDeployment:
             self._metrics.finished(reason)
             self._metrics.prefix(self.engine.prefix_cache)
             req_span.end(finish_reason=reason, tokens=n_tokens)
+
+    def _submit_request(self, prompt_tokens, max_new_tokens, temperature,
+                        eos_id, deadline_s, req_span):
+        """Admission hook: submit one request to the engine under the
+        request span's trace context. The disaggregated decode tier
+        (serve/disagg.py) overrides this to run the KV hand-off —
+        hold-submit, import remotely prefilled blocks, release — before
+        admission plans any prefill."""
+        from ray_tpu._private import events
+        with events.trace_context(req_span.trace_id, req_span.span_id):
+            return self.engine.submit(prompt_tokens,
+                                      max_new_tokens=max_new_tokens,
+                                      temperature=temperature,
+                                      eos_id=eos_id,
+                                      deadline_s=deadline_s)
 
     def generate(self, prompt_tokens, **kw):
         """Non-streaming convenience: returns the full token list

@@ -168,6 +168,10 @@ class InferenceEngine:
         self.prefill_compile_count = 0
         self.steps = 0
         self.tokens_generated = 0
+        # disagg hand-off accounting (serve/disagg.py)
+        self.kv_exports = 0
+        self.kv_imports = 0
+        self.remote_prefix_tokens = 0
         self.on_step: Optional[Callable[[Dict], None]] = None
         # flight-recorder root for engine-owned work that belongs to no
         # single request (multi-request decode batches)
@@ -289,19 +293,45 @@ class InferenceEngine:
                 sv = jax.lax.dynamic_update_slice(sv, cv, (0, 0, dst, 0, 0))
                 return sk, sv
 
+            def export_span(bk, bv, slot, src):
+                # disagg hand-off, sender half: one cached block out of
+                # the pool (device value; the caller materializes it to
+                # host for the wire). Fixed span shape + traced offsets
+                # = one compile, ever — same contract as load/save.
+                ck = jax.lax.dynamic_slice(bk, (0, slot, src, 0, 0), span)
+                cv = jax.lax.dynamic_slice(bv, (0, slot, src, 0, 0), span)
+                return ck, cv
+
+            def import_span(bk, bv, ck, cv, slot, dst):
+                # disagg hand-off, receiver half: a span computed on
+                # ANOTHER replica lands in this engine's block pool; the
+                # normal load_span hit path then serves it exactly like
+                # a locally prefilled block.
+                bk = jax.lax.dynamic_update_slice(bk, ck,
+                                                  (0, slot, dst, 0, 0))
+                bv = jax.lax.dynamic_update_slice(bv, cv,
+                                                  (0, slot, dst, 0, 0))
+                return bk, bv
+
             self._save_span_fn = jax.jit(
                 save_span, donate_argnums=(0, 1) if donate else ())
             self._load_span_fn = jax.jit(
                 load_span, donate_argnums=(0, 1) if donate else ())
+            self._export_span_fn = jax.jit(export_span)
+            self._import_span_fn = jax.jit(
+                import_span, donate_argnums=(0, 1) if donate else ())
 
     # -------------------------------------------------------------- intake
     def submit(self, tokens, max_new_tokens: int = 64,
                temperature: Optional[float] = None,
                eos_id: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> RequestHandle:
+               deadline_s: Optional[float] = None,
+               hold: bool = False) -> RequestHandle:
         """Queue one prompt; returns a streaming RequestHandle.
         deadline_s is relative (seconds from now) — a request still
-        queued past it fails with finish_reason='deadline'."""
+        queued past it fails with finish_reason='deadline'.
+        hold=True parks the request in the queue (FIFO position kept)
+        until release_hold() — the remote-prefill hand-off window."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         if len(tokens) == 0:
             raise ValueError("empty prompt")
@@ -317,9 +347,18 @@ class InferenceEngine:
         with self._work:
             if self._stop:
                 raise RuntimeError("engine is stopped")
-            h = self.sched.submit(req)
+            h = self.sched.submit(req, hold=hold)
             self._work.notify_all()
         return h
+
+    def release_hold(self, handle: RequestHandle):
+        """End a hold-submitted request's hand-off window: it becomes
+        admissible on the next step (its imported prefix — if the
+        hand-off landed — now matches via the radix trie exactly like a
+        locally cached one). Safe to call on any failure path."""
+        with self._work:
+            self.sched.release_hold(handle.rid)
+            self._work.notify_all()
 
     def begin_drain(self):
         """Preemption drain: refuse new submissions (submit raises and
@@ -586,6 +625,75 @@ class InferenceEngine:
                     self._blocks_k, self._blocks_v, sk, sv,
                     np.int32(bslot), np.int32(boff * C), np.int32(off))
 
+    # --------------------------------------------------- disagg hand-off
+    def export_kv_blocks(self, tokens, max_chunks: Optional[int] = None):
+        """Sender half of the prefill/decode hand-off: copy the cached
+        KV blocks covering ``tokens``' chunk-aligned prefix out of the
+        block pool as host arrays. Returns ``(covered_tokens, spans)``
+        where ``spans`` is ``[(k, v), ...]`` of fixed span shape
+        ``[n_layers, 1, prefill_chunk, Hkv, D]`` — the unit
+        serve/disagg.py frames onto the data plane. Defaults to the
+        admission cap (one token short of the prompt) so the importing
+        engine's match covers exactly what its scheduler would use.
+        Blocks stay pinned for the duration of the copy; compile-once
+        holds (one fixed-shape export program)."""
+        if self.prefix_cache is None:
+            return 0, []
+        C = self.config.prefill_chunk
+        cap = (max(0, len(tokens) - 1) // C if max_chunks is None
+               else max(0, int(max_chunks)))
+        with self._lock:
+            nodes = self.prefix_cache.walk(tokens, cap)
+            spans = []
+            try:
+                with self._mesh_ctx():
+                    for node in nodes:
+                        bslot, boff = divmod(node.block,
+                                             self._blocks_per_slot)
+                        ck, cv = self._export_span_fn(
+                            self._blocks_k, self._blocks_v,
+                            np.int32(bslot), np.int32(boff * C))
+                        spans.append((np.asarray(ck), np.asarray(cv)))
+            finally:
+                self.prefix_cache.release(nodes)
+            if spans:
+                self.kv_exports += 1
+        return len(spans) * C, spans
+
+    def import_kv_blocks(self, tokens, spans) -> int:
+        """Receiver half: land remotely prefilled spans in this engine's
+        block pool and extend the trie over them, so the NEXT admission
+        of ``tokens`` (or any prompt sharing the prefix) hits via the
+        ordinary load_span path — no forward pass runs over the imported
+        range, and greedy output is bit-identical to a local prefill
+        (the blocks are the same deterministic computation, just done
+        elsewhere). Chunks already cached locally are skipped; returns
+        the number of prompt tokens newly covered."""
+        if self.prefix_cache is None or not spans:
+            return 0
+        import jax.numpy as jnp
+        C = self.config.prefill_chunk
+        n = min(len(spans), len(tokens) // C)
+        if n <= 0:
+            return 0
+        with self._lock:
+            created = self.prefix_cache.insert(
+                [int(t) for t in tokens[:n * C]])
+            with self._mesh_ctx():
+                for off, block in created:
+                    ck, cv = spans[off // C]
+                    bslot, boff = divmod(block, self._blocks_per_slot)
+                    self._blocks_k, self._blocks_v = self._import_span_fn(
+                        self._blocks_k, self._blocks_v,
+                        jnp.asarray(ck, self._cache_dtype),
+                        jnp.asarray(cv, self._cache_dtype),
+                        np.int32(bslot), np.int32(boff * C))
+            imported = len(created) * C
+            if imported:
+                self.kv_imports += 1
+                self.remote_prefix_tokens += imported
+        return imported
+
     # -------------------------------------------------------------- stats
     def stats(self) -> Dict:
         out = {
@@ -601,4 +709,7 @@ class InferenceEngine:
         }
         if self.prefix_cache is not None:
             out.update(self.prefix_cache.stats())
+            out["kv_exports"] = self.kv_exports
+            out["kv_imports"] = self.kv_imports
+            out["remote_prefix_tokens"] = self.remote_prefix_tokens
         return out

@@ -31,8 +31,42 @@ scheduler and engine already serialize there); nothing here locks.
 
 from __future__ import annotations
 
+import hashlib
 import itertools
+import struct
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+def _roll_fp(parent_fp: int, key: Sequence[int]) -> int:
+    """Rolling 64-bit path fingerprint: hash of (parent fingerprint,
+    this chunk's tokens). Two prompts share fingerprint ``i`` iff they
+    share their first ``(i+1) * chunk_size`` tokens (modulo hash
+    collision), so a flat fingerprint SET is enough to answer "how deep
+    does this replica's trie cover my prompt" without shipping tokens."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(parent_fp.to_bytes(8, "little"))
+    h.update(struct.pack(f"<{len(key)}i", *(int(t) for t in key)))
+    return int.from_bytes(h.digest(), "little")
+
+
+def chunk_fingerprints(tokens: Sequence[int], chunk_size: int,
+                       max_chunks: Optional[int] = None) -> List[int]:
+    """Path fingerprints of a prompt's full chunks: element ``i`` covers
+    ``tokens[: (i+1) * chunk_size]``. The router computes these for an
+    incoming prompt and intersects them with each replica's published
+    summary to find the deepest cluster-wide match (serve/disagg.py)."""
+    C = int(chunk_size)
+    if C <= 0:
+        raise ValueError("chunk_size must be positive")
+    n = len(tokens) // C
+    if max_chunks is not None:
+        n = min(n, max(0, int(max_chunks)))
+    fps: List[int] = []
+    fp = 0
+    for c in range(n):
+        fp = _roll_fp(fp, tokens[c * C:(c + 1) * C])
+        fps.append(fp)
+    return fps
 
 
 class TrieNode:
@@ -41,7 +75,8 @@ class TrieNode:
     values; ``pins`` counts in-flight requests that matched through this
     node and have not yet copied it out."""
 
-    __slots__ = ("key", "block", "children", "parent", "pins", "stamp")
+    __slots__ = ("key", "block", "children", "parent", "pins", "stamp",
+                 "fp")
 
     def __init__(self, key: Optional[Tuple[int, ...]], block: Optional[int],
                  parent: Optional["TrieNode"]):
@@ -51,6 +86,9 @@ class TrieNode:
         self.children: Dict[Tuple[int, ...], "TrieNode"] = {}
         self.pins = 0
         self.stamp = 0
+        # path fingerprint (root -> this node); the unit the cluster-wide
+        # routing summary is built from
+        self.fp = 0
 
     def __repr__(self):
         return (f"TrieNode(block={self.block}, pins={self.pins}, "
@@ -116,6 +154,67 @@ class RadixPrefixCache:
             if n.pins > 0:
                 n.pins -= 1
 
+    def peek(self, tokens: Sequence[int]) -> int:
+        """Longest capped match length WITHOUT pinning, LRU touch, or
+        hit/lookup accounting — the read the disagg admission path and
+        routing decisions use (``match`` is reserved for admissions that
+        will actually copy the blocks out)."""
+        C = self.chunk_size
+        limit = max(0, (len(tokens) - 1)) // C
+        node = self._root
+        depth = 0
+        for c in range(limit):
+            child = node.children.get(
+                tuple(int(t) for t in tokens[c * C:(c + 1) * C]))
+            if child is None:
+                break
+            node = child
+            depth += 1
+        return depth * C
+
+    def walk(self, tokens: Sequence[int], n_chunks: int) -> List[TrieNode]:
+        """PINNED nodes for the first ``n_chunks`` chunks of ``tokens``
+        present in the trie (contiguous from the root, no one-token-short
+        cap, no hit/lookup stats) — the KV-export path: the caller copies
+        each node's block out of the pool and then ``release()``s. Unlike
+        ``match`` this may cover the whole prompt: the importing engine
+        applies its own admission cap."""
+        C = self.chunk_size
+        node = self._root
+        out: List[TrieNode] = []
+        for c in range(max(0, int(n_chunks))):
+            child = node.children.get(
+                tuple(int(t) for t in tokens[c * C:(c + 1) * C]))
+            if child is None:
+                break
+            out.append(child)
+            node = child
+        if out:
+            stamp = next(self._clock)
+            for n in out:
+                n.pins += 1
+                n.stamp = stamp
+        return out
+
+    def summary(self, top_k: int = 128) -> Dict[str, Any]:
+        """Compact trie summary for cluster-wide prefix routing: the
+        ``top_k`` most-recently-touched nodes' path fingerprints (plus
+        the chunk size the fingerprints were computed at). A router
+        holding summaries from every replica answers "which replica
+        covers this prompt deepest" by intersecting the prompt's own
+        ``chunk_fingerprints`` with each set — no tokens leave the
+        replica, and the payload is ~8 bytes per cached chunk."""
+        rows: List[Tuple[int, int]] = []
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            rows.append((n.stamp, n.fp))
+            stack.extend(n.children.values())
+        rows.sort(reverse=True)
+        return {"fps": [fp for _, fp in rows[:max(0, int(top_k))]],
+                "chunk": self.chunk_size,
+                "blocks": self.blocks_cached}
+
     # ------------------------------------------------------------- insert
     def insert(self, tokens: Sequence[int]) -> List[Tuple[int, int]]:
         """Extend the trie over every FULL chunk of ``tokens``. Returns
@@ -138,6 +237,7 @@ class RadixPrefixCache:
                     if block is None:
                         break
                     child = TrieNode(key, block, node)
+                    child.fp = _roll_fp(node.fp, key)
                     node.children[key] = child
                     self.blocks_cached += 1
                     created.append((c * C, block))

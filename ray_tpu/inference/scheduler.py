@@ -172,6 +172,11 @@ class RequestState:
     # trie nodes backing it (released once the copy lands in scratch)
     prefix_matched: int = 0
     prefix_nodes: Optional[List[Any]] = None
+    # remote-prefill admission state (serve/disagg.py): a held request
+    # keeps its FIFO queue position but is skipped by plan_prefill until
+    # release_hold — the window in which its KV blocks are in flight
+    # from another replica. Cancellation/deadline reaping still applies.
+    hold: bool = False
 
 
 @dataclasses.dataclass
@@ -224,11 +229,18 @@ class Scheduler:
 
     def drained(self) -> bool:
         """True once every in-flight request has finished (the point at
-        which the controller may reap the replica early)."""
-        return self.draining and not self.has_work()
+        which the controller may reap the replica early). Held requests
+        still count as pending — their hand-off will release them."""
+        return self.draining and not (self._queue or self._prefilling
+                                      or self._active)
 
     # ------------------------------------------------------------ intake
-    def submit(self, request: Request) -> RequestHandle:
+    def submit(self, request: Request, hold: bool = False) -> RequestHandle:
+        """hold=True enqueues WITHOUT making the request admissible: it
+        keeps its FIFO position while a KV hand-off is in flight and
+        becomes plannable on release_hold() (or on any failure path the
+        caller takes — a hold that is never released is only reaped by
+        cancel/deadline)."""
         if self.draining:
             raise RuntimeError(
                 "scheduler is draining (preemption notice): new "
@@ -241,9 +253,20 @@ class Scheduler:
         eos = (request.eos_id if request.eos_id is not None
                else self.default_eos)
         st = RequestState(rid=rid, request=request, handle=handle,
-                          temperature=float(temp), eos_id=int(eos))
+                          temperature=float(temp), eos_id=int(eos),
+                          hold=bool(hold))
         self._queue.append(st)
         return handle
+
+    def release_hold(self, rid: int) -> bool:
+        """Make a held request admissible (its hand-off landed — or
+        failed, in which case admission falls back to local prefill).
+        Idempotent; False when the request already left the queue."""
+        for st in self._queue:
+            if st.rid == rid:
+                st.hold = False
+                return True
+        return False
 
     # -------------------------------------------------------- accounting
     def queue_depth(self) -> int:
@@ -336,8 +359,14 @@ class Scheduler:
             if budget <= 0:
                 break
             budget -= self._plan_one(st, budget, chunks)
-        while budget > 0 and self._queue and self._free_slots:
-            st = self._queue.pop(0)
+        qi = 0
+        while budget > 0 and qi < len(self._queue) and self._free_slots:
+            if self._queue[qi].hold:
+                # remote-prefill hand-off in flight: the request keeps
+                # its FIFO position but later arrivals may admit past it
+                qi += 1
+                continue
+            st = self._queue.pop(qi)
             st.slot = self._free_slots.pop(0)
             st.status = "PREFILLING"
             if self.prefix_cache is not None:
@@ -433,4 +462,8 @@ class Scheduler:
         self._prefilling.clear()
 
     def has_work(self) -> bool:
-        return bool(self._queue or self._prefilling or self._active)
+        """Actionable work only: a queue holding nothing but held
+        requests doesn't spin the engine loop — release_hold notifies
+        the loop's condition when a hand-off lands."""
+        return bool(self._prefilling or self._active
+                    or any(not st.hold for st in self._queue))

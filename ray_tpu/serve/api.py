@@ -25,6 +25,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                num_hosts: int = 1, resumable_streams: Optional[bool] = None,
                coalesce_streams: Optional[bool] = None,
                preempt_grace_s: Optional[float] = None,
+               prefix_routed: Optional[bool] = None,
+               tier: Optional[str] = None,
                topology: Optional[str] = None, **_ignored):
     def wrap(target):
         # a callable opts into stream resume with __serve_resumable__ =
@@ -36,13 +38,19 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
         # token-chunk lists that the handle layer unpacks per token
         coalesced = (getattr(target, "__serve_coalesce_stream__", False)
                      if coalesce_streams is None else coalesce_streams)
+        # and __serve_prefix_route__ = True: the router fingerprints
+        # prompts and routes by deepest cluster-wide trie match
+        # (serve/disagg.py DisaggLLMDeployment)
+        prefixed = (getattr(target, "__serve_prefix_route__", False)
+                    if prefix_routed is None else prefix_routed)
         cfg = DeploymentConfig(
             num_replicas=num_replicas,
             max_ongoing_requests=max_ongoing_requests,
             ray_actor_options=ray_actor_options,
             num_hosts=num_hosts, topology=topology,
             resumable_streams=bool(resumable),
-            coalesce_streams=bool(coalesced))
+            coalesce_streams=bool(coalesced),
+            prefix_routed=bool(prefixed), tier=tier)
         if preempt_grace_s is not None:
             cfg.preempt_grace_s = float(preempt_grace_s)
         if autoscaling_config is not None:

@@ -810,7 +810,15 @@ class ServeController:
                     "resumable": bool(dep["spec"]["config"]
                                       .get("resumable_streams")),
                     "coalesced": bool(dep["spec"]["config"]
-                                      .get("coalesce_streams"))}
+                                      .get("coalesce_streams")),
+                    # cluster-wide prefix routing (serve/disagg.py):
+                    # replica actor ids key the GCS prefix_summaries
+                    # rows back onto routing-table indices
+                    "prefix_routed": bool(dep["spec"]["config"]
+                                          .get("prefix_routed")),
+                    "tier": dep["spec"]["config"].get("tier"),
+                    "replica_ids": [getattr(r, "_actor_id", None)
+                                    for r in dep["replicas"]]}
 
     def get_status(self) -> Dict:
         with self._lock:
@@ -818,7 +826,8 @@ class ServeController:
                 app_name: {
                     name: {"target": dep["target"],
                            "running": len(dep["replicas"]),
-                           "version": dep["version"]}
+                           "version": dep["version"],
+                           "tier": dep["spec"]["config"].get("tier")}
                     for name, dep in app.items()}
                 for app_name, app in self.apps.items()}
 

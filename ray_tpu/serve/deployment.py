@@ -70,6 +70,18 @@ class DeploymentConfig:
     # graceful_shutdown_timeout_s
     preempt_grace_s: float = 25.0
     graceful_shutdown_timeout_s: float = 30.0
+    # cluster-wide prefix routing (serve/disagg.py): True when the
+    # callable opted in (``__serve_prefix_route__ = True``) — the router
+    # fingerprints each prompt's chunk-aligned prefixes and routes to
+    # the replica whose published trie summary matches deepest, with
+    # session-hash fallback on ties/misses
+    prefix_routed: bool = False
+    # disaggregated-serving tier label ("prefill" / "decode" / None):
+    # informational for status surfaces, and the unit independent
+    # autoscaling operates on — each tier is its own deployment, so
+    # burn-driven scaling and autoscaler binpacking size the tiers
+    # separately (the tier-aware half of placement)
+    tier: Optional[str] = None
 
 
 def _coerce_slo(slo):

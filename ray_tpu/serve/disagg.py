@@ -1,0 +1,364 @@
+"""Disaggregated prefill/decode serving plane (ROADMAP item 1b/1c).
+
+Prefill is compute-bound, decode is memory-bound; colocating them on one
+replica wastes both sides of the roofline (the Gemma-on-TPU serving
+study quantifies the imbalance). This module splits ``LLMDeployment``
+into two tiers and turns N replica prefix caches into one logical
+cluster cache:
+
+- **Prefill tier** (:class:`PrefillLLMDeployment`): replicas run chunked
+  prefill only. ``prefill_export(tokens)`` makes sure the prompt's
+  chunk-aligned prefix is in the local radix cache (PR 10 blocks are
+  already immutable chunk-aligned spans), copies the blocks out of the
+  pool with the engine's fixed-shape export program, frames them into
+  one contiguous payload, and parks it in the **pinned shared-memory
+  arena** via ``ray_tpu.put`` — returning the ObjectRef, never the
+  bytes. The payload therefore moves between nodes over the PR 5
+  zero-copy data plane: the decode node's ``recv_into`` writes straight
+  into its arena, and the import path reads ``np.frombuffer`` views of
+  that region (no host staging copy; the single host->device copy is
+  the irreducible one).
+
+- **Decode tier** (:class:`DisaggLLMDeployment`): on a request whose
+  prefix is not cached locally, the replica hold-submits the request
+  (the scheduler keeps its FIFO position but won't admit it — the
+  remote-prefill admission state), asks the prefill tier for the KV
+  blocks, imports them into its own block pool + trie, and releases the
+  hold. Admission then takes the ordinary radix-hit path: ``load_span``
+  restores the imported blocks into scratch and only the final chunk
+  prefills. Greedy output is bit-identical to the colocated path and
+  ``decode_compile_count`` stays at 1 (export/import are two more
+  fixed-shape programs, compiled once).
+
+- **Cluster-wide prefix routing**: every decode replica periodically
+  publishes a compact trie summary — the top-K most-recently-touched
+  path fingerprints (~8 bytes per cached chunk) — to the GCS
+  ``prefix_summaries`` table. The router (serve/handle.py) computes the
+  incoming prompt's own chunk fingerprints and routes to the replica
+  with the DEEPEST cluster-wide match; session hash breaks ties and
+  handles the no-match case. N private caches become one logical cache:
+  a prefix warmed on replica A serves sessions that have never touched
+  A.
+
+Fallback ladder (every rung preserves exactly-once token delivery —
+nothing has streamed yet when a rung fails):
+
+  1. cluster longest-prefix route  (router; stale summary -> rung 2)
+  2. local radix hit               (no hand-off needed)
+  3. KV hand-off from the prefill tier (replica death / timeout -> 4)
+  4. local chunked prefill         (the PR 3 path, always available)
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import msgpack
+import numpy as np
+
+from ray_tpu._private import events, rpc
+from ray_tpu._private.config import cfg
+from ray_tpu.inference.api import LLMDeployment
+
+logger = logging.getLogger(__name__)
+
+
+# ------------------------------------------------------------ KV framing
+def pack_kv_spans(spans: List[Tuple[np.ndarray, np.ndarray]]) -> bytes:
+    """Frame exported KV spans into one contiguous payload:
+    ``[u32 header_len][msgpack {n, shape, dtype}][k0][v0][k1][v1]...``
+    with raw array bytes back to back — the shape ``unpack_kv_spans``
+    reads as zero-copy ``np.frombuffer`` views of the arena buffer the
+    data plane received into."""
+    if not spans:
+        hdr = msgpack.packb({"n": 0, "shape": [], "dtype": ""})
+        return len(hdr).to_bytes(4, "little") + hdr
+    k0 = spans[0][0]
+    hdr = msgpack.packb({"n": len(spans), "shape": list(k0.shape),
+                         "dtype": str(k0.dtype)})
+    parts = [len(hdr).to_bytes(4, "little"), hdr]
+    for k, v in spans:
+        parts.append(np.ascontiguousarray(k).tobytes())
+        parts.append(np.ascontiguousarray(v).tobytes())
+    return b"".join(parts)
+
+
+def unpack_kv_spans(buf) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Inverse of :func:`pack_kv_spans`. Accepts bytes or a memoryview
+    (e.g. the zero-copy arena view ``ray_tpu.get`` returns) and hands
+    back ``np.frombuffer`` views into it — no copy until the engine's
+    one host->device put."""
+    mv = memoryview(buf)
+    hlen = int.from_bytes(mv[:4], "little")
+    meta = msgpack.unpackb(bytes(mv[4:4 + hlen]), raw=False)
+    n = int(meta["n"])
+    if n == 0:
+        return []
+    shape = tuple(int(s) for s in meta["shape"])
+    dtype = np.dtype(meta["dtype"])
+    span_bytes = dtype.itemsize * int(np.prod(shape))
+    off = 4 + hlen
+    spans = []
+    for _ in range(n):
+        k = np.frombuffer(mv[off:off + span_bytes], dtype).reshape(shape)
+        off += span_bytes
+        v = np.frombuffer(mv[off:off + span_bytes], dtype).reshape(shape)
+        off += span_bytes
+        spans.append((k, v))
+    return spans
+
+
+# --------------------------------------------------- summary publication
+class PrefixSummaryPublisher:
+    """Background publisher of one replica's trie summary into the GCS
+    ``prefix_summaries`` table (cadence ``cfg.prefix_summary_interval_s``;
+    rows expire after ``cfg.prefix_summary_ttl_s`` so a dead replica
+    falls out of routing within one TTL). No-op outside a cluster
+    (direct instantiation in tests) — start() simply doesn't spawn the
+    thread when there is no runtime context to publish under."""
+
+    def __init__(self, engine, deployment: str):
+        self._engine = engine
+        self._deployment = deployment
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.published = 0
+
+    def start(self) -> "PrefixSummaryPublisher":
+        if self._engine.prefix_cache is None:
+            return self
+        try:
+            import ray_tpu
+            rid = ray_tpu.get_runtime_context().get("actor_id")
+        except Exception:
+            return self
+        if not rid:
+            return self
+        self._rid = rid
+        self._thread = threading.Thread(
+            target=self._loop, name="prefix-summary-pub", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        import ray_tpu
+        while not self._stop.wait(cfg.prefix_summary_interval_s):
+            cache = self._engine.prefix_cache
+            if cache is None or self._engine._stop:
+                return   # engine retired: let the GCS row TTL out
+            try:
+                s = cache.summary(cfg.prefix_summary_top_k)
+                ray_tpu._get_worker().gcs_call(
+                    "publish_prefix_summary", replica_id=self._rid,
+                    fps=s["fps"], chunk=s["chunk"], blocks=s["blocks"],
+                    deployment=self._deployment)
+                self.published += 1
+            except Exception:
+                # routing falls back to session hash while the GCS is
+                # unreachable; the next tick retries
+                logger.debug("prefix summary publish failed",
+                             exc_info=True)
+
+    def stop(self):
+        self._stop.set()
+
+
+# ----------------------------------------------------------- prefill tier
+class PrefillLLMDeployment(LLMDeployment):
+    """Prefill-tier replica: fills KV blocks, never decodes for clients.
+
+    ``prefill_export`` is the tier's whole API: make sure the prompt's
+    chunk-aligned prefix is cached (running chunked prefill if it is
+    not), export the blocks, and hand back a pinned-arena ObjectRef the
+    decode tier pulls over the data plane. The engine keeps a SMALL slot
+    pool (prefill scratch + the single throwaway decode step per cold
+    prompt) and a LARGE prefix block pool — the inverse of a decode
+    replica's shape, which is the point of disaggregating.
+
+    Chaos: ``rpc._maybe_inject_failure("prefill_export")`` fires at
+    entry and again right before the return (the mid-export death the
+    ServeReplicaKiller/PrefillExportKiller suites exercise); the decode
+    tier treats any failure as "fall back to local prefill"."""
+
+    def __init__(self, model="llama-debug", *, n_slots: int = 2,
+                 prefix_cache_slots: int = 8, **kw):
+        if prefix_cache_slots <= 0:
+            raise ValueError("the prefill tier IS its prefix cache: "
+                             "prefix_cache_slots must be > 0")
+        super().__init__(model, n_slots=n_slots,
+                         prefix_cache_slots=prefix_cache_slots, **kw)
+        self._publisher = PrefixSummaryPublisher(
+            self.engine, type(self).__name__).start()
+
+    def prefill_export(self, prompt_tokens,
+                       max_chunks: Optional[int] = None) -> Dict:
+        """Prefill (if needed) and export the KV blocks covering
+        ``prompt_tokens``' chunk-aligned prefix. Returns ``{covered,
+        chunk, ref}`` with the payload parked in the pinned arena —
+        or ``{covered, chunk, payload}`` with inline bytes outside a
+        cluster (direct instantiation in tests/benches)."""
+        rpc._maybe_inject_failure("prefill_export")
+        toks = [int(t) for t in prompt_tokens]
+        eng = self.engine
+        C = eng.config.prefill_chunk
+        cap = (max(0, len(toks) - 1) // C if max_chunks is None
+               else max(0, int(max_chunks)))
+        span = events.start_span("serve.prefill_export", category="serve",
+                                 prompt_tokens=len(toks))
+        try:
+            if cap and eng.prefix_cache.peek(toks) < cap * C:
+                # cold prefix: one budgeted chunked-prefill pass fills
+                # the blocks via the ordinary _populate_prefix path (the
+                # single sampled token is discarded — this tier's decode
+                # step exists only to complete the prefill lifecycle)
+                h = eng.submit(toks, max_new_tokens=1)
+                for _ in h:
+                    pass
+            covered, spans = eng.export_kv_blocks(toks, max_chunks=cap)
+            payload = pack_kv_spans(spans)
+            out: Dict[str, Any] = {"covered": covered, "chunk": C}
+            try:
+                import ray_tpu
+                out["ref"] = ray_tpu.put(payload)
+            except Exception:
+                # no cluster runtime (unit tier / in-process bench):
+                # inline the bytes — same framing, no data plane
+                out["payload"] = payload
+            rpc._maybe_inject_failure("prefill_export")
+            span.set(covered=covered, payload_bytes=len(payload))
+            return out
+        finally:
+            span.end()
+
+
+# ------------------------------------------------------------ decode tier
+class DisaggLLMDeployment(LLMDeployment):
+    """Decode-tier replica: serves streams, never runs a long prefill
+    when the cluster already has the KV.
+
+    Admission ladder per request (see module docstring): local radix
+    hit -> KV hand-off from ``prefill`` -> local chunked prefill. The
+    hand-off window uses the scheduler's hold state so the request
+    keeps its FIFO position while blocks are in flight; every failure
+    path releases the hold, so the worst case is exactly the colocated
+    path. Publishes trie summaries for cluster-wide prefix routing
+    (``__serve_prefix_route__`` makes the router fingerprint incoming
+    prompts and route by deepest cluster match)."""
+
+    __serve_prefix_route__ = True
+
+    def __init__(self, model="llama-debug", *, prefill=None,
+                 handoff_timeout_s: float = 10.0,
+                 prefix_cache_slots: int = 4, **kw):
+        super().__init__(model, prefix_cache_slots=prefix_cache_slots,
+                         **kw)
+        self._prefill = prefill
+        self._handoff_timeout_s = float(handoff_timeout_s)
+        self._publisher = PrefixSummaryPublisher(
+            self.engine, type(self).__name__).start()
+        from ray_tpu.util.metrics import Counter
+        self._m_handoffs = Counter(
+            "serve_kv_handoffs_total",
+            "prefill->decode KV hand-offs by outcome",
+            tag_keys=("outcome",))
+        self._m_handoff_tokens = Counter(
+            "serve_kv_handoff_tokens_total",
+            "prompt tokens imported via KV hand-off")
+
+    # ------------------------------------------------------- hand-off
+    def _call_prefill(self, toks: List[int]) -> Dict:
+        p = self._prefill
+        fn = getattr(p, "prefill_export", None)
+        if fn is None:
+            raise TypeError("prefill tier object has no prefill_export")
+        if hasattr(fn, "remote"):       # DeploymentHandle method caller
+            return fn.remote(toks).result(timeout=self._handoff_timeout_s)
+        return fn(toks)                  # direct object (tests/benches)
+
+    def _fetch_payload(self, out: Dict):
+        if out.get("ref") is not None:
+            import ray_tpu
+            # the pull lands via the data plane: recv_into straight into
+            # this node's arena; the returned view needs no staging copy
+            return ray_tpu.get(out["ref"],
+                               timeout=self._handoff_timeout_s)
+        return out.get("payload")
+
+    def _submit_request(self, prompt_tokens, max_new_tokens, temperature,
+                        eos_id, deadline_s, req_span):
+        eng = self.engine
+        toks = [int(t) for t in prompt_tokens]
+        C = eng.config.prefill_chunk
+        want = (max(0, len(toks) - 1) // C) * C
+        local = (eng.prefix_cache.peek(toks)
+                 if eng.prefix_cache is not None else 0)
+        if (self._prefill is None or eng.prefix_cache is None
+                or want == 0 or local >= want):
+            # rung 2 (local hit) or rung 4 (nothing to hand off):
+            # plain colocated admission
+            return super()._submit_request(
+                prompt_tokens, max_new_tokens, temperature, eos_id,
+                deadline_s, req_span)
+        with events.trace_context(req_span.trace_id, req_span.span_id):
+            handle = eng.submit(toks, max_new_tokens=max_new_tokens,
+                                temperature=temperature, eos_id=eos_id,
+                                deadline_s=deadline_s, hold=True)
+        hspan = events.start_span(
+            "serve.kv_handoff", category="serve",
+            trace_id=req_span.trace_id, parent_span_id=req_span.span_id,
+            prompt_tokens=len(toks), local_tokens=local)
+        try:
+            out = self._call_prefill(toks)
+            if int(out.get("chunk") or 0) != C:
+                raise ValueError(
+                    f"prefill tier chunk={out.get('chunk')} != {C}")
+            payload = self._fetch_payload(out)
+            spans = unpack_kv_spans(payload)
+            covered = min(int(out["covered"]), len(spans) * C)
+            imported = eng.import_kv_blocks(toks[:covered], spans)
+            self._m_handoffs.inc(tags={"outcome": "ok"})
+            self._m_handoff_tokens.inc(max(0, imported))
+            hspan.end(ok=True, covered=covered, imported=imported)
+        except Exception as e:
+            # rung 4: local prefill. Nothing has streamed, so
+            # exactly-once delivery is untouched — the request simply
+            # pays the prefill it would have paid colocated.
+            self._m_handoffs.inc(tags={"outcome": "fallback"})
+            events.record_instant(
+                "serve.kv_handoff_fallback", category="serve",
+                trace_id=req_span.trace_id,
+                parent_span_id=req_span.span_id,
+                error=type(e).__name__)
+            logger.warning("KV hand-off failed; falling back to local "
+                           "prefill: %s", e)
+            hspan.end(ok=False, error=type(e).__name__)
+        finally:
+            eng.release_hold(handle)
+        return handle
+
+
+# ------------------------------------------------------------ app builder
+def build_disagg_app(model="llama-debug", *, decode_replicas: int = 2,
+                     prefill_replicas: int = 1,
+                     prefill_kwargs: Optional[Dict] = None,
+                     decode_kwargs: Optional[Dict] = None,
+                     prefill_deployment_kwargs: Optional[Dict] = None,
+                     decode_deployment_kwargs: Optional[Dict] = None):
+    """Wire the two tiers into one Serve application graph: the decode
+    tier is the ingress, bound to the prefill tier so every decode
+    replica holds a handle to it. ``serve.run(build_disagg_app(...))``
+    is the whole deployment story."""
+    from ray_tpu import serve
+    prefill = serve.deployment(
+        PrefillLLMDeployment, name="prefill", tier="prefill",
+        num_replicas=prefill_replicas,
+        **(prefill_deployment_kwargs or {})).bind(
+            model, **(prefill_kwargs or {}))
+    decode = serve.deployment(
+        DisaggLLMDeployment, tier="decode",
+        num_replicas=decode_replicas,
+        **(decode_deployment_kwargs or {})).bind(
+            model, prefill=prefill, **(decode_kwargs or {}))
+    return decode

@@ -312,6 +312,14 @@ class _Router:
         self.version = -1
         self.resumable = False   # deployment streams accept resume_tokens
         self.coalesced = False   # streams yield token-chunk lists
+        # cluster-wide prefix routing (serve/disagg.py): the deployment
+        # opted in, replica_ids key the GCS prefix_summaries rows onto
+        # routing indices, and _summaries caches {replica_id: fp set}
+        self.prefix_routed = False
+        self.replica_ids: List = []
+        self._summaries: Dict[str, set] = {}
+        self._summary_chunk: Optional[int] = None
+        self._last_summary_refresh = 0.0
         self.lock = threading.Lock()
         self._last_refresh = 0.0
         self.model_map: Dict[str, int] = {}   # multiplexed model -> replica
@@ -325,6 +333,8 @@ class _Router:
             self._last_refresh = time.monotonic()
             self.resumable = bool(info.get("resumable"))
             self.coalesced = bool(info.get("coalesced"))
+            self.prefix_routed = bool(info.get("prefix_routed"))
+            self.replica_ids = list(info.get("replica_ids") or [])
             if info["version"] != self.version:
                 self.version = info["version"]
                 self.replicas = info["replicas"]
@@ -346,6 +356,8 @@ class _Router:
             self._last_refresh = now
             self.resumable = bool(info.get("resumable"))
             self.coalesced = bool(info.get("coalesced"))
+            self.prefix_routed = bool(info.get("prefix_routed"))
+            self.replica_ids = list(info.get("replica_ids") or [])
             if info["version"] != self.version:
                 self.version = info["version"]
                 self.replicas = info["replicas"]
@@ -353,9 +365,64 @@ class _Router:
                 self.model_map.clear()
             self.shared_load = dict(enumerate(info.get("loads") or []))
 
+    def _refresh_summaries(self):
+        """Pull the GCS prefix_summaries rows for this deployment's
+        replicas (throttled to 1 Hz; the rows themselves refresh at
+        cfg.prefix_summary_interval_s and expire at the TTL). Failure
+        just leaves routing on the session-hash/P2C rungs."""
+        now = time.monotonic()
+        if now - self._last_summary_refresh < 1.0:
+            return
+        self._last_summary_refresh = now
+        try:
+            rows = ray_tpu._get_worker().gcs_call(
+                "get_prefix_summaries",
+                ids=[r for r in self.replica_ids if r] or None)
+        except Exception:
+            return
+        summaries: Dict[str, set] = {}
+        chunk = None
+        for row in rows or []:
+            summaries[row["replica_id"]] = set(row.get("fps") or ())
+            chunk = chunk or int(row.get("chunk") or 0)
+        with self.lock:
+            self._summaries = summaries
+            self._summary_chunk = chunk or None
+
+    def _cluster_match_depths(self, prompt_tokens, n: int) -> Dict[int, int]:
+        """{replica_idx: matched chunk depth} over the cached summaries:
+        depth d means the replica's published trie covers the prompt's
+        first d chunks. Pure set intersections — no tokens leave the
+        client, no RPC on this path."""
+        if not self._summaries or not self._summary_chunk:
+            return {}
+        from ray_tpu.inference.prefix_cache import chunk_fingerprints
+        C = self._summary_chunk
+        # same cap as engine admission: the last token always prefills
+        fps = chunk_fingerprints(
+            [int(t) for t in prompt_tokens], C,
+            max_chunks=max(0, (len(prompt_tokens) - 1) // C))
+        if not fps:
+            return {}
+        depths: Dict[int, int] = {}
+        for i in range(n):
+            rid = self.replica_ids[i] if i < len(self.replica_ids) else None
+            s = self._summaries.get(rid)
+            if not s:
+                continue
+            d = 0
+            for j, fp in enumerate(fps):
+                if fp in s:
+                    d = j + 1
+            if d:
+                depths[i] = d
+        return depths
+
     def pick(self, model_id: str = "", session_id: str = "",
-             avoid: Optional[set] = None):
+             avoid: Optional[set] = None, prompt_tokens=None):
         self.refresh()
+        if self.prefix_routed and prompt_tokens is not None:
+            self._refresh_summaries()
         with self.lock:
             n = len(self.replicas)
             if n == 0:
@@ -364,11 +431,36 @@ class _Router:
             score = lambda i: (self.shared_load.get(i, 0)  # noqa: E731
                                + self.inflight.get(i, 0))
             avoid = avoid or set()
+            prefix_depths: Dict[int, int] = {}
+            if self.prefix_routed and prompt_tokens is not None \
+                    and not model_id:
+                prefix_depths = {
+                    i: d for i, d in
+                    self._cluster_match_depths(prompt_tokens, n).items()
+                    if i not in (avoid or set())}
             if model_id and self.model_map.get(model_id, n) < n:
                 # sticky multiplex routing: the replica that loaded this
                 # model keeps serving it (reference: multiplexed replica
                 # preference in the pow-2 scheduler)
                 idx = self.model_map[model_id]
+            elif prefix_depths:
+                # cluster-wide longest-prefix routing (ROADMAP 1c): the
+                # replica whose published trie summary covers the prompt
+                # deepest serves it — N private caches act as one. Ties
+                # break to session affinity when the sticky replica is
+                # among the deepest, else to the least-loaded of them.
+                best = max(prefix_depths.values())
+                winners = [i for i, d in prefix_depths.items()
+                           if d == best]
+                if session_id:
+                    import zlib
+                    sticky = zlib.crc32(str(session_id).encode()) % n
+                    if sticky in winners:
+                        idx = sticky
+                    else:
+                        idx = min(winners, key=score)
+                else:
+                    idx = min(winners, key=score)
             elif session_id:
                 # session affinity (ROADMAP 1c): hash the session onto a
                 # sticky replica so repeat prompts land where their
@@ -437,6 +529,17 @@ class DeploymentHandle:
             kwargs = {**kwargs, "__serve_model_id": model_id}
         session_id = getattr(self, "_session_id", "")
         stream = getattr(self, "_stream", False)
+        # prefix-routed deployments (serve/disagg.py): the prompt is the
+        # streaming call's first positional arg — fingerprint it so the
+        # router can match against the cluster's published trie
+        # summaries. Anything non-tokenlike just skips the rung.
+        prompt = None
+        if self._router.prefix_routed and args \
+                and method in ("__call__", "generate"):
+            try:
+                prompt = [int(t) for t in args[0]]
+            except (TypeError, ValueError):
+                prompt = None
         last_err = None
         avoid: set = set()    # replicas that already failed this call
         from ray_tpu._private import events
@@ -445,7 +548,8 @@ class DeploymentHandle:
                                     deployment=self.deployment_name,
                                     app=self.app_name) as route_span:
                 idx, replica = self._router.pick(model_id, session_id,
-                                                 avoid)
+                                                 avoid,
+                                                 prompt_tokens=prompt)
                 route_span.set(replica=idx)
             try:
                 if stream:

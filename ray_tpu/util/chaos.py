@@ -162,6 +162,53 @@ class BroadcastRelayKiller:
         return e
 
 
+class PrefillExportKiller:
+    """Injects failure into the disaggregated-serving KV hand-off: the
+    prefill tier's ``prefill_export`` runs the injection hook at entry
+    AND right before returning (``serve/disagg.py``), so with
+    probability ``p`` an export dies either before any prefill work or
+    AFTER the payload object exists but before the hand-off completes —
+    the two halves of "prefill replica killed mid-export". The decode
+    tier must fall back to LOCAL prefill with exactly-once token
+    delivery preserved (nothing has streamed when the rung fails).
+
+    Spec: ``RAY_TPU_TESTING_RPC_FAILURE="prefill_export=p"``; like the
+    other RPC-chaos specs it must be in the environment BEFORE the
+    victim process parses it (first injection check caches the spec).
+    Compose with :class:`ServeReplicaKiller` on the prefill deployment
+    to exercise the actor-death (rather than exception) variant."""
+
+    SPEC_ENV = "RAY_TPU_TESTING_RPC_FAILURE"
+
+    def __init__(self, probability: float = 1.0):
+        if not 0.0 < probability <= 1.0:
+            raise ValueError("probability must be in (0, 1]")
+        self.probability = probability
+
+    def spec(self) -> str:
+        return f"prefill_export={self.probability}"
+
+    def env(self, base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+        e = dict(base if base is not None else os.environ)
+        prior = e.get(self.SPEC_ENV)
+        e[self.SPEC_ENV] = f"{prior},{self.spec()}" if prior else self.spec()
+        return e
+
+    def arm_local(self):
+        """Arm the CURRENT process (direct-instantiation tests): sets
+        the env var and resets rpc.py's parsed-spec cache so the next
+        injection check re-reads it. Pair with :meth:`disarm_local`."""
+        from ray_tpu._private import rpc
+        os.environ[self.SPEC_ENV] = self.spec()
+        rpc._CHAOS_SPEC = None
+
+    @staticmethod
+    def disarm_local():
+        from ray_tpu._private import rpc
+        os.environ.pop(PrefillExportKiller.SPEC_ENV, None)
+        rpc._CHAOS_SPEC = None
+
+
 class ServeReplicaKiller:
     """Kill serve replica actors mid-request (streaming included) and
     let the controller's reconcile loop replace them — the serving

@@ -156,6 +156,67 @@ def _run_static(model, params, mesh, reqs, n_slots, vocab):
     return useful / wall
 
 
+def _run_disagg(model, params, spec, reqs, arrivals, n_slots, max_len,
+                prefill_chunk, cache_slots):
+    """Disaggregated split (serve/disagg.py, engine-level): a prefill
+    engine fills KV blocks, a decode engine imports them through the
+    real wire framing (pack/unpack round-trip) and serves the Poisson
+    stream. Returns per-run rates + decode-engine stats + hand-off
+    count — recorded next to the colocated number in the SAME entry."""
+    from ray_tpu.inference import EngineConfig, InferenceEngine
+    from ray_tpu.serve.disagg import pack_kv_spans, unpack_kv_spans
+
+    def mk(slots, pslots):
+        return InferenceEngine(
+            model, params,
+            EngineConfig(n_slots=slots, max_len=max_len,
+                         prefill_chunk=prefill_chunk,
+                         prefill_budget=spec.get("prefill_budget",
+                                                 2 * prefill_chunk),
+                         prefix_cache_slots=pslots)).start()
+
+    pslots = max(1, int(cache_slots))
+    prefill = mk(2, pslots)
+    decode = mk(n_slots, pslots)
+    list(prefill.submit(reqs[0]["prompt"][:4], max_new_tokens=1))
+    list(decode.submit(reqs[0]["prompt"][:4], max_new_tokens=2))
+    C = prefill_chunk
+    handoffs = [0]
+
+    def submit_one(r):
+        toks = [int(t) for t in r["prompt"]]
+        want = (max(0, len(toks) - 1) // C) * C
+        if want and decode.prefix_cache.peek(toks) < want:
+            # cold on the decode tier: prefill-tier fill + hand-off
+            if prefill.prefix_cache.peek(toks) < want:
+                for _ in prefill.submit(toks, max_new_tokens=1):
+                    pass
+            covered, spans = prefill.export_kv_blocks(toks)
+            if covered:
+                payload = pack_kv_spans(spans)
+                decode.import_kv_blocks(toks[:covered],
+                                        unpack_kv_spans(payload))
+                handoffs[0] += 1
+        return decode.submit(toks, max_new_tokens=r["new"])
+
+    rates = []
+    for _ in range(spec.get("runs", 3)):
+        handles = [None] * len(reqs)
+        t0 = time.perf_counter()
+        for i, (r, at) in enumerate(zip(reqs, arrivals)):
+            delay = at - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            handles[i] = submit_one(r)
+        total = sum(len(h.tokens()) for h in handles)
+        rates.append(total / (time.perf_counter() - t0))
+    stats = decode.stats()
+    prefill.stop()
+    decode.stop()
+    rates.sort()
+    return rates, stats, handoffs[0]
+
+
 def run(spec):
     import jax
     import numpy as np
@@ -258,6 +319,30 @@ def run(spec):
             if p95_miss else None,
             "no_prefix_tokens_per_s": round(base_med, 1),
             "vs_no_prefix": round(med / base_med, 3) if base_med else None,
+        })
+    if spec.get("disagg"):
+        # disagg-vs-colocated split (ROADMAP item 1): the same workload
+        # through a prefill-tier/decode-tier pair with real KV hand-off
+        # framing, recorded next to the colocated median above
+        d_rates, d_stats, handoffs = _run_disagg(
+            model, params, spec, reqs, arrivals, n_slots, max_len,
+            prefill_chunk, cache_slots or 2)
+        d_med = d_rates[len(d_rates) // 2]
+        lookups = d_stats.get("prefix_lookups", 0)
+        result.update({
+            "disagg_tokens_per_s": round(d_med, 1),
+            "disagg_runs": [round(r, 1) for r in d_rates],
+            "vs_colocated": round(d_med / med, 3) if med else None,
+            "kv_handoffs": handoffs,
+            "kv_imports": d_stats.get("kv_imports", 0),
+            "remote_prefix_tokens": d_stats.get("remote_prefix_tokens", 0),
+            # fraction of decode-tier admissions that skipped prefill via
+            # the combined local+imported cache — N caches as one
+            "cluster_prefix_hit_rate": round(
+                d_stats.get("prefix_hits", 0) / lookups, 4)
+            if lookups else 0.0,
+            "disagg_decode_compile_count":
+                d_stats.get("decode_compile_count"),
         })
     return result
 

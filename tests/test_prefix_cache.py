@@ -367,6 +367,11 @@ def _router(n):
     r.version = 0
     r.resumable = False
     r.coalesced = False
+    r.prefix_routed = False
+    r.replica_ids = []
+    r._summaries = {}
+    r._summary_chunk = None
+    r._last_summary_refresh = time.monotonic() + 1e6
     r.lock = threading.Lock()
     r._last_refresh = time.monotonic() + 1e6   # never refresh
     r.model_map = {}
