@@ -585,6 +585,39 @@ def bench_serve_availability_under_churn():
     return {"skipped": True, "reason": last}
 
 
+def bench_multi_model_churn():
+    """Multi-model fleet scenario (reports/churn_probe.py multi_model
+    mode, extending serve_availability_under_churn with ROADMAP item
+    3): N deployments share the cluster under zipf traffic across
+    models AND tenants; the coldest model scales to zero and must
+    revive through a pre-warmed shell at least once. Headline is the
+    cold-start p99; the per-tenant p95 split and the admission gate's
+    serve_tenant_shed_total ride in the same entry. The colocated
+    serve_tokens_per_s ratchet (vs_r05) is untouched — this entry
+    measures the fleet plane, not engine throughput. Needs the cluster
+    runtime (Python >= 3.12)."""
+    import os
+    import sys
+    if sys.version_info < (3, 12):
+        return {"skipped": True,
+                "reason": "cluster runtime requires Python >= 3.12"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    runner = os.path.join(here, "reports", "churn_probe.py")
+    spec = {"mode": "multi_model", "n_models": 3, "n_tenants": 4,
+            "n_slots": 2, "n_requests": 24, "arrival_rate_rps": 6.0,
+            "tenant_quota": 2, "tenant_queue_max": 2,
+            "idle_scale_to_zero_s": 2.0, "seed": 0}
+    last = "unknown"
+    for attempt in range(2):
+        if attempt:
+            time.sleep(10)
+        result, last = _run_probe(runner, spec, timeout=1200)
+        if result is not None:
+            return result
+        log(f"multi-model churn probe failed: {last}")
+    return {"skipped": True, "reason": last}
+
+
 def bench_transfer_gb_per_s():
     """Cross-node object-transfer bandwidth (reports/transfer_probe.py):
     a 256 MB object pushed between two single-box node managers over
@@ -1166,6 +1199,34 @@ def main():
         log(f"churn probe FAILED: {e}")
         results["serve_availability_under_churn"] = {
             "skipped": True, "reason": str(e)[:200]}
+
+    try:
+        mmc = bench_multi_model_churn()
+        if not mmc.get("skipped"):
+            results["multi_model_churn"] = {
+                "value": mmc.get("cold_start_p99_ms"),
+                "unit": "cold_start_p99_ms",
+                "revivals": mmc.get("revivals"),
+                "scaled_to_zero": mmc.get("scaled_to_zero"),
+                "cold_start_count": mmc.get("cold_start_count"),
+                "tenant_p95_ms": mmc.get("tenant_p95_ms"),
+                "serve_tenant_shed_total":
+                    mmc.get("serve_tenant_shed_total"),
+                "n_models": mmc.get("n_models"),
+                "n_tenants": mmc.get("n_tenants"),
+                "errors": mmc.get("errors")}
+            log(f"multi_model_churn: cold_start_p99 "
+                f"{mmc.get('cold_start_p99_ms')}ms (revivals "
+                f"{mmc.get('revivals')}, shed "
+                f"{mmc.get('serve_tenant_shed_total')}, errors "
+                f"{mmc.get('errors')})")
+        else:
+            results["multi_model_churn"] = mmc
+            log(f"multi-model churn probe skipped: {mmc.get('reason')}")
+    except Exception as e:
+        log(f"multi-model churn probe FAILED: {e}")
+        results["multi_model_churn"] = {"skipped": True,
+                                        "reason": str(e)[:200]}
 
     try:
         rec = bench_observability_overhead()

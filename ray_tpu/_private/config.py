@@ -262,6 +262,41 @@ _flag("prefix_summary_top_k", int, 128,
       "Fingerprints per published trie summary (most recently touched "
       "first); ~8 bytes each on the wire, so the default is ~1KB per "
       "replica per publish.")
+# Multi-model fleet plane (serve/fleet.py)
+_flag("fleet_shell_pool_size", int, 1,
+      "Pre-warmed replica shells the fleet manager keeps pooled for "
+      "scale-to-zero revivals (process + imports paid; the deployment's "
+      "callable/weights attach at cold start). 0 disables pooling — "
+      "revivals fall back to a cold replica build.")
+_flag("fleet_cold_start_timeout_s", float, 60.0,
+      "How long a router holds requests for a scaled-to-zero deployment "
+      "while a revival is in flight before surfacing no-replicas "
+      "(serve/handle.py hold queue).")
+_flag("fleet_attach_timeout_s", float, 120.0,
+      "Per-shell attach RPC deadline during a revival (callable "
+      "construction + weight load + warmup inside the shell); past it "
+      "the shell is discarded and the next shell (or a cold build) "
+      "serves the revival.")
+_flag("prefix_summary_push", bool, True,
+      "Push prefix_summaries table changes to routers over the serve "
+      "long-poll plane (the controller snapshots the GCS table each "
+      "reconcile tick and bumps listeners on change). Off = routers "
+      "fall back to the 1 Hz GCS pull.")
+# Serve tenancy (serve/fleet.py TenantAdmission; GCS tenant_quotas table)
+_flag("tenant_default_quota", int, 0,
+      "Default per-tenant concurrency quota at the serve ingress "
+      "(max in-flight requests per tenant). <= 0 = unlimited, which "
+      "keeps untagged traffic zero-cost; per-tenant overrides live in "
+      "the GCS tenant_quotas table (serve.set_tenant_quota).")
+_flag("tenant_default_weight", float, 1.0,
+      "Default deficit-round-robin weight for tenants queued at the "
+      "serve ingress; a backlogged tenant's service share is "
+      "proportional to its weight.")
+_flag("tenant_queue_max", int, 64,
+      "Per-tenant ingress wait-queue bound; requests past it are shed "
+      "with 429 + Retry-After instead of collapsing the queue.")
+_flag("tenant_retry_after_s", float, 1.0,
+      "Retry-After hint attached to tenant-quota 429 responses.")
 # Object store: spanning-object spill (weight-distribution plane)
 _flag("span_spill_min_idle_s", float, 5.0,
       "A sealed, unpinned spanning object younger than this is never "

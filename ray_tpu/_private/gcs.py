@@ -92,6 +92,10 @@ class GcsServer:
         # after cfg.prefix_summary_ttl_s so dead replicas fall out of
         # routing within one TTL without explicit teardown
         self.prefix_summaries: Dict[str, Dict] = {}
+        # serve tenancy (serve/fleet.py TenantAdmission): per-tenant
+        # concurrency quota + DRR weight rows; the "__default__" tenant
+        # row moves the fleet-wide defaults. Proxies refresh ~5s.
+        self.tenant_quotas: Dict[str, Dict] = {}
         # time-series plane over report_metrics pushes (metrics_ts.py):
         # bounded per-series rings answering windowed queries (rate /
         # percentiles) that the latest-snapshot table cannot
@@ -145,6 +149,8 @@ class GcsServer:
             "ledger_stats": self.h_ledger_stats,
             "publish_prefix_summary": self.h_publish_prefix_summary,
             "get_prefix_summaries": self.h_get_prefix_summaries,
+            "set_tenant_quota": self.h_set_tenant_quota,
+            "get_tenant_quotas": self.h_get_tenant_quotas,
             "ping": lambda conn: "pong",
         }
         self.server = rpc.Server(handlers, name="gcs")
@@ -193,6 +199,7 @@ class GcsServer:
                              in self.named_actors.items()],
             "actors": {aid: dict(row) for aid, row in self.actors.items()},
             "placement_groups": self.placement_groups,
+            "tenant_quotas": self.tenant_quotas,
         }
 
     def _save_snapshot(self):
@@ -283,6 +290,7 @@ class GcsServer:
             self.named_actors[(ns, name)] = aid
         self.actors.update(snap.get("actors", {}))
         self.placement_groups.update(snap.get("placement_groups", {}))
+        self.tenant_quotas.update(snap.get("tenant_quotas", {}))
         logger.info("restored GCS snapshot from %s (%d kv namespaces, "
                     "%d actors)", self.persist_path, len(self.kv),
                     len(self.actors))
@@ -1031,6 +1039,33 @@ class GcsServer:
         if deployment:
             rows = [r for r in rows if r.get("deployment") == deployment]
         return rows
+
+    # ------------------------------------------------- tenant quotas
+    def h_set_tenant_quota(self, conn, tenant: str,
+                           quota: Optional[int] = None,
+                           weight: Optional[float] = None):
+        """One tenant's fair-share admission row (serve/fleet.py):
+        `quota` caps concurrent in-flight requests at the serve ingress
+        (<= 0 = unlimited), `weight` sets the tenant's DRR share while
+        queued. Partial updates merge; the "__default__" tenant moves
+        the fleet-wide defaults. Bounded at 4096 tenants (stalest rows
+        retire — same discipline as prefix_summaries)."""
+        if not tenant:
+            return False
+        row = self.tenant_quotas.setdefault(tenant, {"tenant": tenant})
+        if quota is not None:
+            row["quota"] = int(quota)
+        if weight is not None:
+            row["weight"] = float(weight)
+        row["ts"] = time.time()
+        if len(self.tenant_quotas) > 4096:
+            for t in sorted(self.tenant_quotas,
+                            key=lambda t: self.tenant_quotas[t]["ts"])[:64]:
+                self.tenant_quotas.pop(t, None)
+        return True
+
+    def h_get_tenant_quotas(self, conn):
+        return list(self.tenant_quotas.values())
 
     # --------------------------------------------------------------- pubsub
     def h_report_metrics(self, conn, worker_id: str, metrics: list,

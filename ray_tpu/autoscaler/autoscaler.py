@@ -35,7 +35,10 @@ class AutoscalerConfig:
     # fold the serve controller's unmet replica demand
     # (ServeController.get_replica_demand) into binpacking, so the
     # provider acquires TPU slices for replicas the serve control loop
-    # wants before their lease requests even reach a node manager
+    # wants before their lease requests even reach a node manager. The
+    # controller keeps these rows honest for the fleet plane: a
+    # deployment shedding burn overflow to its fallback_model, or one
+    # scaled to zero, bids for no slices (serve/fleet.py)
     serve_demand: bool = True
 
 

@@ -30,6 +30,7 @@ class DashboardServer:
         r.add_get("/api/tasks", self._tasks)
         r.add_get("/api/timeline", self._timeline)
         r.add_get("/api/memory", self._memory)
+        r.add_get("/api/fleet", self._fleet)
         r.add_get("/api/runtime_events", self._runtime_events)
         r.add_get("/api/placement_groups", self._pgs)
         r.add_get("/api/jobs", self._jobs)
@@ -168,6 +169,32 @@ class DashboardServer:
                 rows = [r for r in rows if r.get("leaked")]
             return {"objects": rows, "summary": state.memory_summary()}
         return web.json_response(await self._in_thread(fetch))
+
+    async def _fleet(self, request):
+        """Fleet-plane view (serve/fleet.py): scale-to-zero state per
+        deployment, shell-pool occupancy, cold-start percentiles, and
+        configured tenant quotas. 404s when serve isn't running."""
+        from aiohttp import web
+
+        def fetch():
+            import ray_tpu
+            from ray_tpu import serve
+            # probe, don't create: fleet_status() via _get_controller
+            # would START a serve controller on a serve-less cluster
+            ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
+            out = serve.fleet_status()
+            try:
+                quotas = serve.get_tenant_quotas()
+                if quotas:
+                    out["tenant_quotas"] = quotas
+            except Exception:
+                pass
+            return out
+        try:
+            return web.json_response(await self._in_thread(fetch))
+        except Exception as e:
+            return web.json_response(
+                {"error": f"{type(e).__name__}: {e}"}, status=404)
 
     async def _runtime_events(self, request):
         """Raw flight-recorder rows; ?category=engine|store|data|serve

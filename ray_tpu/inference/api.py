@@ -235,6 +235,24 @@ class LLMDeployment:
         them — while queued and in-flight requests run to completion."""
         self.engine.begin_drain()
 
+    def on_shell_attach(self):
+        """Fleet cold-start hook (serve/fleet.py ReplicaShell.attach):
+        runs INSIDE a pre-warmed shell after construction, BEFORE the
+        replica is published to routing tables. One tiny greedy
+        generate forces every fixed-shape XLA program to compile here,
+        so the requests held through the cold start never pay compile
+        latency — serve_cold_start_ms measures weights + compile, TTFT
+        afterwards looks warm. Best-effort: a warmup failure still
+        lets the replica serve (the first request compiles instead)."""
+        try:
+            for _ in self.__call__([1], max_new_tokens=1):
+                pass
+        except Exception:
+            import logging
+            logging.getLogger(__name__).warning(
+                "shell-attach warmup failed; first request will compile",
+                exc_info=True)
+
     def drain_status(self) -> Dict:
         st = self.engine.stats()
         return {"draining": st["draining"],

@@ -447,6 +447,23 @@ def cmd_serve_status(args):
     print(json.dumps(out, indent=2, default=str))
 
 
+def cmd_serve_fleet(args):
+    """Fleet-plane view (serve/fleet.py): per-deployment scale-to-zero
+    state, shell-pool occupancy, revival counts + cold-start
+    percentiles, and configured tenant quotas."""
+    import ray_tpu
+    from ray_tpu import serve
+    ray_tpu.init(address=_load_address(args), ignore_reinit_error=True)
+    out = serve.fleet_status()
+    try:
+        quotas = serve.get_tenant_quotas()
+        if quotas:
+            out["tenant_quotas"] = quotas
+    except Exception:
+        pass
+    print(json.dumps(out, indent=2, default=str))
+
+
 def cmd_serve_delete(args):
     import ray_tpu
     from ray_tpu import serve
@@ -581,6 +598,11 @@ def main(argv=None):
     ss = srv_sub.add_parser("status")
     ss.add_argument("--address", default=None)
     ss.set_defaults(fn=cmd_serve_status)
+    sf = srv_sub.add_parser(
+        "fleet", help="fleet plane: scale-to-zero state, shell pool, "
+                      "cold-start percentiles, tenant quotas")
+    sf.add_argument("--address", default=None)
+    sf.set_defaults(fn=cmd_serve_fleet)
     sdel = srv_sub.add_parser("delete")
     sdel.add_argument("name", nargs="?", default=None)
     sdel.add_argument("--all", action="store_true",

@@ -1,6 +1,7 @@
-from ray_tpu.serve.api import (batch, delete, deployment, get_app_handle,
-                               proxies, run, shutdown, slo_status, start,
-                               status)
+from ray_tpu.serve.api import (batch, delete, deployment, fleet_status,
+                               get_app_handle, get_tenant_quotas, proxies,
+                               run, set_tenant_quota, shutdown, slo_status,
+                               start, status)
 from ray_tpu.serve.grpc_proxy import grpc_call
 from ray_tpu.serve.schema import deploy_from_config
 from ray_tpu.serve.deployment import Application, Deployment
@@ -11,4 +12,5 @@ __all__ = ["deployment", "run", "shutdown", "status", "batch", "delete",
            "get_app_handle", "Deployment", "Application",
            "DeploymentHandle", "DeploymentResponse", "multiplexed",
            "get_multiplexed_model_id", "start", "proxies", "grpc_call",
-           "deploy_from_config", "slo_status"]
+           "deploy_from_config", "slo_status", "fleet_status",
+           "set_tenant_quota", "get_tenant_quotas"]

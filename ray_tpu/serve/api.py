@@ -27,6 +27,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                preempt_grace_s: Optional[float] = None,
                prefix_routed: Optional[bool] = None,
                tier: Optional[str] = None,
+               fallback_model: Optional[str] = None,
                topology: Optional[str] = None, **_ignored):
     def wrap(target):
         # a callable opts into stream resume with __serve_resumable__ =
@@ -50,7 +51,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             num_hosts=num_hosts, topology=topology,
             resumable_streams=bool(resumable),
             coalesce_streams=bool(coalesced),
-            prefix_routed=bool(prefixed), tier=tier)
+            prefix_routed=bool(prefixed), tier=tier,
+            fallback_model=fallback_model)
         if preempt_grace_s is not None:
             cfg.preempt_grace_s = float(preempt_grace_s)
         if autoscaling_config is not None:
@@ -203,6 +205,33 @@ def slo_status() -> Dict:
     ...}]}}."""
     return ray_tpu.get(_get_controller().get_slo_status.remote(),
                        timeout=30)
+
+
+def fleet_status() -> Dict:
+    """Fleet-plane view (serve/fleet.py): per-deployment scale-to-zero
+    state, shell-pool occupancy, revival counts, and cold-start
+    latency percentiles."""
+    return ray_tpu.get(_get_controller().get_fleet_status.remote(),
+                       timeout=30)
+
+
+# ------------------------------------------------------------- tenancy
+def set_tenant_quota(tenant: str, max_concurrent: Optional[int] = None,
+                     weight: Optional[float] = None):
+    """Configure one tenant's fair-share admission at the serve ingress
+    (serve/fleet.py TenantAdmission; GCS ``tenant_quotas`` table):
+    ``max_concurrent`` caps the tenant's in-flight requests (<= 0 =
+    unlimited), ``weight`` sets its deficit-round-robin share while
+    queued. The special tenant ``"__default__"`` moves the fleet-wide
+    defaults. Proxies refresh quotas within ~5s."""
+    return ray_tpu._get_worker().gcs_call(
+        "set_tenant_quota", tenant=tenant, quota=max_concurrent,
+        weight=weight)
+
+
+def get_tenant_quotas() -> List[Dict]:
+    """Configured tenant rows: [{tenant, quota, weight, ts}]."""
+    return ray_tpu._get_worker().gcs_call("get_tenant_quotas")
 
 
 def delete(name: str = "default"):

@@ -87,6 +87,15 @@ class ServeController:
         # one policy instance per (app, deployment)
         self._burn_scalers: Dict[tuple, Any] = {}
         self._target_gauge = None
+        # fleet plane (serve/fleet.py): idle reaper + pre-warmed shell
+        # pool + revival, created lazily when the first deployment opts
+        # into scale-to-zero
+        self._fleet = None
+        # router-side prefix-summary push: the reconcile loop snapshots
+        # the GCS prefix_summaries table and bumps the long-poll key on
+        # change, so routers stop paying the 1 Hz pull
+        self._prefix_rows: List[Dict] = []
+        self._prefix_sig = None
         self._longpoll = threading.Condition()
         self._proxy_reconcile_lock = threading.Lock()
         self._thread = threading.Thread(target=self._reconcile_loop,
@@ -106,6 +115,8 @@ class ServeController:
         if key.startswith("dep:"):
             _, app_name, name = key.split(":", 2)
             return self.get_deployment_info(app_name, name)
+        if key == "prefix_summaries":
+            return {"rows": list(self._prefix_rows)}
         return None
 
     def listen_for_change(self, snapshot: Dict[str, int],
@@ -301,11 +312,14 @@ class ServeController:
             self._create_replicas(dep, n)
         return True
 
-    def _build_replica(self, spec: Dict):
+    def _build_replica(self, spec: Dict, spread_node: Optional[str] = None):
         """Construct one replica (possibly slow — sharded gangs do a
         placement-group wait + jax.distributed init + model load). MUST
         be called without self._lock held. Returns (handle, group) where
-        group is the gang record for sharded replicas, else None."""
+        group is the gang record for sharded replicas, else None.
+        spread_node: anti-affinity hint (serve/fleet.py plan_spread) —
+        soft node affinity, so a full node degrades to the default
+        policy instead of failing the build."""
         import ray_tpu
         if int(spec["config"].get("num_hosts") or 1) > 1:
             # sharded replica = a gang of ReplicaShard actors; routers see
@@ -324,9 +338,31 @@ class ServeController:
             resources=opts.get("resources"))
         if opts.get("runtime_env"):
             a_opts["runtime_env"] = opts["runtime_env"]
+        if spread_node:
+            from ray_tpu.util.scheduling_strategies import \
+                NodeAffinitySchedulingStrategy
+            a_opts["scheduling_strategy"] = NodeAffinitySchedulingStrategy(
+                spread_node, soft=True)
         return actor_cls.options(**a_opts).remote(
             spec["callable"], tuple(spec["init_args"]),
             spec["init_kwargs"], spec["is_function"]), None
+
+    def _plan_spread_node(self, dep: Dict) -> Optional[str]:
+        """Anti-affinity placement for the next replica of `dep`: the
+        alive node hosting the fewest of this deployment's replicas, so
+        one preemption/node loss can't zero a model. None on single-node
+        clusters or when the cluster view is unavailable."""
+        import ray_tpu
+        try:
+            nodes = [n for n in ray_tpu.nodes() if n.get("alive")]
+        except Exception:
+            return None
+        with self._lock:
+            node_of = dep.get("replica_nodes") or {}
+            used = [node_of.get(getattr(r, "_actor_id", None))
+                    for r in dep["replicas"]]
+        from ray_tpu.serve.fleet import plan_spread
+        return plan_spread(nodes, [u for u in used if u])
 
     def _create_replicas(self, dep: Dict, n: int):
         """Build `n` replicas WITHOUT holding the lock, then attach each
@@ -341,8 +377,10 @@ class ServeController:
                 with self._lock:
                     spec = dep["spec"]
                     gen = dep.get("gen", 0)
+                spread = self._plan_spread_node(dep)
                 try:
-                    handle, group = self._build_replica(spec)
+                    handle, group = self._build_replica(spec,
+                                                        spread_node=spread)
                 except Exception:
                     logger.exception("replica build failed for %s/%s "
                                      "(retried next reconcile tick)",
@@ -355,6 +393,9 @@ class ServeController:
                     if alive and not stale:
                         dep["replicas"].append(handle)
                         dep.setdefault("replica_gens", []).append(gen)
+                        if spread:
+                            dep.setdefault("replica_nodes", {})[
+                                getattr(handle, "_actor_id", None)] = spread
                         if group is not None:
                             dep.setdefault("groups", {})[
                                 handle._actor_id] = group
@@ -457,8 +498,57 @@ class ServeController:
                         logger.exception("reconcile failed for %s/%s",
                                          app_name, name)
                 self._reconcile_proxies()
+                self._fleet_tick(items)
+                self._push_prefix_summaries(items)
             except Exception:
                 logger.exception("reconcile loop iteration failed")
+
+    @staticmethod
+    def _wants_scale_to_zero(dep: Dict) -> bool:
+        auto = dep["spec"]["config"].get("autoscaling_config") or {}
+        return (int(auto.get("min_replicas", 1) or 0) == 0
+                and bool(auto.get("idle_scale_to_zero_s")))
+
+    def _fleet_mgr(self):
+        if self._fleet is None:
+            from ray_tpu.serve.fleet import FleetManager
+            self._fleet = FleetManager(self)
+        return self._fleet
+
+    def _fleet_tick(self, items):
+        """Keep the pre-warmed shell pool topped up while any deployment
+        can scale to zero (off the lock; shell spawn is slow)."""
+        want = any(self._wants_scale_to_zero(dep) for _, _, dep in items)
+        if not want and self._fleet is None:
+            return
+        self._fleet_mgr().tick(want)
+
+    def _push_prefix_summaries(self, items):
+        """Satellite of ROADMAP item 1: deliver prefix_summaries to
+        routers over the long-poll plane instead of their 1 Hz GCS pull.
+        The reconcile tick snapshots the GCS table; a changed snapshot
+        bumps the "prefix_summaries" long-poll key (routers that see no
+        push fall back to pulling)."""
+        from ray_tpu._private.config import cfg
+        if not cfg.prefix_summary_push:
+            return
+        if not any(dep["spec"]["config"].get("prefix_routed")
+                   for _, _, dep in items):
+            return
+        import ray_tpu
+        try:
+            rows = ray_tpu._get_worker().gcs_call("get_prefix_summaries")
+        except Exception:
+            return   # routers keep pulling; next tick retries
+        sig = tuple(sorted(
+            (r.get("replica_id"), tuple(r.get("fps") or ()))
+            for r in rows or []))
+        if sig == self._prefix_sig:
+            return
+        with self._lock:
+            self._prefix_sig = sig
+            self._prefix_rows = list(rows or [])
+        self._bump("prefix_summaries")
 
     def _reconcile_one(self, app_name: str, name: str, dep: Dict):
         import ray_tpu
@@ -506,6 +596,12 @@ class ServeController:
                             dep, r, self._preempt_grace(dep))
             self._autoscale(app_name, name, dep, lens)
             self._burn_autoscale(app_name, name, dep, slo_rows, lens)
+            # idle reaper (serve/fleet.py): the ONLY path that takes the
+            # last replica to zero — _autoscale floors at one
+            if self._wants_scale_to_zero(dep) and not dep.get("_creating"):
+                self._fleet_mgr().note_load(
+                    app_name, name, dep,
+                    float(sum(lens)) if lens else 0.0)
             n_create = self._reconcile_deployment(dep)
         # a dead sharded rank-0 leaves peers + a PG behind: tear the
         # gang down — OUTSIDE the lock, kill RPCs can block on slow
@@ -537,8 +633,14 @@ class ServeController:
         key = (app_name, name)
         now = time.monotonic()
         hist = self._load_hist.setdefault(key, collections.deque())
+        # min_replicas=0 floors at ONE replica here: only the fleet
+        # manager's idle reaper (serve/fleet.py, idle_scale_to_zero_s)
+        # takes the last step to zero, after the full idle window
+        auto_eff = auto
+        if int(auto.get("min_replicas", 1) or 0) < 1:
+            auto_eff = {**auto, "min_replicas": 1}
         dep["target"] = autoscale_decision(
-            auto, hist, float(sum(lens)), dep["target"], now,
+            auto_eff, hist, float(sum(lens)), dep["target"], now,
             self._up_since, self._down_since, key)
 
     def _burn_autoscale(self, app_name, name, dep, rows, lens=None):
@@ -557,6 +659,32 @@ class ServeController:
         total_load = float(sum(lens)) if lens else 0.0
         new_target = scaler.decide(auto, rows, dep["target"], total_load,
                                    time.monotonic())
+        if new_target > dep["target"]:
+            # burn-aware shedding (serve/fleet.py): a deployment with a
+            # fallback whose replicas still have headroom sheds overflow
+            # there (the handle layer routes it) instead of asking the
+            # cluster autoscaler for new slices — replica churn and
+            # slice acquisition are the most expensive moves a TPU
+            # fleet can make
+            fb_name = dep["spec"]["config"].get("fallback_model")
+            fb = self.apps.get(app_name, {}).get(fb_name) \
+                if fb_name else None
+            if fb is not None:
+                from ray_tpu.serve.fleet import fallback_has_headroom
+                if fallback_has_headroom(fb):
+                    if not dep.get("shed_active"):
+                        from ray_tpu._private import events
+                        events.record_instant(
+                            "serve.burn_shed", category="serve",
+                            app=app_name, deployment=name,
+                            fallback=fb_name, target=dep["target"])
+                        logger.info(
+                            "burn shed %s/%s: overflow -> %s instead of "
+                            "target %d -> %d", app_name, name, fb_name,
+                            dep["target"], new_target)
+                    dep["shed_active"] = True
+                    return
+        dep["shed_active"] = False
         if new_target == dep["target"]:
             return
         from ray_tpu._private import events
@@ -603,6 +731,10 @@ class ServeController:
         with self._lock:
             for app in self.apps.values():
                 for dep in app.values():
+                    if dep.get("shed_active"):
+                        # burn overflow is being shed to the fallback
+                        # (serve/fleet.py): don't also bid for slices
+                        continue
                     deficit = dep["target"] - len(dep["replicas"])
                     if deficit <= 0:
                         continue
@@ -817,8 +949,51 @@ class ServeController:
                     "prefix_routed": bool(dep["spec"]["config"]
                                           .get("prefix_routed")),
                     "tier": dep["spec"]["config"].get("tier"),
+                    # fleet plane (serve/fleet.py): an empty replica set
+                    # on a scale_to_zero deployment makes the router
+                    # hold + request revival instead of erroring; the
+                    # fallback/max_ongoing pair drives overflow shedding
+                    "scale_to_zero": self._wants_scale_to_zero(dep),
+                    "fallback": dep["spec"]["config"]
+                    .get("fallback_model"),
+                    "max_ongoing": int(dep["spec"]["config"]
+                                       .get("max_ongoing_requests", 0)
+                                       or 0),
                     "replica_ids": [getattr(r, "_actor_id", None)
                                     for r in dep["replicas"]]}
+
+    def revive_deployment(self, app_name: str, name: str) -> bool:
+        """Router-requested cold start for a scaled-to-zero deployment
+        (serve/fleet.py). Idempotent: concurrent calls while a revival
+        is in flight (or once replicas exist) return True immediately —
+        callers keep polling the routing table, which updates the
+        moment the revived replica is published."""
+        return self._fleet_mgr().revive(app_name, name)
+
+    def get_fleet_status(self) -> Dict:
+        """Fleet-plane view: per-deployment scale-to-zero state plus
+        shell-pool / revival / cold-start stats."""
+        with self._lock:
+            deployments = {
+                app_name: {
+                    name: {
+                        "target": dep["target"],
+                        "running": len(dep["replicas"]),
+                        "scale_to_zero": self._wants_scale_to_zero(dep),
+                        "scaled_to_zero": (
+                            self._wants_scale_to_zero(dep)
+                            and dep["target"] == 0),
+                        "fallback": dep["spec"]["config"]
+                        .get("fallback_model"),
+                        "shed_active": bool(dep.get("shed_active")),
+                        "tier": dep["spec"]["config"].get("tier"),
+                    }
+                    for name, dep in app.items()}
+                for app_name, app in self.apps.items()}
+        out = {"deployments": deployments}
+        if self._fleet is not None:
+            out["fleet"] = self._fleet.status()
+        return out
 
     def get_status(self) -> Dict:
         with self._lock:

@@ -32,6 +32,14 @@ class AutoscalingConfig:
     burn_downscale_idle_s: float = 60.0
     burn_cooldown_s: float = 30.0
     burn_release_threshold: float = 0.25
+    # scale-to-zero (serve/fleet.py): with min_replicas=0 AND this set,
+    # the fleet manager reaps the LAST replica after the probed load has
+    # been zero for this many seconds; the ordinary autoscaling policy
+    # floors at one replica so the idle reaper is the only path to zero.
+    # Revival goes through the pre-warmed shell pool on first request
+    # (cold-start p99 exported as serve_cold_start_ms). None = never
+    # scale to zero, even at min_replicas=0.
+    idle_scale_to_zero_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -76,6 +84,13 @@ class DeploymentConfig:
     # the replica whose published trie summary matches deepest, with
     # session-hash fallback on ties/misses
     prefix_routed: bool = False
+    # burn-aware shedding (serve/fleet.py): name of a deployment in the
+    # SAME application (smaller model, same API) that absorbs overflow.
+    # When this deployment's replicas are saturated the handle routes
+    # new requests down the fallback ladder, and the controller's burn
+    # loop prefers shedding over asking the cluster autoscaler for new
+    # slices while the fallback has headroom.
+    fallback_model: Optional[str] = None
     # disaggregated-serving tier label ("prefill" / "decode" / None):
     # informational for status surfaces, and the unit independent
     # autoscaling operates on — each tier is its own deployment, so
@@ -104,10 +119,13 @@ class Deployment:
                 ray_actor_options: Optional[Dict] = None,
                 autoscaling_config=None, slo_config=None,
                 num_hosts: Optional[int] = None,
+                fallback_model: Optional[str] = None,
                 topology: Optional[str] = None) -> "Deployment":
         cfg = dataclasses.replace(self.config)
         if slo_config is not None:
             cfg.slo_config = _coerce_slo(slo_config)
+        if fallback_model is not None:
+            cfg.fallback_model = fallback_model
         if num_replicas is not None:
             cfg.num_replicas = num_replicas
         if num_hosts is not None:

@@ -273,6 +273,7 @@ class _LongPollClient:
 
     def __init__(self):
         self._routers: Dict[str, List] = {}
+        self._summary_routers: Dict[str, List] = {}
         self._versions: Dict[str, int] = {}
         self._reg_lock = threading.Lock()
         self._stopped = False
@@ -285,6 +286,16 @@ class _LongPollClient:
             self._routers.setdefault(key, []).append(router)
             self._versions.setdefault(key, -1)
 
+    def watch_summaries(self, router: "_Router"):
+        """Subscribe a prefix-routed router to pushed prefix summaries
+        (the controller bumps the "prefix_summaries" key when the GCS
+        table changes — ROADMAP item 1's push satellite). Idempotent."""
+        with self._reg_lock:
+            lst = self._summary_routers.setdefault("prefix_summaries", [])
+            if router not in lst:
+                lst.append(router)
+            self._versions.setdefault("prefix_summaries", -1)
+
     def _loop(self):
         from ray_tpu.serve.long_poll import run_longpoll_loop
 
@@ -293,6 +304,12 @@ class _LongPollClient:
             return _get_controller()
 
         def on_update(key, data):
+            if key == "prefix_summaries":
+                with self._reg_lock:
+                    routers = list(self._summary_routers.get(key, []))
+                for r in routers:
+                    r._apply_summary_push((data or {}).get("rows") or [])
+                return
             with self._reg_lock:
                 routers = list(self._routers.get(key, []))
             for r in routers:
@@ -320,6 +337,15 @@ class _Router:
         self._summaries: Dict[str, set] = {}
         self._summary_chunk: Optional[int] = None
         self._last_summary_refresh = 0.0
+        self._summary_push_t = 0.0    # last long-poll summary push
+        self._watching_summaries = False
+        # fleet plane (serve/fleet.py): scale-to-zero deployments hold
+        # callers instead of erroring on an empty replica set; fallback
+        # + max_ongoing drive overflow shedding down the fallback ladder
+        self.scale_to_zero = False
+        self.fallback: Optional[str] = None
+        self.max_ongoing = 0
+        self._revive_t = 0.0          # last revive request (throttle)
         self.lock = threading.Lock()
         self._last_refresh = 0.0
         self.model_map: Dict[str, int] = {}   # multiplexed model -> replica
@@ -328,19 +354,40 @@ class _Router:
         except Exception:
             pass   # push is an optimization; polling still works
 
+    def _ingest(self, info: Dict, now: float):
+        """Fold one controller get_deployment_info payload in (shared by
+        the long-poll push and the polling refresh). Caller holds
+        self.lock."""
+        self._last_refresh = now
+        self.resumable = bool(info.get("resumable"))
+        self.coalesced = bool(info.get("coalesced"))
+        self.prefix_routed = bool(info.get("prefix_routed"))
+        self.replica_ids = list(info.get("replica_ids") or [])
+        self.scale_to_zero = bool(info.get("scale_to_zero"))
+        self.fallback = info.get("fallback") or None
+        self.max_ongoing = int(info.get("max_ongoing") or 0)
+        if info["version"] != self.version:
+            self.version = info["version"]
+            self.replicas = info["replicas"]
+            self.inflight = {i: 0 for i in range(len(self.replicas))}
+            self.model_map.clear()
+        self.shared_load = dict(enumerate(info.get("loads") or []))
+
+    def _watch_summaries_once(self):
+        if self._watching_summaries:
+            return
+        self._watching_summaries = True
+        try:
+            _LongPollClient.get().watch_summaries(self)
+        except Exception:
+            pass   # pull fallback still works
+
     def _apply_push(self, info: Dict):
         with self.lock:
-            self._last_refresh = time.monotonic()
-            self.resumable = bool(info.get("resumable"))
-            self.coalesced = bool(info.get("coalesced"))
-            self.prefix_routed = bool(info.get("prefix_routed"))
-            self.replica_ids = list(info.get("replica_ids") or [])
-            if info["version"] != self.version:
-                self.version = info["version"]
-                self.replicas = info["replicas"]
-                self.inflight = {i: 0 for i in range(len(self.replicas))}
-                self.model_map.clear()
-            self.shared_load = dict(enumerate(info.get("loads") or []))
+            self._ingest(info, time.monotonic())
+            prefix = self.prefix_routed
+        if prefix:
+            self._watch_summaries_once()
 
     def _controller(self):
         from ray_tpu.serve.api import _get_controller
@@ -353,24 +400,40 @@ class _Router:
         info = ray_tpu.get(self._controller().get_deployment_info.remote(
             self.app_name, self.deployment_name), timeout=30)
         with self.lock:
-            self._last_refresh = now
-            self.resumable = bool(info.get("resumable"))
-            self.coalesced = bool(info.get("coalesced"))
-            self.prefix_routed = bool(info.get("prefix_routed"))
-            self.replica_ids = list(info.get("replica_ids") or [])
-            if info["version"] != self.version:
-                self.version = info["version"]
-                self.replicas = info["replicas"]
-                self.inflight = {i: 0 for i in range(len(self.replicas))}
-                self.model_map.clear()
-            self.shared_load = dict(enumerate(info.get("loads") or []))
+            self._ingest(info, now)
+            prefix = self.prefix_routed
+        if prefix:
+            self._watch_summaries_once()
+
+    def _apply_summary_push(self, rows: List[Dict]):
+        """Prefix summaries arriving over the long-poll plane (the
+        controller snapshots the GCS table each reconcile tick). While
+        pushes keep coming the 1 Hz GCS pull is suppressed — the push
+        path replaces it, it doesn't stack on top."""
+        summaries: Dict[str, set] = {}
+        chunk = None
+        for row in rows or []:
+            summaries[row["replica_id"]] = set(row.get("fps") or ())
+            chunk = chunk or int(row.get("chunk") or 0)
+        with self.lock:
+            mine = set(r for r in self.replica_ids if r)
+            self._summaries = {rid: s for rid, s in summaries.items()
+                               if not mine or rid in mine}
+            self._summary_chunk = chunk or None
+            self._summary_push_t = time.monotonic()
 
     def _refresh_summaries(self):
         """Pull the GCS prefix_summaries rows for this deployment's
         replicas (throttled to 1 Hz; the rows themselves refresh at
-        cfg.prefix_summary_interval_s and expire at the TTL). Failure
-        just leaves routing on the session-hash/P2C rungs."""
+        cfg.prefix_summary_interval_s and expire at the TTL). Skipped
+        while long-poll pushes are fresh (_apply_summary_push) — the
+        pull is the fallback for when the push plane is unavailable.
+        Failure just leaves routing on the session-hash/P2C rungs."""
         now = time.monotonic()
+        from ray_tpu._private.config import cfg
+        if now - getattr(self, "_summary_push_t", 0.0) < 2.0 * max(
+                1.0, cfg.prefix_summary_interval_s):
+            return
         if now - self._last_summary_refresh < 1.0:
             return
         self._last_summary_refresh = now
@@ -418,11 +481,65 @@ class _Router:
                 depths[i] = d
         return depths
 
+    def overloaded(self) -> bool:
+        """True when every replica sits at (or past) its
+        max_ongoing_requests — the shed trigger for deployments with a
+        fallback_model. A zero-replica set counts as overloaded (there
+        is nothing to serve; a scale-to-zero revival may be warming in
+        parallel). max_ongoing unknown (0) never reads overloaded."""
+        with self.lock:
+            n = len(self.replicas)
+            if n == 0:
+                return True
+            if not self.max_ongoing:
+                return False
+            load = sum(self.shared_load.get(i, 0)
+                       + self.inflight.get(i, 0) for i in range(n))
+            return load >= n * self.max_ongoing
+
+    def _request_revive(self):
+        """Ask the controller to cold-start this deployment (throttled
+        to 1/s; the revival itself is idempotent controller-side)."""
+        now = time.monotonic()
+        if now - self._revive_t < 1.0:
+            return
+        self._revive_t = now
+        try:
+            ray_tpu.get(self._controller().revive_deployment.remote(
+                self.app_name, self.deployment_name), timeout=10)
+        except Exception:
+            pass   # the next poll retries
+
+    def _hold_for_revival(self):
+        """Handle-level hold queue (serve/fleet.py; the analog of the
+        scheduler's ``submit(hold=)`` remote-prefill state): callers of
+        a scaled-to-zero deployment park HERE — request submitted zero
+        times — while the fleet manager attaches a pre-warmed shell.
+        They release the moment the revived replica is published to the
+        routing table, so every held request is dispatched exactly
+        once, to a replica that actually exists. Returns when replicas
+        appear; on timeout the caller falls through to the ordinary
+        no-replica error."""
+        from ray_tpu._private.config import cfg
+        deadline = time.monotonic() + cfg.fleet_cold_start_timeout_s
+        while time.monotonic() < deadline:
+            with self.lock:
+                if self.replicas:
+                    return
+            self._request_revive()
+            time.sleep(0.1)
+            try:
+                self.refresh(force=True)
+            except Exception:
+                pass   # controller briefly unreachable: keep holding
+
     def pick(self, model_id: str = "", session_id: str = "",
              avoid: Optional[set] = None, prompt_tokens=None):
         self.refresh()
         if self.prefix_routed and prompt_tokens is not None:
             self._refresh_summaries()
+        if not self.replicas and getattr(self, "scale_to_zero", False):
+            self._hold_for_revival()
         with self.lock:
             n = len(self.replicas)
             if n == 0:
@@ -518,7 +635,18 @@ class DeploymentHandle:
 
     def _invoke(self, method: str, args, kwargs,
                 retry: int = 2,
-                allow_resubmit: bool = True) -> DeploymentResponse:
+                allow_resubmit: bool = True,
+                shed_depth: int = 0) -> DeploymentResponse:
+        # burn-aware shedding (serve/fleet.py): a saturated deployment
+        # with a fallback_model hands NEW requests down the fallback
+        # ladder (each rung may shed again; depth-capped so a cycle in
+        # the ladder cannot loop). Resubmits of accepted requests never
+        # shed — exactly-once stays with the original deployment.
+        if allow_resubmit:
+            shed = self._maybe_shed(method, args, kwargs, retry,
+                                    shed_depth)
+            if shed is not None:
+                return shed
         # unwrap nested responses so replicas receive resolved values
         args = tuple(a._object_ref if isinstance(a, DeploymentResponse)
                      else a for a in args)
@@ -527,6 +655,11 @@ class DeploymentHandle:
         model_id = getattr(self, "_model_id", "")
         if model_id:
             kwargs = {**kwargs, "__serve_model_id": model_id}
+        tenant = getattr(self, "_tenant", "")
+        if tenant:
+            # fair-share routing metadata (serve/fleet.py): the replica
+            # pops it; proxy-side admission enforces quotas
+            kwargs = {**kwargs, "__serve_tenant": tenant}
         session_id = getattr(self, "_session_id", "")
         stream = getattr(self, "_stream", False)
         # prefix-routed deployments (serve/disagg.py): the prompt is the
@@ -583,6 +716,46 @@ class DeploymentHandle:
                 last_err = e
         raise last_err
 
+    MAX_SHED_DEPTH = 4
+
+    def _maybe_shed(self, method, args, kwargs, retry, shed_depth):
+        """One rung of the fallback ladder: when this deployment is
+        saturated (router.overloaded()) and declares a fallback_model,
+        route the request there instead of queueing into the overload.
+        Returns None to serve locally. A scaled-to-zero primary also
+        kicks its revival here, so the fallback absorbs traffic WHILE
+        the primary warms — burn-aware shedding's whole point."""
+        r = self._router
+        if not r.fallback or shed_depth >= self.MAX_SHED_DEPTH:
+            return None
+        try:
+            r.refresh()
+        except Exception:
+            return None
+        if not r.overloaded():
+            return None
+        if r.scale_to_zero and not r.replicas:
+            r._request_revive()
+        from ray_tpu.serve.fleet import record_fallback_shed
+        record_fallback_shed(self.deployment_name, r.fallback,
+                             app=self.app_name)
+        return self._fallback_handle()._invoke(
+            method, args, kwargs, retry=retry,
+            shed_depth=shed_depth + 1)
+
+    def _fallback_handle(self) -> "DeploymentHandle":
+        fb = getattr(self, "_fb_handle", None)
+        if fb is None or fb.deployment_name != self._router.fallback:
+            fb = DeploymentHandle(self._router.fallback, self.app_name)
+            self._fb_handle = fb
+        # carry the caller's traits (stream/session/tenant/model) down
+        # the ladder so the fallback serves the same call shape
+        return fb.options(
+            multiplexed_model_id=getattr(self, "_model_id", ""),
+            stream=getattr(self, "_stream", False),
+            session_id=getattr(self, "_session_id", ""),
+            tenant=getattr(self, "_tenant", ""))
+
     def _make_stream_resume(self, method, args, kwargs, retry):
         """One-shot re-route for a stream severed by replica death (the
         streaming counterpart of DeploymentResponse's resubmit). Returns
@@ -613,8 +786,9 @@ class DeploymentHandle:
 
     def options(self, *, multiplexed_model_id: str = "",
                 stream: bool = False, session_id: str = "",
-                **_kw) -> "DeploymentHandle":
-        if not multiplexed_model_id and not stream and not session_id:
+                tenant: str = "", **_kw) -> "DeploymentHandle":
+        if not multiplexed_model_id and not stream and not session_id \
+                and not tenant:
             return self
         clone = DeploymentHandle(self.deployment_name, self.app_name)
         clone._router = self._router          # share routing state
@@ -624,12 +798,18 @@ class DeploymentHandle:
             # sticky-session routing: calls through this handle hash to
             # one replica so repeat prompts hit its prefix cache
             clone._session_id = str(session_id)
+        if tenant:
+            # fair-share admission identity (serve/fleet.py): the
+            # HTTP-header analog is X-RayTPU-Tenant at the proxy
+            clone._tenant = str(tenant)
         # a handle derived twice (options().options()) keeps its traits
         clone._stream = stream or getattr(self, "_stream", False)
         if not session_id and getattr(self, "_session_id", ""):
             clone._session_id = self._session_id
         if not multiplexed_model_id and getattr(self, "_model_id", ""):
             clone._model_id = self._model_id
+        if not tenant and getattr(self, "_tenant", ""):
+            clone._tenant = self._tenant
         return clone
 
     def __reduce__(self):

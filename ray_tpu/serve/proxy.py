@@ -22,6 +22,7 @@ class HttpProxy:
         self.ingress: Dict[str, str] = {}     # app_name -> deployment
         self._versions = {"routes": 0}
         self._handles = {}
+        self._adm = None                       # lazy TenantAdmission
         self._addr: Optional[str] = None
         from ray_tpu._private.worker import global_worker
         asyncio.run_coroutine_threadsafe(
@@ -83,6 +84,44 @@ class HttpProxy:
             self._handles[app_name] = h
         return h
 
+    # ------------------------------------------------- tenant admission
+    def _admission(self):
+        if self._adm is None:
+            from ray_tpu.serve.fleet import TenantAdmission
+            self._adm = TenantAdmission()
+        return self._adm
+
+    @staticmethod
+    def _fetch_quotas():
+        import ray_tpu
+        return ray_tpu._get_worker().gcs_call("get_tenant_quotas")
+
+    @staticmethod
+    def _tenant_of(request, payload) -> str:
+        """X-RayTPU-Tenant header, falling back to a `tenant` field in a
+        JSON payload (forwarded untouched either way)."""
+        t = request.headers.get("X-RayTPU-Tenant", "")
+        if not t and isinstance(payload, dict):
+            t = str(payload.get("tenant") or "")
+        return t
+
+    def _acquire_tenant(self, tenant: str):
+        """Blocking fair-share admission (serve/fleet.py): runs on an
+        executor thread, never this event loop. Raises
+        TenantQuotaExceeded for over-quota work — mapped to 429 +
+        Retry-After by the caller."""
+        adm = self._admission()
+        adm.maybe_refresh(self._fetch_quotas)
+        return adm.acquire(tenant)
+
+    @staticmethod
+    def _shed_response(e):
+        from aiohttp import web
+        return web.Response(
+            status=429,
+            text=f"tenant {e.tenant!r} over quota",
+            headers={"Retry-After": str(max(1, int(e.retry_after_s)))})
+
     @staticmethod
     def _incoming_trace(request):
         """W3C traceparent (`00-<trace32>-<span16>-<flags>`): an
@@ -124,6 +163,20 @@ class HttpProxy:
             session_id = str(payload.get("session_id") or "")
         if session_id:
             handle = handle.options(session_id=session_id)
+        # per-tenant fair-share admission (serve/fleet.py): DRR queueing
+        # under concurrency quotas, over-quota work shed with 429 +
+        # Retry-After BEFORE it can collapse the replica queues. The
+        # blocking acquire runs on an executor thread.
+        loop = asyncio.get_event_loop()
+        tenant = self._tenant_of(request, payload)
+        from ray_tpu.serve.fleet import TenantQuotaExceeded
+        try:
+            lease = await loop.run_in_executor(
+                None, self._acquire_tenant, tenant)
+        except TenantQuotaExceeded as e:
+            return self._shed_response(e)
+        if tenant:
+            handle = handle.options(tenant=tenant)
         # the request's root span: every downstream phase (replica task,
         # engine slot, first token) parents under it because the handle
         # call below submits inside its trace context
@@ -131,12 +184,14 @@ class HttpProxy:
         span = events.start_span("proxy.request", category="serve",
                                  trace_id=trace_id, parent_span_id=parent,
                                  method=request.method, path=path,
-                                 app=app_name)
+                                 app=app_name, tenant=tenant or None)
         if (request.headers.get("X-RayTPU-Stream") == "1"
                 or "text/event-stream" in request.headers.get("Accept", "")):
-            return await self._handle_streaming(request, handle, payload,
-                                                span)
-        loop = asyncio.get_event_loop()
+            try:
+                return await self._handle_streaming(request, handle,
+                                                    payload, span)
+            finally:
+                lease.release()
 
         def _call():
             # routing + submit use the sync API; keep them off this loop.
@@ -149,6 +204,8 @@ class HttpProxy:
         except Exception as e:
             span.end(status=500, error=type(e).__name__)
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        finally:
+            lease.release()
         span.end(status=200)
         if isinstance(result, (dict, list)):
             return web.json_response(result)

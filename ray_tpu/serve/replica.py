@@ -27,6 +27,22 @@ class ReplicaDrainingError(Exception):
 class Replica:
     def __init__(self, serialized_callable: bytes, init_args: Tuple,
                  init_kwargs: Dict, is_function: bool):
+        self._init_state()
+        self._init_callable(serialized_callable, init_args, init_kwargs,
+                            is_function)
+
+    # split so a pre-warmed ReplicaShell (serve/fleet.py) can pay the
+    # process/import cost at pool time and run the callable
+    # construction later, at attach
+    def _init_state(self):
+        self._callable = None
+        self._is_function = False
+        self._ongoing = 0
+        self._lock = threading.Lock()
+        self._draining = False
+
+    def _init_callable(self, serialized_callable: bytes, init_args: Tuple,
+                       init_kwargs: Dict, is_function: bool):
         import cloudpickle
         target = cloudpickle.loads(serialized_callable)
         self._is_function = is_function
@@ -34,9 +50,6 @@ class Replica:
             self._callable = target
         else:
             self._callable = target(*init_args, **init_kwargs)
-        self._ongoing = 0
-        self._lock = threading.Lock()
-        self._draining = False
         # spot preemption notices: on GCE (or under chaos injection) a
         # watcher polls the metadata channel and flips this replica into
         # draining before the platform kills the VM — the controller
@@ -99,6 +112,7 @@ class Replica:
         kwargs = {k: (ray_tpu.get(v) if isinstance(v, ObjectRef) else v)
                   for k, v in kwargs.items()}
         model_id = kwargs.pop("__serve_model_id", "")
+        kwargs.pop("__serve_tenant", "")   # routing metadata, not an arg
         from ray_tpu._private import events
         with self._lock:
             self._ongoing += 1
@@ -156,6 +170,7 @@ class Replica:
             raise ReplicaDrainingError(
                 "replica is draining (preemption notice); re-route")
         model_id = kwargs.pop("__serve_model_id", "")
+        kwargs.pop("__serve_tenant", "")
         with self._lock:
             self._ongoing += 1
         # the body's first resumption runs under the streaming task's

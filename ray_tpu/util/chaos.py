@@ -209,6 +209,53 @@ class PrefillExportKiller:
         rpc._CHAOS_SPEC = None
 
 
+class ShellAttachKiller:
+    """Injects failure into the fleet plane's cold-start path: a
+    pre-warmed replica shell's ``attach`` (serve/fleet.py ReplicaShell)
+    runs the injection hook at entry AND after the callable is
+    constructed but before the shell reports ready — the two halves of
+    "shell killed mid-weight-attach". The fleet manager must discard
+    the poisoned shell and serve the revival through a FRESH shell or a
+    cold replica build; requests held at the router (they are parked
+    un-submitted until a replica is published) are therefore delivered
+    exactly once either way.
+
+    Spec: ``RAY_TPU_TESTING_RPC_FAILURE="shell_attach=p"``; like the
+    other RPC-chaos specs the env must be set before the victim process
+    parses it (first injection check caches the spec). ``arm_local`` /
+    ``disarm_local`` reset the cache for in-process tests."""
+
+    SPEC_ENV = "RAY_TPU_TESTING_RPC_FAILURE"
+
+    def __init__(self, probability: float = 1.0):
+        if not 0.0 < probability <= 1.0:
+            raise ValueError("probability must be in (0, 1]")
+        self.probability = probability
+
+    def spec(self) -> str:
+        return f"shell_attach={self.probability}"
+
+    def env(self, base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+        e = dict(base if base is not None else os.environ)
+        prior = e.get(self.SPEC_ENV)
+        e[self.SPEC_ENV] = f"{prior},{self.spec()}" if prior else self.spec()
+        return e
+
+    def arm_local(self):
+        """Arm the CURRENT process (direct-instantiation tests): sets
+        the env var and resets rpc.py's parsed-spec cache so the next
+        injection check re-reads it. Pair with :meth:`disarm_local`."""
+        from ray_tpu._private import rpc
+        os.environ[self.SPEC_ENV] = self.spec()
+        rpc._CHAOS_SPEC = None
+
+    @staticmethod
+    def disarm_local():
+        from ray_tpu._private import rpc
+        os.environ.pop(ShellAttachKiller.SPEC_ENV, None)
+        rpc._CHAOS_SPEC = None
+
+
 class ServeReplicaKiller:
     """Kill serve replica actors mid-request (streaming included) and
     let the controller's reconcile loop replace them — the serving

@@ -227,6 +227,142 @@ def run(spec):
         ray_tpu.shutdown()
 
 
+def run_multi_model(spec):
+    """Multi-model fleet churn (bench `multi_model_churn`, extending
+    `serve_availability_under_churn` with ROADMAP item 3's scenario):
+    N tiny-model deployments share one cluster, zipf traffic across
+    models AND tenants, the coldest model scales to zero and must
+    revive through a pre-warmed shell at least once. Reported:
+
+      cold_start_p99_ms      fleet-view revival latency percentile
+      revivals               scale-to-zero revivals observed (>= 1)
+      tenant_p95_ms          per-tenant client-side p95 split
+      serve_tenant_shed_total  requests shed by the admission gate
+      errors                 failed streams (expected 0)
+
+    Tenancy runs through the real ingress component (serve/fleet.py
+    TenantAdmission — the same object the HTTP proxy runs), driven
+    directly so the probe sheds deterministically without an HTTP hop.
+    """
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.inference import LLMDeployment
+    from ray_tpu.serve.fleet import TenantAdmission, TenantQuotaExceeded
+
+    n_models = int(spec.get("n_models", 3))
+    n_tenants = int(spec.get("n_tenants", 4))
+    n_requests = int(spec.get("n_requests", 24))
+    idle_s = float(spec.get("idle_scale_to_zero_s", 2.0))
+    rng = np.random.default_rng(spec.get("seed", 0))
+    ray_tpu.init(num_cpus=max(4, 2 * n_models))
+    try:
+        cold_app = f"m{n_models - 1}"
+        handles = {}
+        for i in range(n_models):
+            app = f"m{i}"
+            dep = serve.deployment(LLMDeployment, name=f"llm{i}",
+                                   num_replicas=1)
+            if app == cold_app:
+                dep = dep.options(autoscaling_config={
+                    "min_replicas": 0, "max_replicas": 1,
+                    "target_ongoing_requests": 2.0,
+                    "look_back_period_s": 1.0, "downscale_delay_s": 0.5,
+                    "idle_scale_to_zero_s": idle_s})
+            serve.run(dep.bind(_tiny_cfg(), n_slots=spec.get("n_slots", 2),
+                               max_len=512, prefill_chunk=8,
+                               prefill_budget=16), name=app)
+            handles[app] = serve.get_app_handle(app)
+        for h in handles.values():   # warm compiles out of the timings
+            list(h.options(stream=True).remote([1, 2], max_new_tokens=2))
+
+        # idle the cold model past its window -> scale-to-zero
+        deadline = time.time() + 90
+        scaled = False
+        while time.time() < deadline:
+            st = serve.status()[cold_app][f"llm{n_models - 1}"]
+            if st["running"] == 0 and st["target"] == 0:
+                scaled = True
+                break
+            time.sleep(0.5)
+
+        # zipf traffic over models (m0 hottest) and tenants (t0 hottest)
+        # through the real admission gate; the hot tenant's quota forces
+        # shedding under its own burst, never the quiet tenants'
+        adm = TenantAdmission(default_quota=int(spec.get("tenant_quota", 2)),
+                              queue_max=int(spec.get("tenant_queue_max", 2)))
+        zm = (1.0 / np.arange(1, n_models + 1)) ** 1.1
+        zt = (1.0 / np.arange(1, n_tenants + 1)) ** 1.1
+        picks_m = rng.choice(n_models, size=n_requests, p=zm / zm.sum())
+        picks_t = rng.choice(n_tenants, size=n_requests, p=zt / zt.sum())
+        picks_m[-1] = n_models - 1      # the cold model IS exercised
+        gaps = rng.exponential(1.0 / spec.get("arrival_rate_rps", 6.0),
+                               size=n_requests)
+        lat = {f"t{i}": [] for i in range(n_tenants)}
+        errors = []
+
+        def one(mi, ti):
+            tenant = f"t{ti}"
+            t0 = time.perf_counter()
+            try:
+                lease = adm.acquire(tenant, timeout_s=30)
+            except TenantQuotaExceeded:
+                return          # shed: counted by the admission gate
+            try:
+                h = handles[f"m{mi}"].options(stream=True, tenant=tenant)
+                out = [t for t in h.remote(
+                    [1 + int(mi), 2, 3], max_new_tokens=8)]
+                if not out:
+                    errors.append("empty")
+                lat[tenant].append((time.perf_counter() - t0) * 1e3)
+            except Exception as e:
+                errors.append(f"{type(e).__name__}: {e}")
+            finally:
+                lease.release()
+
+        threads = []
+        for (mi, ti, gap) in zip(picks_m, picks_t, gaps):
+            time.sleep(float(gap))
+            th = threading.Thread(target=one, args=(int(mi), int(ti)),
+                                  daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=300)
+
+        fleet = serve.fleet_status()
+        cold_key = f"{cold_app}/llm{n_models - 1}"
+        cold_stats = (fleet.get("fleet") or {}).get(
+            "cold_starts", {}).get(cold_key, {})
+        tenant_p95 = {}
+        for t, xs in lat.items():
+            if xs:
+                xs = sorted(xs)
+                tenant_p95[t] = round(xs[int(len(xs) * 0.95)
+                                         if len(xs) > 1 else 0], 1)
+        shed = (adm.stats() or {}).get("shed_total", {})
+        return {
+            "n_models": n_models, "n_tenants": n_tenants,
+            "n_requests": n_requests,
+            "scaled_to_zero": scaled,
+            "revivals": (fleet.get("fleet") or {}).get("revivals_total", 0),
+            "cold_start_p99_ms": cold_stats.get("p99_ms"),
+            "cold_start_count": cold_stats.get("count", 0),
+            "tenant_p95_ms": tenant_p95,
+            "serve_tenant_shed_total": {t: int(n)
+                                        for t, n in shed.items()},
+            "errors": len(errors), "error_detail": errors[:3],
+        }
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+
+
 if __name__ == "__main__":
     spec = json.loads(sys.argv[sys.argv.index("--one") + 1])
-    print("RESULT " + json.dumps(run(spec)), flush=True)
+    fn = run_multi_model if spec.get("mode") == "multi_model" else run
+    print("RESULT " + json.dumps(fn(spec)), flush=True)
