@@ -669,6 +669,32 @@ def bench_weight_broadcast_gb_per_s():
     return {"skipped": True, "reason": last}
 
 
+def bench_mpmd_pipeline_step_ms():
+    """Elastic MPMD pipeline step latency (reports/pipeline_probe.py):
+    per-stage programs + 1F1B microbatch schedule through the
+    train/mpmd.py dispatcher on the virtual CPU mesh — median ms/step
+    and steps/s, per-stage bubble fraction next to the analytic
+    (S-1)/(M+S-1) bound, and the recovery cost of ONE injected stage
+    kill mid-step (steps lost <= replay_depth + 1, bit-identity and
+    compile-once asserted inside the probe). Runs without a cluster —
+    the local transport shares every line of schedule/recovery code
+    with the actor gang."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    runner = os.path.join(here, "reports", "pipeline_probe.py")
+    spec = {"n_stages": 2, "n_microbatches": 8, "steps": 10,
+            "d_model": 64, "runs": 3}
+    last = "unknown"
+    for attempt in range(2):
+        if attempt:
+            time.sleep(5)
+        result, last = _run_probe(runner, spec, timeout=900)
+        if result is not None:
+            return result
+        log(f"pipeline probe failed: {last}")
+    return {"skipped": True, "reason": last}
+
+
 def bench_observability_overhead():
     """Observability cost guard (reports/trace_probe.py): put and
     decode-step throughput with the WHOLE plane enabled (span recorder
@@ -1001,6 +1027,33 @@ def main():
         log(f"broadcast probe FAILED: {e}")
         results["weight_broadcast_gb_per_s"] = {"skipped": True,
                                                 "reason": str(e)[:200]}
+
+    try:
+        pp = bench_mpmd_pipeline_step_ms()
+        if not pp.get("skipped"):
+            results["mpmd_pipeline_step_ms"] = {
+                "value": pp["mpmd_pipeline_step_ms"], "unit": "ms",
+                "steps_per_s": pp["steps_per_s"],
+                "n_stages": pp["n_stages"],
+                "n_microbatches": pp["n_microbatches"],
+                "schedule": pp["schedule"],
+                "bubble_fraction_per_stage":
+                    pp["bubble_fraction_per_stage"],
+                "bubble_fraction_analytic":
+                    pp["bubble_fraction_analytic"],
+                "spread": pp["spread"], "runs": pp["runs"],
+                "recovery": pp["recovery"]}
+            log(f"mpmd_pipeline_step_ms: {pp['mpmd_pipeline_step_ms']} "
+                f"(recovery steps_lost="
+                f"{pp['recovery']['steps_lost']}, "
+                f"{pp['recovery']['recovery_ms']}ms)")
+        else:
+            results["mpmd_pipeline_step_ms"] = pp
+            log(f"pipeline probe skipped: {pp.get('reason')}")
+    except Exception as e:
+        log(f"pipeline probe FAILED: {e}")
+        results["mpmd_pipeline_step_ms"] = {"skipped": True,
+                                            "reason": str(e)[:200]}
 
     try:
         ceiling = bench_memcpy_ceiling()

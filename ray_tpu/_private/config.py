@@ -297,6 +297,35 @@ _flag("tenant_queue_max", int, 64,
       "with 429 + Retry-After instead of collapsing the queue.")
 _flag("tenant_retry_after_s", float, 1.0,
       "Retry-After hint attached to tenant-quota 429 responses.")
+# Multi-model fleet plane: weight source for shell attach / revival
+_flag("fleet_weights_from_arena", bool, True,
+      "Deployments whose weights come from a params_fn resolve them "
+      "through the cluster weight plane by default: the first replica "
+      "to construct the callable publishes the loaded tree via "
+      "broadcast_weights (plain put when the plane is unavailable) and "
+      "records the ref in the GCS KV; every later attach — shell "
+      "revivals included — gets the tree from its local arena instead "
+      "of re-running the loader. Off = every attach re-runs params_fn.")
+# Elastic MPMD pipeline training (train/mpmd.py)
+_flag("mpmd_replay_depth", int, 2,
+      "Steps of input microbatches the MPMD pipeline controller retains "
+      "in its bounded replay buffer; a stage lost to preemption can "
+      "rejoin from a shard checkpoint at most this many steps old, so "
+      "recovery replays <= replay_depth + 1 steps.")
+_flag("mpmd_barrier_deadline_s", float, 30.0,
+      "How long surviving pipeline stages may take to park (abort the "
+      "in-flight step and roll back to the checkpoint boundary) after a "
+      "stage loss; a survivor that misses the barrier degrades the "
+      "recovery to a job-level failure instead of hanging the pipeline.")
+_flag("mpmd_restart_backoff_s", float, 1.0,
+      "Delay before re-provisioning a lost pipeline stage (and between "
+      "consecutive stage-replace attempts).")
+_flag("mpmd_health_poll_s", float, 0.5,
+      "Cadence of the per-stage preemption-notice watch thread "
+      "(tpu.check_preemption_notice + the per-stage marker file).")
+_flag("mpmd_step_timeout_s", float, 300.0,
+      "Deadline for one pipeline step's optimizer-apply barrier; past "
+      "it the controller treats unresponsive stages as lost.")
 # Object store: spanning-object spill (weight-distribution plane)
 _flag("span_spill_min_idle_s", float, 5.0,
       "A sealed, unpinned spanning object younger than this is never "

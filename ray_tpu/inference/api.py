@@ -86,7 +86,8 @@ class LLMDeployment:
                  top_p: float = 1.0, params_fn=None, mesh=None,
                  seed: int = 0, prefix_cache_slots: int = 2,
                  stream_coalesce_tokens: int = 8,
-                 stream_coalesce_ms: float = 20.0):
+                 stream_coalesce_ms: float = 20.0,
+                 weights_key: Optional[str] = "auto"):
         import jax
 
         self.model = _resolve_model(model)
@@ -98,7 +99,19 @@ class LLMDeployment:
         self.stream_coalesce_tokens = max(1, int(stream_coalesce_tokens))
         self.stream_coalesce_ms = max(0.0, float(stream_coalesce_ms))
         if params_fn is not None:
-            params = params_fn()
+            # weight-plane attach (serve/weights.py): the first replica
+            # to run params_fn publishes the tree via broadcast_weights
+            # (plain-put fallback) and records the ref; later attaches —
+            # fleet shell revivals included — get a zero-copy local
+            # arena read instead of re-running the loader. weights_key
+            # "auto" derives a key from (model, seed) for registry-name
+            # models; pass an explicit key for config/module models or
+            # None to always re-run params_fn.
+            if weights_key == "auto":
+                weights_key = (f"llm/{model}/{seed}"
+                               if isinstance(model, str) else None)
+            from ray_tpu.serve.weights import resolve_weight_source
+            params = resolve_weight_source(weights_key, params_fn)
         else:
             import jax.numpy as jnp
             tokens0 = jnp.zeros((1, min(8, max_len)), jnp.int32)

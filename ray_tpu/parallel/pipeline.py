@@ -105,3 +105,115 @@ def make_pipeline_fns(stage_fn: Callable, mesh: Mesh,
         return pipeline_apply(stage_fn, stacked_params, microbatches,
                               mesh, stage_axis)
     return apply
+
+
+# --------------------------------------------------------- MPMD schedules
+# Host-level microbatch schedules for the MPMD pipeline (train/mpmd.py):
+# per-stage programs on separate meshes, activations shipped stage-to-
+# stage through the object store instead of lax.ppermute. Ops are
+# ("F", mb) / ("B", mb) tuples in per-stage execution order; cross-stage
+# data dependencies (F(s, m) needs F(s-1, m)'s activation, B(s, m) needs
+# B(s+1, m)'s input-gradient) are enforced by the dispatcher, not the
+# schedule — these lists only fix each stage's LOCAL order, which is what
+# determines both the bubble and the grad-accumulation order (replay
+# determinism depends on the latter).
+
+OP_FWD = "F"
+OP_BWD = "B"
+
+
+def schedule_gpipe(n_stages: int, n_microbatches: int):
+    """GPipe (all-forward then all-backward) per-stage op lists. Peak
+    live activations = n_microbatches on every stage."""
+    if n_stages < 1 or n_microbatches < 1:
+        raise ValueError("need n_stages >= 1 and n_microbatches >= 1")
+    M = n_microbatches
+    return [[(OP_FWD, m) for m in range(M)] + [(OP_BWD, m) for m in range(M)]
+            for _ in range(n_stages)]
+
+
+def schedule_1f1b(n_stages: int, n_microbatches: int):
+    """Non-interleaved 1F1B (PipeDream-flush): stage s runs
+    min(S-1-s, M) warmup forwards, then alternates one-forward/
+    one-backward, then drains the remaining backwards. Same bubble as
+    GPipe but peak live activations drop from M to min(S-s, M) — the
+    schedule the MPMD trainer defaults to."""
+    if n_stages < 1 or n_microbatches < 1:
+        raise ValueError("need n_stages >= 1 and n_microbatches >= 1")
+    S, M = n_stages, n_microbatches
+    out = []
+    for s in range(S):
+        warmup = min(S - 1 - s, M)
+        ops = [(OP_FWD, m) for m in range(warmup)]
+        for i in range(M - warmup):
+            ops.append((OP_FWD, warmup + i))
+            ops.append((OP_BWD, i))
+        for i in range(max(M - warmup, 0), M):
+            ops.append((OP_BWD, i))
+        out.append(ops)
+    return out
+
+
+def make_schedule(kind: str, n_stages: int, n_microbatches: int):
+    if kind == "1f1b":
+        return schedule_1f1b(n_stages, n_microbatches)
+    if kind == "gpipe":
+        return schedule_gpipe(n_stages, n_microbatches)
+    raise ValueError(f"unknown pipeline schedule {kind!r} "
+                     "(expected '1f1b' or 'gpipe')")
+
+
+def peak_live_activations(stage_ops) -> int:
+    """Max forwards outstanding (saved inputs awaiting their backward)
+    at any point of one stage's op list — the stage's activation-memory
+    high-water mark in microbatches."""
+    live = peak = 0
+    for op, _mb in stage_ops:
+        live += 1 if op == OP_FWD else -1
+        peak = max(peak, live)
+    return peak
+
+
+def pipeline_bubble_fraction(n_stages: int, n_microbatches: int) -> float:
+    """Analytic flush-bubble fraction (S-1)/(M+S-1) shared by GPipe and
+    non-interleaved 1F1B; the probe reports the measured per-stage idle
+    fraction next to this bound."""
+    return (n_stages - 1) / (n_microbatches + n_stages - 1)
+
+
+def simulate_schedule(schedules):
+    """Dependency-order simulation of per-stage op lists: repeatedly
+    sweep the stages, running each stage's next op when its cross-stage
+    input is available. Returns the global execution order as
+    (tick, stage, op, mb) tuples; raises if the schedule deadlocks
+    (an op whose dependency can never arrive). The MPMD dispatcher uses
+    the same sweep against live stage handles; tests use this pure
+    version to pin schedule correctness."""
+    S = len(schedules)
+    queues = [list(ops) for ops in schedules]
+    fwd_done = [set() for _ in range(S)]   # mb whose F(s, m) completed
+    bwd_done = [set() for _ in range(S)]
+    order = []
+    tick = 0
+    while any(queues):
+        progressed = False
+        for s in range(S):
+            while queues[s]:
+                op, mb = queues[s][0]
+                if op == OP_FWD:
+                    ready = s == 0 or mb in fwd_done[s - 1]
+                else:
+                    ready = (mb in fwd_done[s]
+                             and (s == S - 1 or mb in bwd_done[s + 1]))
+                if not ready:
+                    break
+                queues[s].pop(0)
+                (fwd_done if op == OP_FWD else bwd_done)[s].add(mb)
+                order.append((tick, s, op, mb))
+                progressed = True
+        if not progressed:
+            raise ValueError(
+                "pipeline schedule deadlocked; remaining per-stage ops: "
+                f"{[q[:2] for q in queues]}")
+        tick += 1
+    return order

@@ -19,11 +19,15 @@ problems define it, and three pieces here solve them:
   ``_hold_for_revival``) and asks the controller to revive; the fleet
   manager checks a shell out, attaches the deployment's callable to it
   (weights load inside the already-warm process — an LLMDeployment's
-  ``params_fn`` can attach from the PR 11 arena via
-  ``sharded_checkpoint.restore_from_broadcast``), lets the callable's
-  ``on_shell_attach`` hook warm its compiled programs, and only then
-  publishes the replica to routing tables. Cold-start latency is
-  measured per revival and exported as ``serve_cold_start_ms``.
+  ``params_fn`` resolves through the PR 11 weight plane BY DEFAULT:
+  ``serve/weights.py resolve_weight_source`` attaches the recorded
+  broadcast tree zero-copy from the local arena and only the very first
+  attach cluster-wide runs the loader, with a plain-put fallback when
+  the plane is unavailable — ``fleet_weights_from_arena`` flag), lets
+  the callable's ``on_shell_attach`` hook warm its compiled programs,
+  and only then publishes the replica to routing tables. Cold-start
+  latency is measured per revival and exported as
+  ``serve_cold_start_ms``.
 
 - **Per-tenant fair-share admission.** Requests carry a tenant
   (``X-RayTPU-Tenant`` header at the proxy, ``options(tenant=)`` at the

@@ -10,4 +10,14 @@ from ray_tpu.train.trainer import JaxTrainer, Result
 __all__ = ["JaxTrainer", "Result", "ScalingConfig", "RunConfig",
            "FailureConfig", "CheckpointConfig", "DataConfig", "Checkpoint",
            "report", "get_context", "get_checkpoint", "get_dataset_shard",
-           "step_profiler"]
+           "step_profiler", "MPMDPipelineTrainer", "MPMDConfig",
+           "StageDefinition"]
+
+
+def __getattr__(name):
+    # mpmd pulls in jax-facing machinery; load it on first touch so
+    # `import ray_tpu.train` stays light for config-only consumers
+    if name in ("MPMDPipelineTrainer", "MPMDConfig", "StageDefinition"):
+        from ray_tpu.train import mpmd as _mpmd
+        return getattr(_mpmd, name)
+    raise AttributeError(f"module 'ray_tpu.train' has no attribute {name!r}")

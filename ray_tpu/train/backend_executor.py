@@ -5,9 +5,15 @@ _internal/worker_group.py:102 WorkerGroup).
 A training run = a placement group (gang) + one actor per worker +
 rank/world wiring + a backend hook that initializes jax.distributed
 (coordinator rendezvous through GCS KV — the NCCL/TCP-store replacement).
-Worker failures surface as ActorDiedError on the run refs; the trainer
-restarts the whole gang from the latest checkpoint (TPU slices fail as a
-unit, so whole-group restart is the right granularity)."""
+Worker failures surface as ActorDiedError on the run refs. Restart
+granularity follows ``FailureConfig.restart_policy``: under "job" the
+trainer restarts the whole gang from the latest checkpoint; under
+"stage" the executor replaces ONLY the dead workers in place
+(:meth:`BackendExecutor.replace_failed_workers` — same bundle, same
+rank, latest-checkpoint resume pushed to the fresh actor) while the
+survivors keep running. Per-worker replace is refused (job restart
+instead) when the gang runs jax.distributed collectives or a slice
+topology — those fail as a unit."""
 
 from __future__ import annotations
 
@@ -93,6 +99,10 @@ class BackendExecutor:
         self.workers: List = []
         self.run_refs: List = []
         self.slice_pod = None
+        self._bundles: List[Dict] = []
+        self._dataset_shards = None
+        self._run_fn = None
+        self._run_config = None
 
     def start(self):
         n = self.scaling.num_workers
@@ -136,26 +146,20 @@ class BackendExecutor:
             remove_placement_group(self.pg)
             raise RuntimeError(
                 f"placement group for {bundles} not schedulable")
-        actor_cls = ray_tpu.remote(TrainWorker)
-        self.workers = [
-            actor_cls.options(
-                max_concurrency=2,
-                resources=dict(bundles[i]),   # consumes its bundle
-                scheduling_strategy=PlacementGroupSchedulingStrategy(
-                    self.pg, placement_group_bundle_index=i),
-            ).remote()
-            for i in range(n)
-        ]
+        self._bundles = bundles
+        self.workers = [self._spawn_worker(i) for i in range(n)]
         # ranks: worker order; local/node ranks by node ip grouping
         ips = ray_tpu.get([w.get_node_ip.remote() for w in self.workers],
                           timeout=120)
         node_order: Dict[str, int] = {}
         local_counters: Dict[str, int] = {}
         setups = []
+        self._setup_args: List[tuple] = []
         for rank, (w, ip) in enumerate(zip(self.workers, ips)):
             node_rank = node_order.setdefault(ip, len(node_order))
             local_rank = local_counters.get(ip, 0)
             local_counters[ip] = local_rank + 1
+            self._setup_args.append((n, rank, local_rank, node_rank))
             setups.append(w.setup.remote(n, rank, local_rank, node_rank))
         ray_tpu.get(setups, timeout=120)
         if self.use_jax_distributed:
@@ -209,13 +213,33 @@ class BackendExecutor:
         ray_tpu.get([w.set_dataset_shards.remote(per_worker[i])
                      for i, w in enumerate(self.workers)], timeout=120)
 
+    def _spawn_worker(self, bundle_index: int):
+        actor_cls = ray_tpu.remote(TrainWorker)
+        return actor_cls.options(
+            max_concurrency=2,
+            resources=dict(self._bundles[bundle_index]),  # consumes bundle
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                self.pg, placement_group_bundle_index=bundle_index),
+        ).remote()
+
     def start_training(self, fn: Callable, config):
+        self._run_fn, self._run_config = fn, config
         self.run_refs = [w.run.remote(fn, config) for w in self.workers]
         return self.run_refs
 
     def poll_results(self) -> List[List[Dict]]:
-        return ray_tpu.get([w.poll.remote() for w in self.workers],
-                           timeout=60)
+        """Drain buffered report() rows per worker. A dead worker
+        contributes an empty list instead of failing the sweep — its
+        death is surfaced by finished() / failed_worker_indexes(), and
+        under restart_policy="stage" the survivors' metrics must keep
+        flowing while the replacement builds."""
+        out: List[List[Dict]] = []
+        for w in self.workers:
+            try:
+                out.append(ray_tpu.get(w.poll.remote(), timeout=60))
+            except Exception:
+                out.append([])
+        return out
 
     def finished(self):
         """(done, error): done when every run ref resolved; error holds the
@@ -236,6 +260,60 @@ class BackendExecutor:
             return True, None
         except Exception as e:
             return True, e
+
+    # -------------------------------------------------- per-worker replace
+    def supports_worker_replace(self) -> bool:
+        """Per-worker replace is sound only when workers are independent
+        processes: a jax.distributed gang's collectives hang on a member
+        swap (the group rendezvous is immutable) and a slice topology
+        fails as a unit — both degrade to the job-level restart."""
+        return not self.use_jax_distributed and self.slice_pod is None
+
+    def failed_worker_indexes(self) -> List[int]:
+        """Workers whose run ref resolved with an error (actor death or
+        a raised training loop); survivors' refs stay pending."""
+        failed = []
+        for i, ref in enumerate(self.run_refs):
+            ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
+            if not ready:
+                continue
+            try:
+                ray_tpu.get(ref, timeout=1)
+            except Exception:
+                failed.append(i)
+        return failed
+
+    def replace_failed_workers(self, resume_checkpoint=None) -> List[int]:
+        """Build a fresh actor in each dead worker's bundle, re-wire its
+        rank, push the latest checkpoint + its dataset shards, and
+        restart its training loop — the surviving workers never stop.
+        Returns the replaced indexes (empty when nothing was dead or
+        replace is unsupported)."""
+        if not self.supports_worker_replace():
+            return []
+        failed = self.failed_worker_indexes()
+        if not failed:
+            return []
+        from ray_tpu._private import events
+        for i in failed:
+            try:
+                ray_tpu.kill(self.workers[i])
+            except Exception:
+                pass   # already dead
+            w = self._spawn_worker(i)
+            ray_tpu.get(w.setup.remote(*self._setup_args[i]), timeout=120)
+            if resume_checkpoint is not None:
+                ray_tpu.get(w.set_resume_checkpoint.remote(
+                    resume_checkpoint), timeout=60)
+            if self._dataset_shards is not None:
+                ray_tpu.get(w.set_dataset_shards.remote(
+                    self._dataset_shards[i]), timeout=120)
+            self.workers[i] = w
+            self.run_refs[i] = w.run.remote(self._run_fn, self._run_config)
+            events.record_instant(
+                "train.worker_replaced", category="train", rank=i,
+                resumed=bool(resume_checkpoint is not None))
+        return failed
 
     def shutdown(self):
         self._dataset_shards = None

@@ -50,7 +50,33 @@ class DataConfig:
 
 @dataclasses.dataclass
 class FailureConfig:
+    """Failure budget + restart granularity for training runs.
+
+    restart_policy:
+      "job"   — any worker death restarts the WHOLE gang from the latest
+                checkpoint (the only safe granularity for jax.distributed
+                collectives and TPU slices, which fail as a unit).
+      "stage" — only the dead party restarts: JaxTrainer replaces the
+                failed worker in place (BackendExecutor per-worker
+                replace, latest-checkpoint resume pushed to it) and the
+                MPMD pipeline trainer replaces the lost STAGE (park →
+                restore shard → replay) while survivors keep their
+                state. Falls back to job-level restart where per-worker
+                replace is unsound (jax.distributed gangs, slice
+                topologies).
+    restart_backoff_s: delay before any restart/replace attempt.
+    """
     max_failures: int = 0
+    restart_policy: str = "job"
+    restart_backoff_s: float = 1.0
+
+    def __post_init__(self):
+        if self.restart_policy not in ("job", "stage"):
+            raise ValueError(
+                f"restart_policy must be 'job' or 'stage', "
+                f"got {self.restart_policy!r}")
+        if self.restart_backoff_s < 0:
+            raise ValueError("restart_backoff_s must be >= 0")
 
 
 @dataclasses.dataclass

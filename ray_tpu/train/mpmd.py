@@ -1,0 +1,1147 @@
+"""Elastic MPMD pipeline training: per-stage programs on per-stage
+meshes, activations over the data plane, stage-level preemption recovery.
+
+The SPMD pipeline (parallel/pipeline.py) keeps every stage inside ONE
+jitted program on one mesh — the right shape inside a slice, but it
+cannot span slices (no ICI) and a single lost host kills the whole
+program. This module is the cross-slice shape from the MPMD pipeline
+paper (arXiv 2412.14374): each stage is its OWN program compiled once on
+its OWN mesh/slice, hosted by an actor; activations and input-gradients
+hop stage-to-stage as object-store objects — created in the pinned
+shared-memory arena by the producing actor and, across nodes, shipped by
+the PR 5 zero-copy binary data plane (the controller only routes refs,
+bytes never visit it). The microbatch schedule (1F1B by default, GPipe
+optional — parallel/pipeline.py schedule_*) is dispatched ref-chained:
+every op of a step is submitted up front and the per-actor ordered
+queues + object dependencies realize the pipeline without a host round
+trip per hop.
+
+Stage loss is a first-class lifecycle, mirroring PR 9's serving shape:
+
+  notice   — each stage actor watches ``tpu.check_preemption_notice()``
+             (plus its per-stage marker file, the chaos channel); a
+             preempting stage is migrated at the NEXT step boundary:
+             fresh shard checkpoint, replacement provisioned, old actor
+             reaped — zero steps replayed.
+  crash    — a stage actor that dies mid-step (preemption without
+             notice, chaos ``StageKiller``) surfaces as failed applies /
+             dead pings. Surviving stages PARK at a bounded-deadline
+             barrier (abort the in-flight step, roll back to the last
+             checkpoint boundary — their params never left the process);
+             the controller re-provisions the stage from its shard
+             checkpoint (object-store snapshot ref first; storage shard
+             via ``sharded_checkpoint.restore_and_broadcast`` when a
+             ``storage_path`` is configured and the ref is gone), then
+             REPLAYS the buffered input microbatches. Replay re-runs the
+             identical per-stage op order through the identical
+             compiled-once programs, so post-replay optimizer state is
+             bit-identical to an uninterrupted run; training resumes
+             within ``replay_depth + 1`` steps of where it stopped.
+  degrade  — a survivor that misses the park barrier
+             (``mpmd_barrier_deadline_s``) or an exhausted
+             ``FailureConfig.max_failures`` budget raises
+             :class:`PipelineDegradedError`; the job-level
+             ``restart_policy="job"`` ladder (trainer.py) takes over.
+
+Compile-once discipline (the engine's ``decode`` rule applied to
+training): each stage jits exactly one forward, one backward, one
+grad-accumulate and one optimizer-apply program for its life; the
+counters are asserted ==1 across recovery — survivors never retrace and
+a replacement compiles each program exactly once in its fresh process.
+
+Unit-tier shape: the controller talks to stages through a handle
+protocol; :class:`LocalStageHandle` runs stages in-process (tests,
+probes, the MULTICHIP dryrun with per-stage device subsets) while
+:class:`ActorStageHandle` wraps a :class:`PipelineStageActor` gang —
+same dispatcher, same recovery path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu._private.config import cfg
+from ray_tpu.parallel.pipeline import (OP_BWD, OP_FWD, make_schedule,
+                                       peak_live_activations,
+                                       pipeline_bubble_fraction)
+from ray_tpu.train.config import FailureConfig
+
+
+class StageLostError(RuntimeError):
+    """One or more stage actors died or failed mid-step; carries the
+    lost stage indexes (a single chaos event can take several stages —
+    e.g. a node death under two colocated stages)."""
+
+    def __init__(self, stage_idx: int, cause: str = "",
+                 stages: Optional[List[int]] = None):
+        self.stages = sorted(set(stages or [stage_idx]))
+        super().__init__(f"pipeline stage(s) {self.stages} lost"
+                         + (f": {cause}" if cause else ""))
+        self.stage_idx = stage_idx
+        self.cause = cause
+
+
+class PipelineDegradedError(RuntimeError):
+    """Stage-level recovery could not proceed (park-barrier deadline
+    missed or failure budget exhausted); the pipeline is parked and the
+    caller must fall back to a job-level restart."""
+
+
+@dataclasses.dataclass
+class StageDefinition:
+    """What one pipeline stage computes. Built INSIDE the stage's
+    process by the per-stage builder so params land on the stage's own
+    mesh/devices.
+
+    stage_fn(params, x) -> y; the last stage's ``loss_fn(y, targets)``
+    -> scalar closes the pipeline. ``place`` re-places a restored host
+    (numpy) state tree onto the stage's devices/shardings (defaults to
+    leaving host arrays for jit to commit)."""
+    stage_fn: Callable[[Any, Any], Any]
+    params: Any
+    optimizer: Any                                  # optax gradient xform
+    loss_fn: Optional[Callable[[Any, Any], Any]] = None
+    place: Optional[Callable[[Any], Any]] = None
+
+
+@dataclasses.dataclass
+class MPMDConfig:
+    """Pipeline-shape + elasticity knobs (defaults from the flag
+    registry, overridable per trainer)."""
+    n_microbatches: int = 4
+    schedule: str = "1f1b"                  # "1f1b" | "gpipe"
+    replay_depth: Optional[int] = None      # cfg.mpmd_replay_depth
+    checkpoint_every: Optional[int] = None  # default: replay_depth
+    barrier_deadline_s: Optional[float] = None
+    step_timeout_s: Optional[float] = None
+    storage_path: Optional[str] = None      # durable shard checkpoints
+
+    def resolved(self) -> "MPMDConfig":
+        c = dataclasses.replace(self)
+        if c.replay_depth is None:
+            c.replay_depth = cfg.mpmd_replay_depth
+        if c.checkpoint_every is None:
+            c.checkpoint_every = c.replay_depth
+        if c.barrier_deadline_s is None:
+            c.barrier_deadline_s = cfg.mpmd_barrier_deadline_s
+        if c.step_timeout_s is None:
+            c.step_timeout_s = cfg.mpmd_step_timeout_s
+        if c.n_microbatches < 1:
+            raise ValueError("n_microbatches must be >= 1")
+        if c.replay_depth < 1:
+            raise ValueError("replay_depth must be >= 1")
+        if c.checkpoint_every < 1:
+            raise ValueError("checkpoint_every must be >= 1")
+        if c.checkpoint_every > c.replay_depth:
+            raise ValueError(
+                f"checkpoint_every={c.checkpoint_every} must be <= "
+                f"replay_depth={c.replay_depth}: the replay buffer must "
+                "cover every step since the last shard checkpoint")
+        return c
+
+
+# ------------------------------------------------------------ replay buffer
+
+class MicrobatchReplayBuffer:
+    """Bounded per-step retention of input microbatches (+ targets) so a
+    re-provisioned stage can replay every step since the last shard
+    checkpoint. Eviction is deterministic: strictly oldest-first once
+    more than ``depth`` steps are held. Stored arrays are snapshotted
+    (np.asarray copies) so later caller mutation can't corrupt replay."""
+
+    def __init__(self, depth: int):
+        if depth < 1:
+            raise ValueError("replay depth must be >= 1")
+        self.depth = depth
+        self._steps: Dict[int, Any] = {}
+
+    def record(self, step: int, inputs: List[Any], targets: List[Any]):
+        self._steps[step] = (
+            [np.array(np.asarray(x)) for x in inputs],
+            [np.array(np.asarray(t)) for t in targets])
+        while len(self._steps) > self.depth:
+            del self._steps[min(self._steps)]
+
+    def steps(self) -> List[int]:
+        return sorted(self._steps)
+
+    def get(self, step: int):
+        if step not in self._steps:
+            raise KeyError(
+                f"step {step} not in replay buffer (held: {self.steps()}, "
+                f"depth {self.depth})")
+        return self._steps[step]
+
+    def replayable_from(self, boundary_step: int) -> List[int]:
+        """Steps after ``boundary_step`` available for replay, in order;
+        raises if a gap means the boundary is too old to recover from."""
+        want = [s for s in self.steps() if s > boundary_step]
+        expect = list(range(boundary_step + 1, boundary_step + 1 + len(want)))
+        if want != expect:
+            raise KeyError(
+                f"replay gap: checkpoint at step {boundary_step} but "
+                f"buffer holds {self.steps()}")
+        return want
+
+
+# ------------------------------------------------------------ stage runtime
+
+class StageRuntime:
+    """One stage's compute engine: compile-once fwd/bwd/accumulate/apply
+    programs over the StageDefinition, saved-input bookkeeping for the
+    recompute-style backward, grad accumulation in schedule order (replay
+    determinism), and host-snapshot checkpoint/rollback. Runs unchanged
+    inside a :class:`PipelineStageActor` or a :class:`LocalStageHandle`."""
+
+    def __init__(self, defn: StageDefinition, *, stage_idx: int,
+                 n_stages: int, n_microbatches: int):
+        import jax
+
+        self.defn = defn
+        self.stage_idx = stage_idx
+        self.n_stages = n_stages
+        self.n_microbatches = n_microbatches
+        self.is_first = stage_idx == 0
+        self.is_last = stage_idx == n_stages - 1
+        if self.is_last and defn.loss_fn is None:
+            raise ValueError("last stage needs a loss_fn")
+        self.step = 0
+        self.params = defn.params
+        self.opt_state = defn.optimizer.init(defn.params)
+        self.fwd_compile_count = 0
+        self.bwd_compile_count = 0
+        self.apply_compile_count = 0
+        self._saved: Dict[tuple, Any] = {}
+        self._gacc = None
+        self._losses: List[Any] = []
+        self._compute_s = 0.0
+        self._last_snapshot = self._host_snapshot()
+
+        stage_fn, loss_fn = defn.stage_fn, defn.loss_fn
+        M = n_microbatches
+
+        def fwd(params, x):
+            self.fwd_compile_count += 1       # trace-time only
+            return stage_fn(params, x)
+
+        def fwd_last(params, x, target):
+            self.fwd_compile_count += 1
+            return loss_fn(stage_fn(params, x), target)
+
+        def bwd(params, x, gy):
+            self.bwd_compile_count += 1
+            _y, vjp = jax.vjp(stage_fn, params, x)
+            gp, gx = vjp(gy)
+            return gx, gp
+
+        def bwd_last(params, x, target):
+            self.bwd_compile_count += 1
+            loss, (gp, gx) = jax.value_and_grad(
+                lambda p, xx: loss_fn(stage_fn(p, xx), target),
+                argnums=(0, 1))(params, x)
+            return gx, gp, loss
+
+        def acc(a, b):
+            return jax.tree.map(lambda u, v: u + v, a, b)
+
+        def apply(params, opt_state, gacc):
+            self.apply_compile_count += 1
+            g = jax.tree.map(lambda u: u / M, gacc)
+            updates, new_opt = defn.optimizer.update(g, opt_state,
+                                                     params=params)
+            import optax
+            return optax.apply_updates(params, updates), new_opt
+
+        self._fwd_j = jax.jit(fwd_last if self.is_last else fwd)
+        self._bwd_j = jax.jit(bwd_last if self.is_last else bwd)
+        self._acc_j = jax.jit(acc)
+        self._apply_j = jax.jit(apply)
+
+    # ------------------------------------------------------------- compute
+    def _timed(self, fn, *args):
+        import jax
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        self._compute_s += time.perf_counter() - t0
+        return out
+
+    def forward(self, step: int, mb: int, x, target=None):
+        """Run F(step, mb). Non-last stages return the activation (the
+        object the next stage consumes); the last stage returns its
+        per-microbatch loss. The input is saved for the recompute-style
+        backward and dropped by it (or by abort_step). Outputs cross a
+        MESH boundary, so they leave as host arrays — in-process that is
+        the device→host hop the object-store hand-off pays anyway, and
+        it keeps each stage's program free of the neighbor's placement."""
+        if self.is_last:
+            self._saved[(step, mb)] = (x, target)
+            return np.asarray(self._timed(self._fwd_j, self.params, x,
+                                          target))
+        self._saved[(step, mb)] = x
+        return np.asarray(self._timed(self._fwd_j, self.params, x))
+
+    def backward(self, step: int, mb: int, gy=None):
+        """Run B(step, mb): recompute-vjp over the saved input,
+        accumulate param grads IN CALL ORDER (the schedule's order —
+        replay hits the same order, hence bit-identical accumulation),
+        return the input-gradient for the upstream stage (host array —
+        it crosses the mesh boundary too)."""
+        if self.is_last:
+            x, target = self._saved.pop((step, mb))
+            gx, gp, loss = self._timed(self._bwd_j, self.params, x, target)
+            self._losses.append(np.asarray(loss))
+        else:
+            x = self._saved.pop((step, mb))
+            gx, gp = self._timed(self._bwd_j, self.params, x, gy)
+        self._gacc = gp if self._gacc is None \
+            else self._acc_j(self._gacc, gp)
+        return np.asarray(gx)
+
+    def apply_step(self, step: int) -> Dict[str, Any]:
+        """Step boundary: apply the accumulated (mean) gradient, clear
+        per-step state, return stage metrics."""
+        if self._gacc is None:
+            raise RuntimeError(f"stage {self.stage_idx}: apply_step({step}) "
+                               "with no accumulated gradients")
+        if self._saved:
+            raise RuntimeError(
+                f"stage {self.stage_idx}: {len(self._saved)} saved "
+                f"activations outstanding at apply_step({step})")
+        self.params, self.opt_state = self._timed(
+            self._apply_j, self.params, self.opt_state, self._gacc)
+        metrics: Dict[str, Any] = {
+            "step": step, "stage": self.stage_idx,
+            "compute_s": round(self._compute_s, 6),
+            "fwd_compile_count": self.fwd_compile_count,
+            "bwd_compile_count": self.bwd_compile_count,
+        }
+        if self.is_last and self._losses:
+            metrics["loss"] = float(np.mean([np.asarray(l)
+                                             for l in self._losses]))
+        self._gacc = None
+        self._losses = []
+        self._compute_s = 0.0
+        self.step = step
+        return metrics
+
+    def abort_step(self, step: int) -> bool:
+        """Park: drop the in-flight step's saved activations, partial
+        grad accumulation and losses. Params/opt_state are untouched —
+        they only move at apply_step."""
+        self._saved = {k: v for k, v in self._saved.items()
+                       if k[0] != step}
+        self._gacc = None
+        self._losses = []
+        self._compute_s = 0.0
+        return True
+
+    # ------------------------------------------------------- checkpointing
+    def _host_snapshot(self) -> Dict[str, Any]:
+        import jax
+        return {"step": self.step,
+                "stage": self.stage_idx,
+                "params": jax.tree.map(lambda a: np.asarray(a), self.params),
+                "opt_state": jax.tree.map(lambda a: np.asarray(a),
+                                          self.opt_state)}
+
+    def checkpoint(self, step: int) -> Dict[str, Any]:
+        """Record a step-boundary shard snapshot (host arrays). Kept
+        in-process for local rollback; the caller also parks a copy in
+        the object store so a REPLACEMENT stage can restore it."""
+        if step != self.step:
+            raise RuntimeError(
+                f"stage {self.stage_idx}: checkpoint({step}) at "
+                f"step {self.step} — checkpoints are step-boundary only")
+        self._last_snapshot = self._host_snapshot()
+        return self._last_snapshot
+
+    def rollback(self) -> int:
+        """Roll params/opt_state back to the last checkpoint boundary;
+        returns the boundary step."""
+        self.load_snapshot(self._last_snapshot)
+        return self.step
+
+    def load_snapshot(self, snap: Dict[str, Any]):
+        place = self.defn.place or (lambda t: t)
+        self.params = place(snap["params"])
+        self.opt_state = place(snap["opt_state"])
+        self.step = int(snap["step"])
+        self._last_snapshot = snap
+        self._saved = {}
+        self._gacc = None
+        self._losses = []
+
+    def state_digest(self) -> str:
+        """sha256 over every params/opt_state leaf — the bit-identity
+        probe the elastic tests compare against an uninterrupted run."""
+        import jax
+        h = hashlib.sha256()
+        for tree in (self.params, self.opt_state):
+            for leaf in jax.tree.leaves(tree):
+                a = np.asarray(leaf)
+                h.update(str(a.dtype).encode())
+                h.update(str(a.shape).encode())
+                h.update(a.tobytes())
+        return h.hexdigest()
+
+    def compile_counts(self) -> Dict[str, int]:
+        return {"fwd": self.fwd_compile_count,
+                "bwd": self.bwd_compile_count,
+                "apply": self.apply_compile_count}
+
+
+# ------------------------------------------------------------- stage hosts
+
+def _build_definition(builder: Callable, stage_idx: int) -> StageDefinition:
+    """Builders may take (stage_idx) or nothing."""
+    try:
+        import inspect
+        takes_arg = len(inspect.signature(builder).parameters) >= 1
+    except (TypeError, ValueError):
+        takes_arg = True
+    defn = builder(stage_idx) if takes_arg else builder()
+    if not isinstance(defn, StageDefinition):
+        raise TypeError(f"stage builder must return StageDefinition, "
+                        f"got {type(defn)!r}")
+    return defn
+
+
+class _Now:
+    """Pre-resolved 'future' for the in-process transport."""
+    __slots__ = ("value", "error")
+
+    def __init__(self, value=None, error: Optional[BaseException] = None):
+        self.value = value
+        self.error = error
+
+    def result(self):
+        if self.error is not None:
+            raise self.error
+        return self.value
+
+
+class LocalStageHandle:
+    """In-process stage host speaking the same protocol as the actor
+    transport: every call returns a future (here pre-resolved), chaos
+    injection runs at forward/backward entry (``stage_step`` spec — a
+    fire marks the handle DEAD and every later call raises StageLostError,
+    the in-process analog of a SIGKILLed actor), and ``preempting()``
+    polls the per-stage marker file. ``fail_at=(step, op)`` arms a
+    deterministic one-shot death for tests/probes."""
+
+    remote = False
+
+    def __init__(self, stage_idx: int, n_stages: int, n_microbatches: int,
+                 builder: Callable, snapshot: Optional[Dict] = None,
+                 preempt_marker: Optional[str] = None,
+                 fail_at: Optional[tuple] = None):
+        self.stage_idx = stage_idx
+        self._rt = StageRuntime(_build_definition(builder, stage_idx),
+                                stage_idx=stage_idx, n_stages=n_stages,
+                                n_microbatches=n_microbatches)
+        if snapshot is not None:
+            self._rt.load_snapshot(snapshot)
+        self._marker = preempt_marker
+        self._fail_at = fail_at
+        self._dead = False
+
+    # ------------------------------------------------------ chaos plumbing
+    def _chaos(self, step: int, op: str):
+        if self._dead:
+            raise StageLostError(self.stage_idx, "stage already dead")
+        if self._fail_at is not None and self._fail_at == (step, op):
+            self._fail_at = None
+            self._dead = True
+            raise StageLostError(self.stage_idx,
+                                 f"armed failure at step {step} {op}")
+        from ray_tpu._private import rpc
+        try:
+            rpc._maybe_inject_failure("stage_step")
+        except rpc.RpcError as e:
+            self._dead = True
+            raise StageLostError(self.stage_idx, str(e)) from e
+
+    def _call(self, fn, *args) -> _Now:
+        try:
+            return _Now(fn(*args))
+        except BaseException as e:   # surfaced at fetch, like a ref
+            return _Now(error=e)
+
+    # ------------------------------------------------------------ protocol
+    @staticmethod
+    def _unwrap(v):
+        # upstream outputs arrive as _Now futures; a poisoned one
+        # re-raises the upstream loss here, mirroring how a failed
+        # object-ref dependency fails the downstream actor task
+        return v.result() if isinstance(v, _Now) else v
+
+    def forward(self, step, mb, x, target=None) -> _Now:
+        def run():
+            self._chaos(step, OP_FWD)
+            return self._rt.forward(step, mb, self._unwrap(x), target)
+        return self._call(run)
+
+    def backward(self, step, mb, gy=None) -> _Now:
+        def run():
+            self._chaos(step, OP_BWD)
+            return self._rt.backward(step, mb, self._unwrap(gy))
+        return self._call(run)
+
+    def apply_step(self, step) -> _Now:
+        def run():
+            if self._dead:
+                raise StageLostError(self.stage_idx, "stage already dead")
+            return self._rt.apply_step(step)
+        return self._call(run)
+
+    def abort_step(self, step) -> _Now:
+        if self._dead:
+            return _Now(error=StageLostError(self.stage_idx, "dead"))
+        return self._call(self._rt.abort_step, step)
+
+    def checkpoint(self, step) -> _Now:
+        if self._dead:
+            return _Now(error=StageLostError(self.stage_idx, "dead"))
+        return self._call(self._rt.checkpoint, step)
+
+    def rollback(self) -> _Now:
+        if self._dead:
+            return _Now(error=StageLostError(self.stage_idx, "dead"))
+        return self._call(self._rt.rollback)
+
+    def compile_counts(self) -> _Now:
+        return self._call(self._rt.compile_counts)
+
+    def state_digest(self) -> _Now:
+        return self._call(self._rt.state_digest)
+
+    def ping(self, timeout: Optional[float] = None) -> bool:
+        return not self._dead
+
+    def preempting(self) -> bool:
+        if self._dead:
+            return False
+        if self._marker and os.path.exists(self._marker):
+            return True
+        from ray_tpu._private.accelerators.tpu import \
+            check_preemption_notice
+        return check_preemption_notice()
+
+    def kill(self):
+        self._dead = True
+
+    def fetch(self, fut: _Now, timeout: Optional[float] = None):
+        return fut.result()
+
+
+class PipelineStageActor:
+    """Actor hosting one pipeline stage pinned to its own mesh/slice.
+    Compute methods ride the DEFAULT (ordered) concurrency group —
+    dispatch order is execution order, which the replay-determinism
+    guarantee leans on; control methods (ping/abort/rollback/...)
+    declare the ``control`` group so the controller can park or probe a
+    stage while compute is queued. Chaos: the ``stage_step`` injection
+    SIGKILLs the process mid-step (``util.chaos.StageKiller``), the
+    hardest death the recovery path must absorb."""
+
+    def __init__(self, stage_idx: int, n_stages: int, n_microbatches: int,
+                 builder: Callable, snapshot: Optional[Dict] = None,
+                 preempt_marker: Optional[str] = None):
+        self._rt = StageRuntime(_build_definition(builder, stage_idx),
+                                stage_idx=stage_idx, n_stages=n_stages,
+                                n_microbatches=n_microbatches)
+        if snapshot is not None:
+            self._rt.load_snapshot(snapshot)
+        self._marker = preempt_marker
+        self._preempting = False
+        self._stop = threading.Event()
+        self._watch = threading.Thread(target=self._watch_loop,
+                                       name=f"stage-{stage_idx}-watch",
+                                       daemon=True)
+        self._watch.start()
+
+    def _watch_loop(self):
+        from ray_tpu._private.accelerators.tpu import \
+            check_preemption_notice
+        while not self._stop.is_set():
+            try:
+                if (self._marker and os.path.exists(self._marker)) \
+                        or check_preemption_notice():
+                    self._preempting = True
+            except Exception:
+                pass   # rtlint: disable=RT004 — poll again next tick
+            if self._stop.wait(cfg.mpmd_health_poll_s):
+                return
+
+    def _chaos(self):
+        from ray_tpu._private import rpc
+        try:
+            rpc._maybe_inject_failure("stage_step")
+        except rpc.RpcError:
+            # the chaos contract is a process DEATH mid-step, not a
+            # catchable exception: survivors must recover from silence
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    # ------------------------------------------------------------- compute
+    def forward(self, step, mb, x, target=None):
+        self._chaos()
+        return self._rt.forward(step, mb, x, target)
+
+    def backward(self, step, mb, gy=None):
+        self._chaos()
+        return self._rt.backward(step, mb, gy)
+
+    def apply_step(self, step):
+        return self._rt.apply_step(step)
+
+    def checkpoint(self, step):
+        snap = self._rt.checkpoint(step)
+        if self._storage_dir():
+            self._write_storage_shard(snap)
+        return snap
+
+    def _storage_dir(self):
+        return getattr(self, "_storage_path", None)
+
+    def set_storage_path(self, path: Optional[str]):
+        self._storage_path = path
+        return True
+
+    def _write_storage_shard(self, snap):
+        """Durable shard for the restore_and_broadcast ladder: written
+        best-effort at each boundary (recovery falls back to it only
+        when the object-store snapshot ref is unreachable)."""
+        try:
+            from ray_tpu.train.sharded_checkpoint import save_stage_shard
+            save_stage_shard(self._storage_path, self._rt.stage_idx, snap)
+        except Exception:
+            import logging
+            logging.getLogger(__name__).warning(
+                "stage %d: storage shard write failed",
+                self._rt.stage_idx, exc_info=True)
+
+    # ------------------------------------------------------------- control
+    def abort_step(self, step):
+        return self._rt.abort_step(step)
+
+    def rollback(self):
+        return self._rt.rollback()
+
+    def compile_counts(self):
+        return self._rt.compile_counts()
+
+    def state_digest(self):
+        return self._rt.state_digest()
+
+    def ping(self):
+        return True
+
+    def preempting(self):
+        return self._preempting
+
+    def stop(self):
+        self._stop.set()
+        return True
+
+
+# control methods answer while compute is queued: tag the group on the
+# plain functions (actor.py reads __concurrency_group__ through
+# ray_tpu.remote(), same as @ray_tpu.method(concurrency_group=...))
+for _name in ("abort_step", "rollback", "compile_counts", "state_digest",
+              "ping", "preempting", "stop", "set_storage_path"):
+    getattr(PipelineStageActor, _name).__concurrency_group__ = "control"
+del _name
+
+
+class ActorStageHandle:
+    """Controller-side wrapper around a PipelineStageActor: methods
+    return ObjectRefs (activations/grads stay in the object store — the
+    controller passes refs between stages, never bytes)."""
+
+    remote = True
+
+    def __init__(self, stage_idx: int, actor):
+        self.stage_idx = stage_idx
+        self.actor = actor
+
+    @classmethod
+    def provision(cls, stage_idx: int, n_stages: int, n_microbatches: int,
+                  builder: Callable, snapshot=None,
+                  preempt_marker: Optional[str] = None,
+                  resources: Optional[Dict[str, float]] = None,
+                  storage_path: Optional[str] = None) -> "ActorStageHandle":
+        import ray_tpu
+        opts: Dict[str, Any] = {
+            "max_concurrency": 4,
+            "concurrency_groups": {"control": 2},
+        }
+        if resources:
+            opts["resources"] = dict(resources)
+        actor = ray_tpu.remote(PipelineStageActor).options(**opts).remote(
+            stage_idx, n_stages, n_microbatches, builder, snapshot,
+            preempt_marker)
+        h = cls(stage_idx, actor)
+        if storage_path:
+            h.fetch(actor.set_storage_path.remote(storage_path),
+                    timeout=60.0)
+        return h
+
+    def forward(self, step, mb, x, target=None):
+        return self.actor.forward.remote(step, mb, x, target)
+
+    def backward(self, step, mb, gy=None):
+        return self.actor.backward.remote(step, mb, gy)
+
+    def apply_step(self, step):
+        return self.actor.apply_step.remote(step)
+
+    def abort_step(self, step):
+        return self.actor.abort_step.remote(step)
+
+    def checkpoint(self, step):
+        return self.actor.checkpoint.remote(step)
+
+    def rollback(self):
+        return self.actor.rollback.remote()
+
+    def compile_counts(self):
+        return self.actor.compile_counts.remote()
+
+    def state_digest(self):
+        return self.actor.state_digest.remote()
+
+    def ping(self, timeout: Optional[float] = 5.0) -> bool:
+        import ray_tpu
+        try:
+            ray_tpu.get(self.actor.ping.remote(), timeout=timeout)
+            return True
+        except Exception:
+            return False
+
+    def preempting(self) -> bool:
+        import ray_tpu
+        try:
+            return bool(ray_tpu.get(self.actor.preempting.remote(),
+                                    timeout=5.0))
+        except Exception:
+            return False
+
+    def kill(self):
+        import ray_tpu
+        try:
+            ray_tpu.kill(self.actor)
+        except Exception:
+            pass
+
+    def fetch(self, ref, timeout: Optional[float] = None):
+        import ray_tpu
+        return ray_tpu.get(ref, timeout=timeout)
+
+
+# -------------------------------------------------------------- controller
+
+class MPMDPipelineTrainer:
+    """Drives an S-stage MPMD pipeline over stage handles: ref-chained
+    schedule dispatch, step-boundary shard checkpoints, and the
+    stage-loss lifecycle (park → re-provision → restore → replay →
+    rejoin).
+
+    stage_builders: one callable per stage returning its
+        :class:`StageDefinition` (runs inside the stage's host process).
+    remote=True provisions a :class:`PipelineStageActor` gang (one
+        actor per stage, ``stage_resources[s]`` pinning each to its
+        slice); remote=False runs stages in-process (tests/probe).
+    provision_fn(stage_idx, snapshot) overrides stage provisioning
+        entirely (tests inject failing handles through this)."""
+
+    def __init__(self, stage_builders: List[Callable],
+                 config: Optional[MPMDConfig] = None,
+                 failure_config: Optional[FailureConfig] = None,
+                 *, remote: bool = False,
+                 stage_resources: Optional[List[Dict[str, float]]] = None,
+                 provision_fn: Optional[Callable] = None,
+                 marker_dir: Optional[str] = None):
+        if len(stage_builders) < 2:
+            raise ValueError("an MPMD pipeline needs >= 2 stages")
+        self.builders = list(stage_builders)
+        self.n_stages = len(self.builders)
+        self.config = (config or MPMDConfig()).resolved()
+        self.failure_config = failure_config or FailureConfig(
+            max_failures=3, restart_policy="stage")
+        self.remote = remote
+        self.stage_resources = stage_resources or [None] * self.n_stages
+        self._provision_fn = provision_fn
+        self.schedule = make_schedule(self.config.schedule, self.n_stages,
+                                      self.config.n_microbatches)
+        self.replay = MicrobatchReplayBuffer(self.config.replay_depth)
+        self.handles: List[Any] = []
+        self._snap_refs: Dict[int, Any] = {}   # stage -> snapshot ref/tree
+        self._ckpt_step = 0
+        self._failures_left = self.failure_config.max_failures
+        self.recoveries: List[Dict[str, Any]] = []
+        self.history: List[Dict[str, Any]] = []
+        self._marker_dir = marker_dir
+        self._markers: List[Optional[str]] = [None] * self.n_stages
+        if marker_dir:
+            os.makedirs(marker_dir, exist_ok=True)
+            self._markers = [os.path.join(marker_dir, f"stage_{s}.preempt")
+                             for s in range(self.n_stages)]
+
+    # ---------------------------------------------------------- provision
+    def _provision(self, stage_idx: int, snapshot=None):
+        if self._provision_fn is not None:
+            return self._provision_fn(stage_idx, snapshot)
+        return self._default_provision(stage_idx, snapshot)
+
+    def _default_provision(self, stage_idx: int, snapshot=None):
+        """The built-in stage host factory; provision_fn overrides can
+        delegate here (it never re-enters the override)."""
+        if self.remote:
+            return ActorStageHandle.provision(
+                stage_idx, self.n_stages, self.config.n_microbatches,
+                self.builders[stage_idx], snapshot,
+                preempt_marker=self._markers[stage_idx],
+                resources=self.stage_resources[stage_idx],
+                storage_path=self.config.storage_path)
+        return LocalStageHandle(
+            stage_idx, self.n_stages, self.config.n_microbatches,
+            self.builders[stage_idx], snapshot,
+            preempt_marker=self._markers[stage_idx])
+
+    def start(self):
+        """Provision the stage gang and take the step-0 checkpoint (so a
+        loss before the first boundary can still restore)."""
+        if self.handles:
+            return self
+        self.handles = [self._provision(s) for s in range(self.n_stages)]
+        self._checkpoint_all(0)
+        return self
+
+    def preempt_marker(self, stage_idx: int) -> Optional[str]:
+        """The per-stage notice-file path (chaos/StageKiller channel)."""
+        return self._markers[stage_idx]
+
+    # -------------------------------------------------------------- fit
+    def fit(self, data_fn: Callable[[int], tuple], n_steps: int
+            ) -> Dict[str, Any]:
+        """Run ``n_steps`` pipeline steps. ``data_fn(step)`` returns
+        (inputs, targets): M first-stage input microbatches and M
+        last-stage target microbatches. Returns the run summary."""
+        from ray_tpu._private import events
+        self.start()
+        with events.record_span("train.mpmd.fit", category="train",
+                                n_stages=self.n_stages,
+                                n_microbatches=self.config.n_microbatches,
+                                schedule=self.config.schedule):
+            step = 0
+            while step < n_steps:
+                step += 1
+                inputs, targets = data_fn(step)
+                self._check_shapes(inputs, targets)
+                self.replay.record(step, inputs, targets)
+                self._run_step_with_recovery(step, inputs, targets)
+                if step % self.config.checkpoint_every == 0:
+                    self._checkpoint_all(step)
+                self._migrate_preempting(step)
+        return self.summary()
+
+    def _check_shapes(self, inputs, targets):
+        M = self.config.n_microbatches
+        if len(inputs) != M or len(targets) != M:
+            raise ValueError(
+                f"data_fn must return {M} input + {M} target microbatches "
+                f"(got {len(inputs)}/{len(targets)})")
+
+    def summary(self) -> Dict[str, Any]:
+        last = self.history[-1] if self.history else {}
+        return {"steps": len({h["step"] for h in self.history}),
+                "last_metrics": last,
+                "history": self.history,
+                "recoveries": self.recoveries,
+                "schedule": self.config.schedule,
+                "bubble_fraction_analytic": pipeline_bubble_fraction(
+                    self.n_stages, self.config.n_microbatches),
+                "peak_live_activations": [
+                    peak_live_activations(ops) for ops in self.schedule]}
+
+    # ------------------------------------------------------ step execution
+    def _run_step_with_recovery(self, step, inputs, targets):
+        """Run one step; on stage loss, recover (park → replace →
+        rollback) and replay the buffer — a loss DURING replay loops
+        back into recovery against the same budget, so repeated chaos
+        converges or degrades deterministically."""
+        try:
+            self._run_step(step, inputs, targets)
+            return
+        except StageLostError as e:
+            lost, cause = e.stages, e.cause
+        while True:
+            t_rec = time.perf_counter()
+            boundary = self._prepare_recovery(step, lost, cause)
+            try:
+                replayed = self.replay.replayable_from(boundary)
+                for t in replayed:
+                    ins, tgts = self.replay.get(t)
+                    self._run_step(t, ins, tgts)
+            except StageLostError as e:
+                lost, cause = e.stages, e.cause
+                continue
+            self._note_recovery(step, lost, cause, boundary, replayed,
+                                time.perf_counter() - t_rec)
+            return
+
+    def _run_step(self, step, inputs, targets):
+        """Dispatch one step's full schedule ref-chained, then collect
+        the per-stage apply barrier."""
+        from ray_tpu._private import events
+        t0 = time.perf_counter()
+        apply_futs = self._dispatch(step, inputs, targets)
+        metrics = self._collect_applies(step, apply_futs)
+        wall = time.perf_counter() - t0
+        row: Dict[str, Any] = {"step": step, "wall_s": round(wall, 6)}
+        for m in metrics:
+            s = m["stage"]
+            row[f"stage{s}_compute_s"] = m["compute_s"]
+            row[f"stage{s}_bubble_fraction"] = round(
+                max(0.0, 1.0 - m["compute_s"] / wall), 4) if wall else 0.0
+            if "loss" in m:
+                row["loss"] = m["loss"]
+        self.history.append(row)
+        events.record_instant(
+            "train.mpmd.step", category="train", step=step,
+            wall_ms=round(wall * 1e3, 3),
+            **({"loss": row["loss"]} if "loss" in row else {}))
+        return row
+
+    def _dispatch(self, step, inputs, targets):
+        S = self.n_stages
+        queues = [list(ops) for ops in self.schedule]
+        fwd_out: Dict[tuple, Any] = {}
+        bwd_out: Dict[tuple, Any] = {}
+        while any(queues):
+            progressed = False
+            for s in range(S):
+                while queues[s]:
+                    op, mb = queues[s][0]
+                    if op == OP_FWD:
+                        if s == 0:
+                            x = inputs[mb]
+                        elif (s - 1, mb) in fwd_out:
+                            x = fwd_out[(s - 1, mb)]
+                        else:
+                            break
+                        tgt = targets[mb] if s == S - 1 else None
+                        fwd_out[(s, mb)] = self.handles[s].forward(
+                            step, mb, x, tgt)
+                    else:
+                        if s < S - 1 and (s + 1, mb) not in bwd_out:
+                            break
+                        gy = bwd_out[(s + 1, mb)] if s < S - 1 else None
+                        bwd_out[(s, mb)] = self.handles[s].backward(
+                            step, mb, gy)
+                    queues[s].pop(0)
+                    progressed = True
+            if not progressed:
+                raise ValueError("pipeline schedule deadlocked in dispatch")
+        return [h.apply_step(step) for h in self.handles]
+
+    def _collect_applies(self, step, apply_futs):
+        metrics, first_err = [], None
+        for s, fut in enumerate(apply_futs):
+            try:
+                metrics.append(self.handles[s].fetch(
+                    fut, timeout=self.config.step_timeout_s))
+            except Exception as e:
+                if first_err is None:
+                    first_err = (s, e)
+        if first_err is not None:
+            lost = [s for s, h in enumerate(self.handles)
+                    if not h.ping(timeout=5.0)]
+            raise StageLostError(
+                lost[0] if lost else first_err[0],
+                f"{type(first_err[1]).__name__}: {first_err[1]}",
+                stages=lost or [first_err[0]])
+        return metrics
+
+    # ------------------------------------------------------- checkpointing
+    def _checkpoint_all(self, step):
+        futs = [h.checkpoint(step) for h in self.handles]
+        for s, fut in enumerate(futs):
+            if self.handles[s].remote:
+                # keep the REF: the snapshot object stays in the arena
+                # (cross-node restores ride the data plane); fetching it
+                # to the controller would defeat the zero-copy path
+                self._snap_refs[s] = fut
+                # surface checkpoint errors without materializing: a
+                # ping after submission is enough — the fetch happens
+                # only on restore
+            else:
+                self._snap_refs[s] = self.handles[s].fetch(fut)
+        self._ckpt_step = step
+
+    def _restore_source(self, stage_idx: int):
+        """Recovery ladder for a replacement stage's shard: object-store
+        snapshot ref first; durable storage shard (one host reads, the
+        weight plane fans out — sharded_checkpoint.restore_and_broadcast)
+        when the ref is gone."""
+        snap = self._snap_refs.get(stage_idx)
+        if snap is not None and self.handles and \
+                self.handles[stage_idx].remote:
+            try:
+                # probe the ref is still materializable (the dead
+                # stage's node may have taken it down with it)
+                import ray_tpu
+                ready, _ = ray_tpu.wait([snap], num_returns=1, timeout=5.0)
+                if not ready:
+                    snap = None
+            except Exception:
+                snap = None
+        if snap is not None:
+            return snap
+        if self.config.storage_path:
+            from ray_tpu.train.sharded_checkpoint import (
+                restore_stage_shard)
+            return restore_stage_shard(self.config.storage_path, stage_idx,
+                                       broadcast=self.remote)
+        raise PipelineDegradedError(
+            f"no restore source for stage {stage_idx} (snapshot ref lost "
+            "and no storage_path configured)")
+
+    # ------------------------------------------------------------ recovery
+    def _prepare_recovery(self, step, lost: List[int], cause: str = ""
+                          ) -> int:
+        """Budget check → park survivors at the bounded barrier →
+        re-provision lost stages from their shards → roll survivors back
+        to the checkpoint boundary. Returns the boundary step the replay
+        must start after. Raises PipelineDegradedError when stage-level
+        recovery cannot proceed (policy/budget/barrier)."""
+        from ray_tpu._private import events
+        policy = getattr(self.failure_config, "restart_policy", "job")
+        if policy != "stage":
+            raise PipelineDegradedError(
+                f"stage {lost} lost at step {step} and "
+                f"restart_policy={policy!r}: job-level restart required")
+        if self._failures_left <= 0:
+            raise PipelineDegradedError(
+                f"stage {lost} lost at step {step}: failure budget "
+                f"exhausted (max_failures="
+                f"{self.failure_config.max_failures})")
+        self._failures_left -= 1
+        events.record_instant(
+            "train.mpmd.stage_lost", category="train", step=step,
+            stages=",".join(map(str, lost)), cause=cause[:200])
+        time.sleep(getattr(self.failure_config, "restart_backoff_s", 0.0)
+                   or 0.0)
+
+        # 1. park survivors at the bounded-deadline barrier
+        survivors = [s for s in range(self.n_stages) if s not in lost]
+        deadline = time.monotonic() + self.config.barrier_deadline_s
+        barrier = [(s, self.handles[s].abort_step(step)) for s in survivors]
+        stragglers = []
+        for s, fut in barrier:
+            left = deadline - time.monotonic()
+            try:
+                self.handles[s].fetch(fut, timeout=max(0.1, left))
+            except Exception:
+                stragglers.append(s)
+        if stragglers:
+            raise PipelineDegradedError(
+                f"survivors {stragglers} missed the "
+                f"{self.config.barrier_deadline_s}s park barrier after "
+                f"stage {lost} loss — degrading to job-level restart")
+
+        # 2. re-provision lost stages from their shard checkpoints
+        for s in lost:
+            try:
+                self.handles[s].kill()
+            except Exception:
+                pass   # rtlint: disable=RT004 — corpse may be gone
+            self.handles[s] = self._provision(s, self._restore_source(s))
+
+        # 3. roll surviving stages back to the checkpoint boundary
+        boundary = self._ckpt_step
+        roll = [(s, self.handles[s].rollback()) for s in survivors]
+        for s, fut in roll:
+            got = self.handles[s].fetch(fut, timeout=60.0)
+            if got != boundary:
+                raise PipelineDegradedError(
+                    f"stage {s} rolled back to step {got}, controller "
+                    f"checkpoint boundary is {boundary}")
+        return boundary
+
+    def _note_recovery(self, step, lost, cause, boundary, replayed,
+                       recovery_s):
+        from ray_tpu._private import events
+        self.recoveries.append({
+            "step": step, "stages": list(lost), "cause": cause,
+            "boundary": boundary, "replayed_steps": list(replayed),
+            "steps_lost": len(replayed),
+            "recovery_s": round(recovery_s, 3)})
+        events.record_instant(
+            "train.mpmd.stage_rejoined", category="train", step=step,
+            stages=",".join(map(str, lost)), boundary=boundary,
+            steps_replayed=len(replayed),
+            recovery_ms=round(recovery_s * 1e3, 1))
+
+    # --------------------------------------------------- graceful migration
+    def _migrate_preempting(self, step):
+        """Boundary-time migration for stages whose host got a
+        preemption NOTICE (watch thread / marker file): fresh
+        checkpoint, replacement provisioned from it, old actor reaped —
+        zero replayed steps, optimizer state untouched."""
+        preempting = []
+        for s, h in enumerate(self.handles):
+            try:
+                if h.preempting():
+                    preempting.append(s)
+            except Exception:
+                continue
+        if not preempting:
+            return
+        from ray_tpu._private import events
+        self._checkpoint_all(step)
+        for s in preempting:
+            old = self.handles[s]
+            self.handles[s] = self._provision(s, self._snap_refs[s])
+            try:
+                old.kill()
+            except Exception:
+                pass   # rtlint: disable=RT004 — host is going away anyway
+            if self._markers[s]:
+                try:
+                    os.remove(self._markers[s])
+                except FileNotFoundError:
+                    pass
+            events.record_instant(
+                "train.mpmd.stage_migrated", category="train", step=step,
+                stage=s)
+
+    # ------------------------------------------------------------- queries
+    def compile_counts(self) -> List[Dict[str, int]]:
+        futs = [h.compile_counts() for h in self.handles]
+        return [self.handles[s].fetch(f, timeout=30.0)
+                for s, f in enumerate(futs)]
+
+    def state_digests(self) -> List[str]:
+        futs = [h.state_digest() for h in self.handles]
+        return [self.handles[s].fetch(f, timeout=60.0)
+                for s, f in enumerate(futs)]
+
+    def shutdown(self):
+        for h in self.handles:
+            try:
+                if h.remote:
+                    h.fetch(h.actor.stop.remote(), timeout=5.0)
+                h.kill()
+            except Exception:
+                pass   # rtlint: disable=RT004 — teardown best-effort
+        self.handles = []

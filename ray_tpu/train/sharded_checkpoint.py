@@ -89,6 +89,57 @@ def restore_host_arrays(path: str) -> Any:
     return ckptr.restore(path)
 
 
+# ------------------------------------------------- MPMD stage shards
+# The elastic pipeline trainer (train/mpmd.py) checkpoints each stage's
+# (params, opt_state) shard at step boundaries. The object-store
+# snapshot ref is the fast path; these durable shards are the fallback
+# when the ref died with the stage's node. The write is a plain
+# cloudpickle blob through util.storage (one shard = one stage = one
+# host; there is nothing to coordinate, so orbax's multi-host machinery
+# would be pure overhead here).
+
+def _stage_shard_path(root: str, stage_idx: int) -> str:
+    from ray_tpu.util import storage as _storage
+    return _storage.join(root, f"stage_{stage_idx:03d}", "shard.pkl")
+
+
+def save_stage_shard(root: str, stage_idx: int, snapshot: Any) -> str:
+    """Persist one pipeline stage's host-array snapshot under
+    ``root/stage_NNN/`` (local path or fsspec URI). Overwrites the
+    previous boundary — the replay buffer only ever needs the latest."""
+    import cloudpickle
+
+    from ray_tpu.util import storage as _storage
+    path = _stage_shard_path(root, stage_idx)
+    _storage.makedirs(_storage.join(root, f"stage_{stage_idx:03d}"))
+    _storage.write_bytes(path, cloudpickle.dumps(snapshot))
+    return path
+
+
+def restore_stage_shard(root: str, stage_idx: int,
+                        broadcast: bool = False):
+    """Read one stage shard back. ``broadcast=True`` (cluster recovery)
+    routes the tree through ``ray_tpu.broadcast_weights`` and returns
+    the ObjectRef — the replacement stage attaches from its local arena
+    (``restore_and_broadcast``'s shape, scoped to one shard) with a
+    plain-put fallback when the weight plane is unavailable.
+    ``broadcast=False`` returns the snapshot tree itself."""
+    import cloudpickle
+
+    from ray_tpu.util import storage as _storage
+    snap = cloudpickle.loads(
+        _storage.read_bytes(_stage_shard_path(root, stage_idx)))
+    if not broadcast:
+        return snap
+    import ray_tpu
+    try:
+        return ray_tpu.broadcast_weights(snap)
+    except Exception:
+        # weight plane unavailable (single node, no data plane): the
+        # plain put still parks the shard arena-side for the attach
+        return ray_tpu.put(snap)
+
+
 def restore_from_broadcast(ref, abstract_state: Any = None) -> Any:
     """Materialize a broadcast checkpoint on this host: a zero-copy get
     from the local arena (the broadcast already landed the bytes here),

@@ -106,6 +106,22 @@ class JaxTrainer:
                                 if ckpt is not None:
                                     manager.register(ckpt, metrics)
                     done, error = executor.finished()
+                    if done and error is not None \
+                            and failure_cfg.restart_policy == "stage" \
+                            and failures_left > 0 \
+                            and executor.supports_worker_replace():
+                        # per-worker replace: only the dead ranks
+                        # restart (fresh actor, same bundle, latest
+                        # checkpoint pushed); survivors never stop
+                        time.sleep(failure_cfg.restart_backoff_s)
+                        latest = manager.latest_checkpoint() or resume
+                        replaced = executor.replace_failed_workers(latest)
+                        if replaced:
+                            failures_left -= 1
+                            error = None
+                            continue
+                        # nothing replaceable (e.g. a driver-side
+                        # error): fall through to the job-level ladder
                     if done:
                         break
                     time.sleep(0.25)
@@ -137,4 +153,4 @@ class JaxTrainer:
                               metrics_history=history)
             failures_left -= 1
             resume = manager.latest_checkpoint() or resume
-            time.sleep(1.0)
+            time.sleep(failure_cfg.restart_backoff_s)

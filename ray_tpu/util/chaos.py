@@ -256,6 +256,78 @@ class ShellAttachKiller:
         rpc._CHAOS_SPEC = None
 
 
+class StageKiller:
+    """Injects stage loss into the elastic MPMD pipeline trainer
+    (train/mpmd.py) through BOTH failure channels the recovery path must
+    handle:
+
+    * ``stage_step=p`` — the armed stage runs the injection hook at
+      forward/backward entry; when it fires, an ACTOR stage SIGKILLs its
+      own process mid-step (the crash shape: no exception reaches the
+      controller, the actor just dies holding in-flight microbatches),
+      while a LOCAL stage handle marks itself dead and raises — the
+      in-process stand-in for the same loss. Surviving stages must park
+      at the recovery barrier, the controller re-provisions the stage
+      from its shard checkpoint, and replay rejoins the pipeline.
+    * :meth:`preempt_stage` — writes the stage's preemption-notice
+      marker file (the ``tpu.check_preemption_notice`` test channel,
+      same file the PR 9 serving lifecycle uses); the stage's watch
+      thread reports ``preempting`` and the controller migrates it at
+      the NEXT step boundary — the graceful notice → drain → replace
+      path, zero replayed steps.
+
+    Spec: ``RAY_TPU_TESTING_RPC_FAILURE="stage_step=p"``; like the other
+    RPC-chaos specs the env must be set before the victim process parses
+    it (first injection check caches the spec). ``arm_local`` /
+    ``disarm_local`` reset the cache for in-process tests."""
+
+    SPEC_ENV = "RAY_TPU_TESTING_RPC_FAILURE"
+
+    def __init__(self, probability: float = 1.0):
+        if not 0.0 < probability <= 1.0:
+            raise ValueError("probability must be in (0, 1]")
+        self.probability = probability
+
+    def spec(self) -> str:
+        return f"stage_step={self.probability}"
+
+    def env(self, base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+        e = dict(base if base is not None else os.environ)
+        prior = e.get(self.SPEC_ENV)
+        e[self.SPEC_ENV] = f"{prior},{self.spec()}" if prior else self.spec()
+        return e
+
+    def arm_local(self):
+        """Arm the CURRENT process (LocalStageHandle tests): sets the
+        env var and resets rpc.py's parsed-spec cache so the next
+        injection check re-reads it. Pair with :meth:`disarm_local`."""
+        from ray_tpu._private import rpc
+        os.environ[self.SPEC_ENV] = self.spec()
+        rpc._CHAOS_SPEC = None
+
+    @staticmethod
+    def disarm_local():
+        from ray_tpu._private import rpc
+        os.environ.pop(StageKiller.SPEC_ENV, None)
+        rpc._CHAOS_SPEC = None
+
+    # ------------------------------------------- graceful notice channel
+    @staticmethod
+    def preempt_stage(marker_path: str) -> None:
+        """Flip a LIVE stage's preemption notice by creating its marker
+        file (the path passed to the stage as ``preempt_marker``; the
+        watch thread polls it at ``mpmd_health_poll_s``)."""
+        with open(marker_path, "w") as f:
+            f.write("preempt\n")
+
+    @staticmethod
+    def clear_notice(marker_path: str) -> None:
+        try:
+            os.remove(marker_path)
+        except FileNotFoundError:
+            pass
+
+
 class ServeReplicaKiller:
     """Kill serve replica actors mid-request (streaming included) and
     let the controller's reconcile loop replace them — the serving

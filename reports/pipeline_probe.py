@@ -1,0 +1,149 @@
+"""Elastic MPMD pipeline probe (bench.py subprocess).
+
+Measures the in-process MPMD pipeline (train/mpmd.py LocalStageHandle —
+the transport-independent half of the trainer; the actor gang adds RPC
+hops, not different math) on the virtual CPU mesh:
+
+  - steady-state step latency (median ms/step, first compile step
+    excluded) and steps/s for the 1F1B schedule
+  - measured per-stage bubble fraction (1 - compute/wall) next to the
+    analytic (S-1)/(M+S-1) bound
+  - recovery cost under ONE injected stage kill mid-step (chaos
+    StageKiller shape, armed deterministically): steps lost (replayed)
+    and wall-clock recovery time, with the bit-identity + compile-once
+    acceptance checks asserted inline — a probe that reports numbers
+    from a run that diverged would be worse than no probe.
+
+Usage: python pipeline_probe.py --one '{"n_stages": 2,
+    "n_microbatches": 8, "steps": 10, "d_model": 64, "runs": 3}'
+Prints one line: RESULT {json}
+"""
+
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _builders(n_stages, d_model, n_layers_per_stage=1):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def builder(stage_idx):
+        from ray_tpu.train.mpmd import StageDefinition
+        ks = jax.random.split(jax.random.PRNGKey(stage_idx + 1),
+                              n_layers_per_stage)
+        params = [{"w": jax.random.normal(k, (d_model, d_model)) * 0.3,
+                   "b": jnp.zeros((d_model,))} for k in ks]
+
+        def stage_fn(ps, x):
+            for p in ps:
+                x = jnp.tanh(x @ p["w"] + p["b"])
+            return x
+
+        loss_fn = None
+        if stage_idx == n_stages - 1:
+            def loss_fn(y, t):
+                return jnp.mean((y - t) ** 2)
+        return StageDefinition(stage_fn=stage_fn, params=params,
+                               optimizer=optax.adamw(1e-3),
+                               loss_fn=loss_fn)
+    return builder
+
+
+def run(spec):
+    import time
+
+    import numpy as np
+
+    from ray_tpu.parallel.pipeline import pipeline_bubble_fraction
+    from ray_tpu.train.config import FailureConfig
+    from ray_tpu.train.mpmd import MPMDConfig, MPMDPipelineTrainer
+
+    n_stages = int(spec.get("n_stages", 2))
+    M = int(spec.get("n_microbatches", 8))
+    steps = int(spec.get("steps", 10))
+    d_model = int(spec.get("d_model", 64))
+    mb = int(spec.get("microbatch", 8))
+    runs = int(spec.get("runs", 3))
+
+    builder = _builders(n_stages, d_model)
+
+    def data_fn(step):
+        rng = np.random.RandomState(step)
+        ins = [rng.randn(mb, d_model).astype(np.float32)
+               for _ in range(M)]
+        tgts = [rng.randn(mb, d_model).astype(np.float32)
+                for _ in range(M)]
+        return ins, tgts
+
+    cfg = MPMDConfig(n_microbatches=M, replay_depth=2)
+    fc = FailureConfig(max_failures=2, restart_policy="stage",
+                       restart_backoff_s=0.0)
+
+    # --- steady-state latency (median over runs of per-run medians) ---
+    run_medians, bubbles = [], []
+    for _rep in range(runs):
+        tr = MPMDPipelineTrainer([builder] * n_stages, cfg, fc)
+        out = tr.fit(data_fn, steps)
+        walls = [h["wall_s"] for h in out["history"][1:]]   # skip compile
+        walls.sort()
+        run_medians.append(walls[len(walls) // 2] * 1e3)
+        per_stage = []
+        for s in range(n_stages):
+            fr = [h[f"stage{s}_bubble_fraction"]
+                  for h in out["history"][1:]]
+            per_stage.append(sum(fr) / len(fr))
+        bubbles.append(per_stage)
+        for counts in tr.compile_counts():
+            assert counts["fwd"] == 1 and counts["bwd"] == 1, counts
+    run_medians.sort()
+    step_ms = run_medians[len(run_medians) // 2]
+    bubble = [round(sum(b[s] for b in bubbles) / len(bubbles), 4)
+              for s in range(n_stages)]
+
+    # --- recovery under one injected mid-step stage kill --------------
+    base = MPMDPipelineTrainer([builder] * n_stages, cfg, fc)
+    base.fit(data_fn, steps)
+    kill_step = max(3, steps // 2)
+    tr = MPMDPipelineTrainer([builder] * n_stages, cfg, fc)
+    tr.start()
+    tr.handles[n_stages - 1]._fail_at = (kill_step, "F")
+    t0 = time.perf_counter()
+    out = tr.fit(data_fn, steps)
+    elastic_wall_s = time.perf_counter() - t0
+    assert out["recoveries"], "injected stage kill never fired"
+    rec = out["recoveries"][0]
+    assert tr.state_digests() == base.state_digests(), \
+        "post-recovery state diverged from uninterrupted run"
+
+    spread = ((run_medians[-1] - run_medians[0]) / step_ms
+              if step_ms else 0.0)
+    return {
+        "mpmd_pipeline_step_ms": round(step_ms, 3),
+        "steps_per_s": round(1e3 / step_ms, 3) if step_ms else 0.0,
+        "n_stages": n_stages, "n_microbatches": M,
+        "schedule": "1f1b",
+        "bubble_fraction_per_stage": bubble,
+        "bubble_fraction_analytic": round(
+            pipeline_bubble_fraction(n_stages, M), 4),
+        "spread": round(spread, 3),
+        "runs": [round(r, 3) for r in run_medians],
+        "recovery": {
+            "kill_step": kill_step,
+            "steps_lost": rec["steps_lost"],
+            "recovery_ms": round(rec["recovery_s"] * 1e3, 1),
+            "elastic_run_s": round(elastic_wall_s, 3),
+            "bit_identical": True,
+            "compile_once": True,
+        },
+    }
+
+
+if __name__ == "__main__":
+    spec = json.loads(sys.argv[sys.argv.index("--one") + 1])
+    print("RESULT " + json.dumps(run(spec)), flush=True)

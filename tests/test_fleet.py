@@ -1084,3 +1084,144 @@ def test_tenant_quota_429_through_http_proxy(ray_start):
         from ray_tpu._private.config import cfg as rt_cfg
         rt_cfg.reset("tenant_queue_max")
         serve.shutdown()
+
+
+# --------------------------------------------------- weight-source attach
+# ROADMAP item 3 leftover: shell revivals attach weights from the PR 11
+# arena (serve/weights.py resolve_weight_source) instead of re-running
+# params_fn — KV-recorded broadcast ref, put-fallback, loader fallback.
+
+class _FakeKV:
+    def __init__(self):
+        self.store = {}
+
+    def gcs_call(self, method, ns=None, key=None, value=None):
+        if method == "kv_put":
+            self.store[(ns, key)] = value
+            return None
+        if method == "kv_get":
+            return self.store.get((ns, key))
+        if method == "kv_del":
+            self.store.pop((ns, key), None)
+            return None
+        raise AssertionError(method)
+
+
+@pytest.fixture()
+def fake_weight_plane(monkeypatch):
+    """serve/weights.py wired to an in-memory KV + object store."""
+    from ray_tpu.serve import weights as W
+    kv = _FakeKV()
+    objects = {}
+    counter = itertools.count()
+
+    class Ref:
+        def __init__(self, n):
+            self.n = n
+
+    def broadcast(tree, node_ids=None, **kw):
+        ref = Ref(next(counter))
+        objects[ref.n] = tree
+        return ref
+
+    def put(tree):
+        ref = Ref(next(counter))
+        objects[ref.n] = tree
+        return ref
+
+    def get(ref, timeout=None):
+        if ref.n not in objects:
+            raise RuntimeError("object lost")
+        return objects[ref.n]
+
+    monkeypatch.setattr(W, "_connected", lambda: True)
+    monkeypatch.setattr(W, "_worker", lambda: kv)
+    monkeypatch.setattr(ray_tpu, "broadcast_weights", broadcast)
+    monkeypatch.setattr(ray_tpu, "put", put)
+    monkeypatch.setattr(ray_tpu, "get", get)
+    return {"kv": kv, "objects": objects}
+
+
+def test_weight_source_loader_runs_once(fake_weight_plane):
+    from ray_tpu.serve import weights as W
+    calls = []
+
+    def loader():
+        calls.append(1)
+        return {"w": 1.0}
+
+    first = W.resolve_weight_source("llm/m/0", loader, enabled=True)
+    assert first == {"w": 1.0} and len(calls) == 1
+    # second attach (the shell-revival shape): arena ref, no loader
+    second = W.resolve_weight_source("llm/m/0", loader, enabled=True)
+    assert second == {"w": 1.0} and len(calls) == 1
+
+
+def test_weight_source_put_fallback(fake_weight_plane, monkeypatch):
+    from ray_tpu.serve import weights as W
+
+    def broken_broadcast(tree, **kw):
+        raise RuntimeError("no data plane")
+    monkeypatch.setattr(ray_tpu, "broadcast_weights", broken_broadcast)
+    calls = []
+    out = W.resolve_weight_source("k2", lambda: calls.append(1)
+                                  or {"w": 2.0}, enabled=True)
+    assert out == {"w": 2.0} and calls == [1]
+    # the put-fallback still recorded a usable ref
+    out2 = W.resolve_weight_source("k2", lambda: calls.append(1)
+                                   or {"w": 2.0}, enabled=True)
+    assert out2 == {"w": 2.0} and len(calls) == 1
+
+
+def test_weight_source_stale_ref_reloads(fake_weight_plane):
+    from ray_tpu.serve import weights as W
+    calls = []
+
+    def loader():
+        calls.append(1)
+        return {"w": 3.0}
+
+    W.resolve_weight_source("k3", loader, enabled=True)
+    # the broadcast object dies (node loss); the recorded ref goes stale
+    fake_weight_plane["objects"].clear()
+    out = W.resolve_weight_source("k3", loader, enabled=True)
+    assert out == {"w": 3.0} and len(calls) == 2
+    # ...and the reload re-published: next attach is arena again
+    W.resolve_weight_source("k3", loader, enabled=True)
+    assert len(calls) == 2
+
+
+def test_weight_source_disabled_reruns_loader(fake_weight_plane):
+    from ray_tpu.serve import weights as W
+    calls = []
+    for _ in range(2):
+        W.resolve_weight_source("k4", lambda: calls.append(1) or {},
+                                enabled=False)
+    assert len(calls) == 2
+    assert fake_weight_plane["kv"].store == {}
+
+
+def test_llm_deployment_auto_weights_key(monkeypatch):
+    """LLMDeployment derives the arena key from (model, seed) for
+    registry models and routes params_fn through the resolver."""
+    from ray_tpu.inference import api as api_mod
+    from ray_tpu.serve import weights as W
+    seen = {}
+
+    def fake_resolve(key, loader, **kw):
+        seen["key"] = key
+        return loader()
+    monkeypatch.setattr(W, "resolve_weight_source", fake_resolve)
+
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models import MODEL_REGISTRY, TransformerLM
+    m = TransformerLM(MODEL_REGISTRY["llama-debug"])
+
+    def pf():
+        t0 = jnp.zeros((1, 8), jnp.int32)
+        return m.init(jax.random.PRNGKey(0), t0)["params"]
+
+    api_mod.LLMDeployment("llama-debug", n_slots=2, max_len=32,
+                          params_fn=pf, seed=7)
+    assert seen["key"] == "llm/llama-debug/7"
