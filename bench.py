@@ -522,6 +522,12 @@ def bench_serve_tokens_per_s(tpu_ok: bool = False):
 # targets >= 2x.
 R05_SERVE_TOKENS_PER_S = 1217.9
 
+# train_step_mfu has been 0.564 since r04 (tpu-3b, bf16 params +
+# adafactor + chunked CE on one v5e chip): the round-6 ratchet floor.
+# An on-TPU MFU below this is a training-path regression — the
+# artifact flags it loudly, mirroring the serve_tokens_per_s ratchet.
+R05_TRAIN_STEP_MFU = 0.564
+
 
 def bench_serve_prefix_tokens_per_s(tpu_ok: bool = False):
     """Shared-system-prompt serving throughput (the radix-cache rung of
@@ -674,16 +680,19 @@ def bench_mpmd_pipeline_step_ms():
     per-stage programs + 1F1B microbatch schedule through the
     train/mpmd.py dispatcher on the virtual CPU mesh — median ms/step
     and steps/s, per-stage bubble fraction next to the analytic
-    (S-1)/(M+S-1) bound, and the recovery cost of ONE injected stage
-    kill mid-step (steps lost <= replay_depth + 1, bit-identity and
-    compile-once asserted inside the probe). Runs without a cluster —
-    the local transport shares every line of schedule/recovery code
-    with the actor gang."""
+    (S-1)/(M+S-1) and interleaved (S-1)/(v*M+S-1) bounds, the
+    interleaved-vs-plain modeled span ratio (`vs_plain_1f1b` < 1.0 is
+    the round-6 acceptance bar), the off-step checkpoint and donation
+    step-time splits, and the recovery cost of ONE injected stage kill
+    mid-step AT v=2 (steps lost <= replay_depth + 1, bit-identity and
+    per-virtual-chunk compile-once asserted inside the probe). Runs
+    without a cluster — the local transport shares every line of
+    schedule/recovery code with the actor gang."""
     import os
     here = os.path.dirname(os.path.abspath(__file__))
     runner = os.path.join(here, "reports", "pipeline_probe.py")
     spec = {"n_stages": 2, "n_microbatches": 8, "steps": 10,
-            "d_model": 64, "runs": 3}
+            "d_model": 64, "runs": 3, "v": 2}
     last = "unknown"
     for attempt in range(2):
         if attempt:
@@ -1041,10 +1050,23 @@ def main():
                     pp["bubble_fraction_per_stage"],
                 "bubble_fraction_analytic":
                     pp["bubble_fraction_analytic"],
+                "bubble_fraction_analytic_interleaved":
+                    pp.get("bubble_fraction_analytic_interleaved"),
+                # round-6 interleaved virtual-stage comparison: same
+                # total model as plain 1F1B, parallel span modeled by
+                # simulate_timeline over MEASURED per-op durations;
+                # < 1.0 = the schedule pays (acceptance criterion)
+                "vs_plain_1f1b": pp.get("vs_plain_1f1b"),
+                "interleaved": pp.get("interleaved"),
+                "checkpoint_off_step_ms":
+                    pp.get("checkpoint_off_step_ms"),
+                "donate_off_step_ms": pp.get("donate_off_step_ms"),
+                "donate_on_step_ms": pp.get("donate_on_step_ms"),
                 "spread": pp["spread"], "runs": pp["runs"],
                 "recovery": pp["recovery"]}
             log(f"mpmd_pipeline_step_ms: {pp['mpmd_pipeline_step_ms']} "
-                f"(recovery steps_lost="
+                f"(vs_plain_1f1b {pp.get('vs_plain_1f1b')}, "
+                f"recovery steps_lost="
                 f"{pp['recovery']['steps_lost']}, "
                 f"{pp['recovery']['recovery_ms']}ms)")
         else:
@@ -1319,13 +1341,22 @@ def main():
         results["observability_overhead"] = {"skipped": True,
                                              "reason": str(e)[:200]}
     if not mfu_res.get("skipped"):
+        vs_r05_mfu = round(mfu_res["mfu"] / R05_TRAIN_STEP_MFU, 3)
         results["train_step_mfu"] = {
             "value": round(mfu_res["mfu"], 4),
             "vs_baseline": round(mfu_res["mfu"] / MFU_BASELINE, 3),
+            "vs_r05_ratchet": vs_r05_mfu,
             "tokens_per_s": round(mfu_res["tokens_per_s"], 1),
             "ms_per_step": round(mfu_res["ms_per_step"], 2),
             "model": mfu_res.get("model"),
         }
+        if vs_r05_mfu < 1.0:
+            # the step-time ratchet: an on-TPU MFU below the r04/r05
+            # 0.564 plateau is a training regression — make it loud in
+            # the artifact, not just on stderr
+            results["train_step_mfu"]["regressed_vs_r05"] = True
+            log(f"train_step_mfu REGRESSED vs r05: "
+                f"{vs_r05_mfu}x of {R05_TRAIN_STEP_MFU}")
         headline = {"metric": "train_step_mfu",
                     "value": results["train_step_mfu"]["value"],
                     "unit": "fraction_of_v5e_peak",

@@ -111,15 +111,33 @@ def make_pipeline_fns(stage_fn: Callable, mesh: Mesh,
 # Host-level microbatch schedules for the MPMD pipeline (train/mpmd.py):
 # per-stage programs on separate meshes, activations shipped stage-to-
 # stage through the object store instead of lax.ppermute. Ops are
-# ("F", mb) / ("B", mb) tuples in per-stage execution order; cross-stage
-# data dependencies (F(s, m) needs F(s-1, m)'s activation, B(s, m) needs
-# B(s+1, m)'s input-gradient) are enforced by the dispatcher, not the
-# schedule — these lists only fix each stage's LOCAL order, which is what
-# determines both the bubble and the grad-accumulation order (replay
-# determinism depends on the latter).
+# ("F", mb) / ("B", mb) tuples in per-stage execution order — or
+# ("F", mb, chunk) triples when the stage hosts interleaved virtual
+# chunks (schedule_interleaved_1f1b); cross-stage data dependencies
+# (F(vs, m) needs F(vs-1, m)'s activation, B(vs, m) needs B(vs+1, m)'s
+# input-gradient, in VIRTUAL stage order vs = chunk*S + s) are enforced
+# by the dispatcher, not the schedule — these lists only fix each
+# stage's LOCAL order, which is what determines both the bubble and the
+# grad-accumulation order (replay determinism depends on the latter).
 
 OP_FWD = "F"
 OP_BWD = "B"
+
+
+def op_chunk(op) -> int:
+    """Virtual-chunk index of a schedule op; plain (op, mb) tuples are
+    chunk 0."""
+    return op[2] if len(op) > 2 else 0
+
+
+def _schedule_chunks(schedules) -> int:
+    """Number of virtual chunks per stage in a schedule (v); 1 for the
+    plain 2-tuple schedules."""
+    v = 1
+    for ops in schedules:
+        for op in ops:
+            v = max(v, op_chunk(op) + 1)
+    return v
 
 
 def schedule_gpipe(n_stages: int, n_microbatches: int):
@@ -154,62 +172,209 @@ def schedule_1f1b(n_stages: int, n_microbatches: int):
     return out
 
 
-def make_schedule(kind: str, n_stages: int, n_microbatches: int):
+def schedule_interleaved_1f1b(n_stages: int, n_microbatches: int, v: int):
+    """Interleaved (virtual-stage) 1F1B, the arXiv 2412.14374 /
+    Megatron-style schedule: each physical stage s hosts v virtual
+    chunks, chunk c being virtual stage vs = c*S + s of a V = v*S deep
+    virtual pipeline. Forwards fill in round-robin blocks of S
+    microbatches per chunk, backwards drain the same way, so the flush
+    bubble shrinks from (S-1)/(M+S-1) toward (S-1)/(v*M+S-1).
+
+    Ops are (op, mb, chunk) triples, with each chunk's forwards AND
+    backwards in strict microbatch order — the backward order is what
+    makes grad accumulation, and therefore recovery replay, bit-
+    identical to running the V virtual stages as V plain 1F1B stages.
+
+    When M % S == 0 (Megatron's requirement) the closed-form ordering
+    is used and the modeled bubble meets the analytic bound exactly:
+    stage s runs 2*(S-1-s) + (v-1)*S warmup forwards, then 1F1B
+    alternation, forwards/backwards drawn from chunks in round-robin
+    blocks of S microbatches (backwards from the deepest chunk first).
+    Otherwise a unit-time greedy simulation over the virtual-stage
+    dependency DAG (F(vs, m) after F(vs-1, m); B(vs, m) after F(vs, m)
+    and B(vs+1, m)) emits a valid — slightly bubblier — schedule;
+    either way the result is deadlock-free (the closed form is
+    validated by simulate_schedule, the greedy order is a projection
+    of a global topological execution).
+    """
+    if n_stages < 1 or n_microbatches < 1 or v < 1:
+        raise ValueError("need n_stages >= 1, n_microbatches >= 1, v >= 1")
+    if v == 1:
+        return [[(op, mb, 0) for op, mb in ops]
+                for ops in schedule_1f1b(n_stages, n_microbatches)]
+    if n_microbatches % n_stages == 0:
+        return _interleaved_closed_form(n_stages, n_microbatches, v)
+    return _interleaved_greedy(n_stages, n_microbatches, v)
+
+
+def _interleaved_closed_form(S: int, M: int, v: int):
+    """Megatron-style interleaved 1F1B for M % S == 0; bubble hits
+    (S-1)/(v*M+S-1) under uniform op times."""
+    total = v * M
+    out = []
+    for s in range(S):
+        fseq, fptr = [], [0] * v
+        for k in range(total):
+            c = (k // S) % v
+            fseq.append((OP_FWD, fptr[c], c))
+            fptr[c] += 1
+        bseq, bptr = [], [0] * v
+        for k in range(total):
+            c = v - 1 - (k // S) % v
+            bseq.append((OP_BWD, bptr[c], c))
+            bptr[c] += 1
+        warmup = min(2 * (S - 1 - s) + (v - 1) * S, total)
+        ops = list(fseq[:warmup])
+        for i in range(total - warmup):
+            ops.append(fseq[warmup + i])
+            ops.append(bseq[i])
+        ops.extend(bseq[max(total - warmup, 0):])
+        out.append(ops)
+    simulate_schedule(out)                 # assert deadlock-freedom
+    return out
+
+
+def _interleaved_greedy(S: int, M: int, v: int):
+    """Greedy fallback for M % S != 0: backward-first unit-time
+    simulation over the virtual-stage DAG; valid for any (S, M, v) but
+    does not always reach the analytic bubble bound."""
+    V = v * S
+    next_f = [0] * V                     # per-virtual-stage microbatch FIFOs
+    next_b = [0] * V
+    f_done = [[-1] * M for _ in range(V)]   # finish tick, -1 = not yet
+    b_done = [[-1] * M for _ in range(V)]
+    out = [[] for _ in range(S)]
+    remaining = 2 * V * M
+    tick = 0
+    while remaining:
+        ran_this_tick = []
+        for s in range(S):
+            # Backward-first (1F1B steady state bounds live activations);
+            # among ready ops prefer the one earliest in the interleaved
+            # round-robin order: blocks of S microbatches per chunk,
+            # deeper chunks drain first on the backward side.
+            best = None
+            for c in range(v):
+                vs = c * S + s
+                m = next_b[vs]
+                if (m < M and 0 <= f_done[vs][m] < tick
+                        and (vs == V - 1 or 0 <= b_done[vs + 1][m] < tick)):
+                    key = (0, (m // S) * V + (V - 1 - vs))
+                    if best is None or key < best[0]:
+                        best = (key, OP_BWD, m, c, vs)
+            if best is None:
+                for c in range(v):
+                    vs = c * S + s
+                    m = next_f[vs]
+                    if (m < M and
+                            (vs == 0 or 0 <= f_done[vs - 1][m] < tick)):
+                        key = (1, (m // S) * V + vs)
+                        if best is None or key < best[0]:
+                            best = (key, OP_FWD, m, c, vs)
+            if best is not None:
+                ran_this_tick.append(best)
+        for _key, op, m, c, vs in ran_this_tick:
+            if op == OP_FWD:
+                next_f[vs] += 1
+                f_done[vs][m] = tick
+            else:
+                next_b[vs] += 1
+                b_done[vs][m] = tick
+            out[vs % S].append((op, m, c))
+            remaining -= 1
+        if not ran_this_tick:          # unreachable for a DAG; guard anyway
+            raise ValueError("interleaved schedule generator stalled at "
+                             f"tick {tick} with {remaining} ops left")
+        tick += 1
+    return out
+
+
+def make_schedule(kind: str, n_stages: int, n_microbatches: int,
+                  virtual: int = 1):
+    if virtual < 1:
+        raise ValueError("virtual stage count must be >= 1")
     if kind == "1f1b":
+        if virtual > 1:
+            return schedule_interleaved_1f1b(
+                n_stages, n_microbatches, virtual)
         return schedule_1f1b(n_stages, n_microbatches)
     if kind == "gpipe":
+        if virtual > 1:
+            raise ValueError(
+                "interleaved virtual stages require the '1f1b' schedule")
         return schedule_gpipe(n_stages, n_microbatches)
     raise ValueError(f"unknown pipeline schedule {kind!r} "
                      "(expected '1f1b' or 'gpipe')")
 
 
-def peak_live_activations(stage_ops) -> int:
-    """Max forwards outstanding (saved inputs awaiting their backward)
-    at any point of one stage's op list — the stage's activation-memory
-    high-water mark in microbatches."""
+def peak_live_activations(stage_ops, grad_buffers: bool = True) -> int:
+    """Buffer high-water mark of one stage's op list, in microbatch-
+    sized units: forwards outstanding (saved inputs awaiting their
+    backward) plus — once a chunk's first backward has run — that
+    chunk's grad-accumulation buffer, which stays live from first
+    backward until the step-boundary apply. The grad buffers are what
+    the old activation-only count missed: in 1F1B steady state a stage
+    holds min(S-s, M) stashes AND its running grad sum, so the true
+    peak is min(S-s, M) + 1. Pass grad_buffers=False for the legacy
+    activation-only number."""
     live = peak = 0
-    for op, _mb in stage_ops:
-        live += 1 if op == OP_FWD else -1
-        peak = max(peak, live)
+    accumulating: set = set()
+    for op in stage_ops:
+        if op[0] == OP_FWD:
+            live += 1
+        else:
+            live -= 1
+            accumulating.add(op_chunk(op))
+        held = live + (len(accumulating) if grad_buffers else 0)
+        peak = max(peak, held)
     return peak
 
 
-def pipeline_bubble_fraction(n_stages: int, n_microbatches: int) -> float:
-    """Analytic flush-bubble fraction (S-1)/(M+S-1) shared by GPipe and
-    non-interleaved 1F1B; the probe reports the measured per-stage idle
-    fraction next to this bound."""
-    return (n_stages - 1) / (n_microbatches + n_stages - 1)
+def pipeline_bubble_fraction(n_stages: int, n_microbatches: int,
+                             virtual: int = 1) -> float:
+    """Analytic flush-bubble fraction: (S-1)/(M+S-1) for GPipe and
+    plain 1F1B, shrinking to (S-1)/(v*M+S-1) under v-way interleaving
+    (each stage's idle gaps are filled by the other chunks' work); the
+    probe reports the measured per-stage idle fraction next to both
+    bounds."""
+    return (n_stages - 1) / (virtual * n_microbatches + n_stages - 1)
 
 
 def simulate_schedule(schedules):
     """Dependency-order simulation of per-stage op lists: repeatedly
     sweep the stages, running each stage's next op when its cross-stage
-    input is available. Returns the global execution order as
-    (tick, stage, op, mb) tuples; raises if the schedule deadlocks
-    (an op whose dependency can never arrive). The MPMD dispatcher uses
-    the same sweep against live stage handles; tests use this pure
-    version to pin schedule correctness."""
+    input is available. Handles both plain (op, mb) and interleaved
+    (op, mb, chunk) schedules — dependencies run in VIRTUAL stage order
+    vs = chunk*S + s. Returns the global execution order as
+    (sweep, stage, op, mb, chunk) tuples; raises if the schedule
+    deadlocks (an op whose dependency can never arrive). The MPMD
+    dispatcher uses the same sweep against live stage handles; tests
+    use this pure version to pin schedule correctness, and recovery
+    replay inherits its determinism from the same per-stage order."""
     S = len(schedules)
+    V = S * _schedule_chunks(schedules)
     queues = [list(ops) for ops in schedules]
-    fwd_done = [set() for _ in range(S)]   # mb whose F(s, m) completed
-    bwd_done = [set() for _ in range(S)]
+    fwd_done = [set() for _ in range(V)]   # mb whose F(vs, m) completed
+    bwd_done = [set() for _ in range(V)]
     order = []
     tick = 0
     while any(queues):
         progressed = False
         for s in range(S):
             while queues[s]:
-                op, mb = queues[s][0]
-                if op == OP_FWD:
-                    ready = s == 0 or mb in fwd_done[s - 1]
+                op = queues[s][0]
+                kind, mb, chunk = op[0], op[1], op_chunk(op)
+                vs = chunk * S + s
+                if kind == OP_FWD:
+                    ready = vs == 0 or mb in fwd_done[vs - 1]
                 else:
-                    ready = (mb in fwd_done[s]
-                             and (s == S - 1 or mb in bwd_done[s + 1]))
+                    ready = (mb in fwd_done[vs]
+                             and (vs == V - 1 or mb in bwd_done[vs + 1]))
                 if not ready:
                     break
                 queues[s].pop(0)
-                (fwd_done if op == OP_FWD else bwd_done)[s].add(mb)
-                order.append((tick, s, op, mb))
+                (fwd_done if kind == OP_FWD else bwd_done)[vs].add(mb)
+                order.append((tick, s, kind, mb, chunk))
                 progressed = True
         if not progressed:
             raise ValueError(
@@ -217,3 +382,47 @@ def simulate_schedule(schedules):
                 f"{[q[:2] for q in queues]}")
         tick += 1
     return order
+
+
+def simulate_timeline(schedules, op_time, transfer_time: float = 0.0):
+    """Event-timeline model of a schedule's parallel execution: each
+    stage executes its op list in order, an op starting at
+    max(stage free, dependencies finished + transfer_time) and running
+    for op_time(stage, op_kind, chunk) seconds. This is the physics the
+    bubble bounds approximate — the probe feeds it MEASURED per-op
+    durations to model the parallel step time and per-stage idle
+    fraction on hosts that can't run S real processes side by side.
+
+    Returns {"span": makespan, "stage_busy": [...], "stage_idle_frac":
+    [...], "bubble_fraction": mean idle frac} (idle measured against
+    the full makespan, matching how the trainer's per-stage
+    bubble_fraction gauge is computed)."""
+    S = len(schedules)
+    order = simulate_schedule(schedules)   # also validates deadlock-freedom
+    finish: dict = {}                      # (kind, mb, vs) -> finish time
+    stage_free = [0.0] * S
+    stage_busy = [0.0] * S
+    for _tick, s, kind, mb, chunk in order:
+        vs = chunk * S + s
+        deps = []
+        if kind == OP_FWD:
+            if vs > 0:
+                deps.append(finish[(OP_FWD, mb, vs - 1)] + transfer_time)
+        else:
+            deps.append(finish[(OP_FWD, mb, vs)])
+            V = S * _schedule_chunks(schedules)
+            if vs < V - 1:
+                deps.append(finish[(OP_BWD, mb, vs + 1)] + transfer_time)
+        start = max([stage_free[s]] + deps)
+        dur = float(op_time(s, kind, chunk))
+        finish[(kind, mb, vs)] = start + dur
+        stage_free[s] = start + dur
+        stage_busy[s] += dur
+    span = max(stage_free) if S else 0.0
+    idle = [1.0 - busy / span if span > 0 else 0.0 for busy in stage_busy]
+    return {
+        "span": span,
+        "stage_busy": stage_busy,
+        "stage_idle_frac": idle,
+        "bubble_fraction": sum(idle) / S if S else 0.0,
+    }

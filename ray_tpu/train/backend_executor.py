@@ -90,6 +90,44 @@ class TrainWorker:
         return True
 
 
+def acquire_slice_bundles(topology: str,
+                          worker_resources: Dict[str, float],
+                          num_workers: Optional[int] = None,
+                          wait_timeout_s: Optional[float] = None):
+    """Wait for a whole healthy multi-host slice and return
+    ``(pod, bundles, "STRICT_SPREAD")`` — the slice-gang acquisition
+    shared by :meth:`BackendExecutor.start` and the MPMD stage gangs
+    (``train.mpmd.GangStageHandle``), where one pipeline stage is a gang
+    of workers over one multi-host mesh. Competing gangs / restarting
+    nodes make slice availability transient; staying in the wait keeps
+    the demand visible instead of burning the caller's failure budget
+    instantly. Returns ``(None, None, None)`` for single-host
+    topologies (no gang needed)."""
+    from ray_tpu.train import slice as slice_lib
+    n_hosts, chips = slice_lib.slice_shape(topology)
+    if n_hosts <= 1:
+        return None, None, None
+    if num_workers is not None and num_workers != n_hosts:
+        raise ValueError(
+            f"topology {topology} has {n_hosts} hosts; "
+            f"num_workers={num_workers} must match")
+    from ray_tpu._private.config import cfg as _cfg
+    deadline = time.monotonic() + (
+        wait_timeout_s if wait_timeout_s is not None
+        else _cfg.slice_wait_timeout_s)
+    pod = None
+    while pod is None:
+        pod = slice_lib.pick_slice(ray_tpu.nodes(), topology)
+        if pod is None:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no healthy {topology} slice available "
+                    f"({n_hosts} hosts with {chips} free chips each)")
+            time.sleep(1.0)
+    bundles = slice_lib.slice_bundles(pod, topology, worker_resources)
+    return pod, bundles, "STRICT_SPREAD"
+
+
 class BackendExecutor:
     def __init__(self, scaling_config: ScalingConfig,
                  use_jax_distributed: bool = False):
@@ -115,31 +153,15 @@ class BackendExecutor:
             # slice via its pod resource, STRICT_SPREAD across its hosts
             # (fails-as-a-unit semantics come from the trainer restarting
             # the whole gang on any worker/node death)
-            from ray_tpu.train import slice as slice_lib
-            n_hosts, chips = slice_lib.slice_shape(topology)
-            if n_hosts > 1:
-                if n != n_hosts:
-                    raise ValueError(
-                        f"topology {topology} has {n_hosts} hosts; "
-                        f"ScalingConfig.num_workers={n} must match")
-                # wait for a whole healthy slice (competing gangs /
-                # restarting nodes make this transient; staying in the
-                # wait also keeps the demand visible instead of burning
-                # the trainer's failure budget instantly)
-                from ray_tpu._private.config import cfg as _cfg
-                deadline = time.monotonic() + _cfg.slice_wait_timeout_s
-                pod = None
-                while pod is None:
-                    pod = slice_lib.pick_slice(ray_tpu.nodes(), topology)
-                    if pod is None:
-                        if time.monotonic() > deadline:
-                            raise RuntimeError(
-                                f"no healthy {topology} slice available "
-                                f"({n_hosts} hosts with {chips} free "
-                                f"chips each)")
-                        time.sleep(1.0)
-                bundles = slice_lib.slice_bundles(pod, topology, res)
-                strategy = "STRICT_SPREAD"
+            try:
+                pod, slice_bundles, slice_strategy = acquire_slice_bundles(
+                    topology, res, num_workers=n)
+            except ValueError as e:
+                raise ValueError(str(e).replace(
+                    "num_workers", "ScalingConfig.num_workers")) from None
+            if pod is not None:
+                bundles = slice_bundles
+                strategy = slice_strategy
                 self.slice_pod = pod
         self.pg = placement_group(bundles, strategy=strategy)
         if not self.pg.wait(timeout=60):

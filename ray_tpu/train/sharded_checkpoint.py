@@ -116,6 +116,84 @@ def save_stage_shard(root: str, stage_idx: int, snapshot: Any) -> str:
     return path
 
 
+class AsyncShardWriter:
+    """Off-step durable shard writes for the elastic MPMD pipeline:
+    ``submit()`` enqueues a stage's latest boundary snapshot and returns
+    immediately; one daemon thread seals/puts the blobs through
+    :func:`save_stage_shard`, so the training hot path never waits on
+    storage. A newer submission for the same stage supersedes a queued
+    older one (only the latest boundary matters for recovery — same
+    rule as the overwrite in ``save_stage_shard``). ``barrier()`` drains
+    the queue and is called only on the recovery path, never per step;
+    write failures are remembered and surfaced there (the shards are
+    the FALLBACK restore source behind the object-store snapshot ref,
+    so a best-effort miss degrades, it does not corrupt)."""
+
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self._pending: dict = {}          # (root, stage_idx) -> snapshot
+        self._wake = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._stop = False
+        self._thread = None
+        self.last_error: Optional[BaseException] = None
+        self.writes = 0
+
+    def _ensure_thread(self):
+        import threading
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name="shard-writer", daemon=True)
+            self._thread.start()
+
+    def _loop(self):
+        while True:
+            self._wake.wait()
+            with self._lock:
+                if self._stop and not self._pending:
+                    return
+                if not self._pending:
+                    self._wake.clear()
+                    self._idle.set()
+                    continue
+                key, snap = next(iter(self._pending.items()))
+                del self._pending[key]
+            try:
+                save_stage_shard(key[0], key[1], snap)
+                with self._lock:
+                    self.writes += 1
+            except BaseException as e:   # surfaced at the next barrier
+                with self._lock:
+                    self.last_error = e
+
+    def submit(self, root: str, stage_idx: int, snapshot: Any):
+        with self._lock:
+            self._pending[(root, stage_idx)] = snapshot
+            self._idle.clear()
+        self._wake.set()
+        self._ensure_thread()
+
+    def barrier(self, timeout: Optional[float] = None) -> bool:
+        """Drain queued writes (recovery-time only). Returns False on
+        timeout; re-raises the last write error, if any, exactly once."""
+        if self._thread is None:
+            drained = True
+        else:
+            drained = self._idle.wait(timeout)
+        with self._lock:
+            err, self.last_error = self.last_error, None
+        if err is not None:
+            raise RuntimeError("async stage-shard write failed") from err
+        return drained
+
+    def stop(self):
+        with self._lock:
+            self._stop = True
+        self._wake.set()
+
+
 def restore_stage_shard(root: str, stage_idx: int,
                         broadcast: bool = False):
     """Read one stage shard back. ``broadcast=True`` (cluster recovery)

@@ -1,0 +1,24 @@
+"""BAD: donated-buffer reuse, optimizer-apply flavor (RT002).
+
+The bug class the MPMD trainer's donation audit guards against: the
+apply program donates (params, opt_state, grads) so XLA can update in
+place, which makes the CALLER'S handles to those buffers invalid — a
+checkpoint taken from the stale handle, or a gradient re-accumulated
+into the freed buffer, reads garbage.
+"""
+import jax
+
+
+def apply_fn(params, opt_state, grads):
+    new_params = params       # stand-in for the optax update
+    return new_params, opt_state
+
+
+jit_apply = jax.jit(apply_fn, donate_argnums=(0, 1, 2))
+
+
+def train_step(params, opt_state, grads):
+    out = jit_apply(params, opt_state, grads)
+    snapshot = params["w"]             # RT002: params was donated above
+    grads = grads + grads              # RT002: grads was donated above
+    return out, snapshot, grads

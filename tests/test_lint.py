@@ -48,6 +48,7 @@ def rules_hit(result):
     ("rt001_bad_handler.py", "RT001", 3),
     ("rt002_bad_coerce.py", "RT002", 3),
     ("rt002_bad_donate.py", "RT002", 2),
+    ("rt002_bad_donate_apply.py", "RT002", 2),
     ("rt003_bad_unlocked.py", "RT003", 3),
     ("rt003_bad_wrong_lock.py", "RT003", 1),
     ("_private/rt004_bad_daemon.py", "RT004", 2),

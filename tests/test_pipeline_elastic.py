@@ -59,8 +59,27 @@ def test_1f1b_peak_live_below_gpipe():
     f1b = plib.schedule_1f1b(s_n, m_n)
     gp = plib.schedule_gpipe(s_n, m_n)
     for s in range(s_n):
+        # gpipe peaks at M stashes during warmup (grad buffer only
+        # becomes live once stashes are draining, so M stays the peak)
         assert plib.peak_live_activations(gp[s]) == m_n
-        assert plib.peak_live_activations(f1b[s]) == min(s_n - s, m_n)
+        # 1f1b steady state: min(S-s, M) stashes + the grad-accumulation
+        # buffer held from first backward to the apply
+        assert plib.peak_live_activations(f1b[s]) == min(s_n - s, m_n) + 1
+        # legacy activation-only count (what the buffer sizing used to
+        # be computed from — one short per stage)
+        assert plib.peak_live_activations(f1b[s], grad_buffers=False) == \
+            min(s_n - s, m_n)
+
+
+def test_peak_live_pinned_s4_m8_interleaved():
+    """Satellite pin: steady-state buffer peaks for (S=4, M=8) at
+    v=1 and v=2 — the numbers MicrobatchReplayBuffer.budget() sizes
+    peak_microbatch_buffers from."""
+    assert [plib.peak_live_activations(ops)
+            for ops in plib.make_schedule("1f1b", 4, 8)] == [5, 4, 3, 2]
+    assert [plib.peak_live_activations(ops)
+            for ops in plib.make_schedule("1f1b", 4, 8, virtual=2)] == \
+        [13, 11, 9, 7]
 
 
 def test_schedules_simulate_without_deadlock():
@@ -70,13 +89,53 @@ def test_schedules_simulate_without_deadlock():
                 plib.make_schedule(kind, s_n, m_n))
             assert len(order) == 2 * s_n * m_n
             done = set()
-            for _tick, s, op, mb in order:
+            for _tick, s, op, mb, _chunk in order:
                 if op == plib.OP_FWD:
                     assert s == 0 or (s - 1, "F", mb) in done
                 else:
                     assert (s, "F", mb) in done
                     assert s == s_n - 1 or (s + 1, "B", mb) in done
                 done.add((s, op, mb))
+
+
+def test_interleaved_schedule_simulates_without_deadlock():
+    """Deadlock-freedom for v in {2, 3} across divisible and
+    non-divisible M (closed form and greedy fallback paths)."""
+    for v in (2, 3):
+        for s_n, m_n in [(2, 4), (4, 8), (3, 4), (4, 6), (2, 8)]:
+            sched = plib.schedule_interleaved_1f1b(s_n, m_n, v)
+            order = plib.simulate_schedule(sched)
+            assert len(order) == 2 * v * s_n * m_n
+            done = set()
+            for _tick, s, op, mb, chunk in order:
+                vs = chunk * s_n + s
+                if op == plib.OP_FWD:
+                    assert vs == 0 or (vs - 1, "F", mb) in done
+                else:
+                    assert (vs, "F", mb) in done
+                    assert vs == v * s_n - 1 or (vs + 1, "B", mb) in done
+                done.add((vs, op, mb))
+            # each chunk's F and B streams stay in microbatch order —
+            # the bit-identity invariant replay depends on
+            for s in range(s_n):
+                for c in range(v):
+                    for kind in (plib.OP_FWD, plib.OP_BWD):
+                        mbs = [op[1] for op in sched[s]
+                               if op[0] == kind and plib.op_chunk(op) == c]
+                        assert mbs == list(range(m_n))
+
+
+def test_interleaved_closed_form_meets_analytic_bound():
+    """When M % S == 0 the Megatron closed form must hit
+    (S-1)/(v*M+S-1) exactly under the unit-time event model."""
+    for v in (2, 3):
+        for s_n, m_n in [(2, 4), (4, 8), (3, 6)]:
+            sched = plib.schedule_interleaved_1f1b(s_n, m_n, v)
+            tl = plib.simulate_timeline(sched, lambda s, k, c: 1.0)
+            ideal = 2.0 * v * m_n  # per-stage busy ticks
+            bound = plib.pipeline_bubble_fraction(s_n, m_n, virtual=v)
+            assert tl["span"] == pytest.approx(ideal / (1.0 - bound),
+                                               rel=1e-9)
 
 
 def test_simulate_schedule_detects_deadlock():
@@ -173,7 +232,7 @@ def test_local_pipeline_trains_and_compiles_once():
     for counts in tr.compile_counts():
         assert counts == {"fwd": 1, "bwd": 1, "apply": 1}
     # per-stage bubble + peak-live bookkeeping present
-    assert out["peak_live_activations"] == [min(S - s, M)
+    assert out["peak_live_activations"] == [min(S - s, M) + 1
                                             for s in range(S)]
     assert 0.0 < out["bubble_fraction_analytic"] < 1.0
     assert "stage0_bubble_fraction" in out["history"][0]
@@ -381,8 +440,11 @@ def test_recovery_falls_back_to_storage_shard(tmp_path):
 
     def ckpt_and_persist(step):
         orig_ckpt(step)
-        for s, snap in tr._snap_refs.items():
-            save_stage_shard(str(tmp_path), s, snap)
+        for s in list(tr._snap_refs):
+            # async checkpoints park unresolved futures; the durable
+            # write needs the sealed snapshot (the actor's shard writer
+            # gets it from the on_sealed hook)
+            save_stage_shard(str(tmp_path), s, tr._resolve_snap(s))
     tr._checkpoint_all = ckpt_and_persist
     ckpt_and_persist(0)
     tr.handles[1]._fail_at = (3, "F")
@@ -407,6 +469,158 @@ def test_no_restore_source_degrades():
         tr.fit(_data_fn, 3)
 
 
+# ------------------------------------------- interleaved virtual stages
+
+V4 = 4          # virtual stages for the interleaving tests
+
+
+def _vbuilder(vs):
+    """Builder for an n_virtual=4 pipeline: one tanh layer per virtual
+    stage, loss on the deepest chunk."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    k = jax.random.PRNGKey(100 + vs)
+    params = {"w": jax.random.normal(k, (D, D)) * 0.3,
+              "b": jnp.zeros((D,))}
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    loss_fn = None
+    if vs == V4 - 1:
+        def loss_fn(y, t):
+            return jnp.mean((y - t) ** 2)
+    return StageDefinition(stage_fn=stage_fn, params=params,
+                           optimizer=optax.adamw(1e-2), loss_fn=loss_fn)
+
+
+def _vtrainer(virtual_stages, **cfg_kw):
+    cfg_kw.setdefault("n_microbatches", M)
+    return MPMDPipelineTrainer(
+        [_vbuilder] * V4,
+        MPMDConfig(virtual_stages=virtual_stages, **cfg_kw),
+        FailureConfig(max_failures=2, restart_policy="stage",
+                      restart_backoff_s=0.0))
+
+
+def test_interleaved_matches_plain_bit_identical():
+    """The tentpole invariant: v=2 over 2 hosts runs each chunk's F and
+    B streams in strict microbatch order, so optimizer state is
+    bit-identical to the SAME 4 virtual stages spread plainly over 4
+    hosts — and every chunk still compiles exactly once."""
+    plain = _vtrainer(virtual_stages=1)
+    out_p = plain.fit(_data_fn, 4)
+    inter = _vtrainer(virtual_stages=2)
+    out_i = inter.fit(_data_fn, 4)
+    assert plain.n_stages == 4 and inter.n_stages == 2
+    assert inter.state_digests() == plain.state_digests()
+    loss_p = [h["loss"] for h in out_p["history"] if "loss" in h]
+    loss_i = [h["loss"] for h in out_i["history"] if "loss" in h]
+    assert loss_i == loss_p
+    for counts in inter.compile_counts():
+        assert counts == {"fwd": 1, "bwd": 1, "apply": 1}
+    # interleaving shrinks the analytic bubble
+    assert out_i["bubble_fraction_analytic"] < \
+        out_p["bubble_fraction_analytic"]
+
+
+def test_interleaved_kill_recovery_bit_identical():
+    """A stage hosting TWO chunks dies mid-step: both chunks restore
+    from the same boundary, replay in the interleaved order, and the
+    final state matches the uninterrupted run bit-for-bit. The rebuilt
+    chunks compile once each (fresh runtimes, no retrace churn)."""
+    base = _vtrainer(virtual_stages=2)
+    base.fit(_data_fn, 6)
+
+    tr = _vtrainer(virtual_stages=2, replay_depth=2)
+    tr.start()
+    tr.handles[1]._fail_at = (4, "F")          # chunks 1 and 3 die
+    out = tr.fit(_data_fn, 6)
+    assert len(out["recoveries"]) == 1
+    assert tr.state_digests() == base.state_digests()
+    for counts in tr.compile_counts():
+        assert counts == {"fwd": 1, "bwd": 1, "apply": 1}
+
+
+# ------------------------------------------------------ fake stage gangs
+
+def test_local_gang_trains_and_matches_solo():
+    """A 2-rank gang on stage 1 (fake: two in-process members) must be
+    invisible to training semantics: same digests as the solo run, and
+    the gang handle fans every compute op out to both ranks."""
+    solo = _trainer()
+    solo.fit(_data_fn, 4)
+
+    gang = MPMDPipelineTrainer(
+        [_builder] * S, MPMDConfig(n_microbatches=M),
+        FailureConfig(max_failures=2, restart_policy="stage",
+                      restart_backoff_s=0.0),
+        stage_gang_sizes=[1, 2, 1])
+    gang.fit(_data_fn, 4)
+    h = gang.handles[1]
+    assert hasattr(h, "members") and len(h.members) == 2
+    assert gang.state_digests() == solo.state_digests()
+    # both ranks actually ran the stage program (replicas, not spares)
+    for m in h.members:
+        for rt in m._rts:
+            assert rt.step == 4
+            assert rt.compile_counts() == {"fwd": 1, "bwd": 1, "apply": 1}
+
+
+def test_gang_rank_divergence_detected():
+    """The replicated-stage invariant: digests are gathered from every
+    rank and must agree bit-for-bit — a silently diverged rank raises
+    instead of corrupting the next boundary."""
+    import jax
+    tr = MPMDPipelineTrainer(
+        [_builder] * S, MPMDConfig(n_microbatches=M),
+        FailureConfig(max_failures=2, restart_policy="stage",
+                      restart_backoff_s=0.0),
+        stage_gang_sizes=[1, 2, 1])
+    tr.fit(_data_fn, 2)
+    rt = tr.handles[1].members[1]._rts[0]
+    rt.params = jax.tree.map(lambda x: x + 1.0, rt.params)
+    with pytest.raises(RuntimeError, match="diverged"):
+        tr.state_digests()
+
+
+# ------------------------------------- off-step I/O and donation parity
+
+def test_async_checkpoint_and_donation_parity():
+    """Async off-step checkpointing and buffer donation are pure
+    performance knobs: all three configurations land bit-identical
+    optimizer state. The async run must also park its boundary
+    snapshots UNRESOLVED (no step-path barrier)."""
+    base = _trainer()                                   # async + donate on
+    base.fit(_data_fn, 5)
+    assert all(hasattr(r, "result") for r in base._snap_refs.values())
+
+    sync = _trainer(async_checkpoint=False)
+    sync.fit(_data_fn, 5)
+    nodonate = _trainer(donate_buffers=False)
+    nodonate.fit(_data_fn, 5)
+    assert sync.state_digests() == base.state_digests()
+    assert nodonate.state_digests() == base.state_digests()
+
+
+def test_replay_budget_reports_peak_buffers():
+    """Satellite: the replay buffer is sized against the CORRECTED
+    peak (grad buffers included), and budget() reports the composite
+    microbatch-buffer number the controller reasons about."""
+    peaks = [plib.peak_live_activations(ops)
+             for ops in plib.make_schedule("1f1b", 4, 8, virtual=2)]
+    assert peaks == [13, 11, 9, 7]
+    buf = MicrobatchReplayBuffer(depth=2, n_microbatches=8,
+                                 peak_live_buffers=peaks)
+    buf.record(1, [np.zeros((2, 2))] * 8, [np.zeros((2, 2))] * 8)
+    b = buf.budget()
+    assert b["replay_microbatches"] == 16
+    assert b["peak_live_stage_buffers"] == 13
+    assert b["peak_microbatch_buffers"] == 29
+    assert b["bytes_held"] == 8 * 2 * (2 * 2 * 8)   # 16 float64 4-elt arrays
+
+
 # ----------------------------------------------------- config validation
 
 def test_mpmd_config_validation():
@@ -428,7 +642,7 @@ def test_failure_config_validation():
 
 
 def test_trainer_requires_two_stages():
-    with pytest.raises(ValueError, match="2 stages"):
+    with pytest.raises(ValueError, match="2 physical stages"):
         MPMDPipelineTrainer([_builder], MPMDConfig(n_microbatches=M))
 
 
