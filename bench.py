@@ -491,18 +491,24 @@ def bench_serve_tokens_per_s(tpu_ok: bool = False):
     import os
     here = os.path.dirname(os.path.abspath(__file__))
     runner = os.path.join(here, "reports", "serve_probe.py")
+    # kv_quant applies to the DISAGG tiers only (serve_probe threads it
+    # nowhere else): the colocated figure stays fp, so vs_r05 compares
+    # like with like while the split records int8 wire/slot gains
     if tpu_ok:
         ladder = [
             {"model": "tpu-1b", "n_slots": 8, "max_len": 512,
              "prefill_chunk": 64, "n_requests": 32,
              "prompt_lens": [16, 128], "new_tokens": [16, 128],
-             "arrival_rate_rps": 50.0, "runs": 3, "disagg": 1},
+             "arrival_rate_rps": 50.0, "runs": 3, "disagg": 1,
+             "kv_quant": "int8"},
             {"model": "tiny", "n_slots": 8, "n_requests": 24,
-             "new_tokens": [4, 64], "runs": 3, "disagg": 1},
+             "new_tokens": [4, 64], "runs": 3, "disagg": 1,
+             "kv_quant": "int8"},
         ]
     else:
         ladder = [{"model": "tiny", "n_slots": 8, "n_requests": 24,
-                   "new_tokens": [4, 64], "runs": 3, "disagg": 1}]
+                   "new_tokens": [4, 64], "runs": 3, "disagg": 1,
+                   "kv_quant": "int8"}]
     last = "unknown"
     for attempt in range(2):
         if attempt:
@@ -557,6 +563,32 @@ def bench_serve_prefix_tokens_per_s(tpu_ok: bool = False):
             if result is not None:
                 return result
             log(f"serve prefix probe failed: {last}")
+    return {"skipped": True, "reason": last}
+
+
+def bench_sharded_decode_tokens_per_s():
+    """Sharded serving plane (reports/sharded_probe.py): speculative
+    decoding + int8 KV through the real ShardedEngineReplica lockstep
+    path, with the spec-OFF baseline in the SAME entry. vs_no_spec >
+    1.0 is the gate — speculation must be a raw-speed multiplier, not a
+    wash — and greedy_parity must hold (spec-on output bit-identical to
+    spec-off). The probe's "micro" shape keeps the CI CPU in the
+    per-step-overhead-bound regime TPU decode actually lives in; the
+    self-draft pins accept at its 1.0 upper bound (a real small draft
+    trades accept rate for cheaper proposals)."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    runner = os.path.join(here, "reports", "sharded_probe.py")
+    spec = {"model": "micro", "k": 8, "n_requests": 8, "runs": 3,
+            "kv_quant": "int8", "seed": 0}
+    last = "unknown"
+    for attempt in range(2):
+        if attempt:
+            time.sleep(10)
+        result, last = _run_probe(runner, spec, timeout=1200)
+        if result is not None:
+            return result
+        log(f"sharded probe failed: {last}")
     return {"skipped": True, "reason": last}
 
 
@@ -1189,6 +1221,18 @@ def main():
                 "kv_handoffs": srv.get("kv_handoffs"),
                 "disagg_decode_compile_count":
                     srv.get("disagg_decode_compile_count"),
+                # int8 KV in the disagg tiers (inference/kv_quant.py):
+                # wire bytes actually shipped vs the fp16 framing of the
+                # same spans, and the block-pool capacity multiplier
+                "disagg_kv_quant": srv.get("kv_quant"),
+                "kv_handoff_payload_bytes":
+                    srv.get("kv_handoff_payload_bytes"),
+                "kv_handoff_bytes_saved_vs_fp16":
+                    srv.get("kv_handoff_bytes_saved_vs_fp16"),
+                "kv_handoff_wire_ratio_vs_fp16":
+                    srv.get("kv_handoff_wire_ratio_vs_fp16"),
+                "kv_quant_slot_gain_vs_fp16":
+                    srv.get("kv_quant_slot_gain_vs_fp16"),
                 "spread": srv["spread"], "runs": srv["runs"]}
             log(f"serve_tokens_per_s: {srv['serve_tokens_per_s']} "
                 f"({srv['model']}, vs_static {srv['vs_static']}x, "
@@ -1246,6 +1290,50 @@ def main():
         log(f"serve prefix probe FAILED: {e}")
         results["serve_prefix_tokens_per_s"] = {"skipped": True,
                                                 "reason": str(e)[:200]}
+
+    try:
+        shd = bench_sharded_decode_tokens_per_s()
+        if not shd.get("skipped"):
+            results["sharded_decode_tokens_per_s"] = {
+                "value": shd.get("sharded_decode_tokens_per_s"),
+                "unit": "tokens_per_s", "model": shd.get("model"),
+                "k": shd.get("k"), "draft": shd.get("draft"),
+                "n_devices": shd.get("n_devices"),
+                "gang_world": shd.get("gang_world"),
+                "tokens_per_s_per_chip": shd.get("tokens_per_s_per_chip"),
+                "no_spec_tokens_per_s": shd.get("no_spec_tokens_per_s"),
+                "vs_no_spec": shd.get("vs_no_spec"),
+                "spec_decode_accept_rate":
+                    shd.get("spec_decode_accept_rate"),
+                "kv_quant": shd.get("kv_quant"),
+                "kv_quant_slot_gain_vs_fp16":
+                    shd.get("kv_quant_slot_gain_vs_fp16"),
+                "decode_compile_count": shd.get("decode_compile_count"),
+                "spec_verify_compile_count":
+                    shd.get("spec_verify_compile_count"),
+                "greedy_parity": shd.get("greedy_parity"),
+                "spread": shd.get("spread"), "runs": shd.get("runs")}
+            vs = shd.get("vs_no_spec") or 0.0
+            if vs <= 1.0 or not shd.get("greedy_parity"):
+                # the spec-decode gate: speculation must be a strict
+                # raw-speed multiplier AND bit-exact under greedy — a
+                # wash or a divergence is a regression, flagged loudly
+                results["sharded_decode_tokens_per_s"][
+                    "spec_gate_failed"] = True
+                log(f"sharded_decode GATE FAILED: vs_no_spec={vs}, "
+                    f"greedy_parity={shd.get('greedy_parity')}")
+            log(f"sharded_decode_tokens_per_s: "
+                f"{shd.get('sharded_decode_tokens_per_s')} "
+                f"(vs_no_spec {vs}x, accept "
+                f"{shd.get('spec_decode_accept_rate')}, "
+                f"per-chip {shd.get('tokens_per_s_per_chip')})")
+        else:
+            results["sharded_decode_tokens_per_s"] = shd
+            log(f"sharded probe skipped: {shd.get('reason')}")
+    except Exception as e:
+        log(f"sharded probe FAILED: {e}")
+        results["sharded_decode_tokens_per_s"] = {
+            "skipped": True, "reason": str(e)[:200]}
 
     try:
         churn = bench_serve_availability_under_churn()

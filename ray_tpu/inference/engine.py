@@ -33,11 +33,25 @@ greedy engine output is bit-identical to the one-program generator.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_zeros(sharding):
+    """Jitted zeros with an explicit output sharding, memoized per
+    sharding (jit caches per (shape, dtype) static args underneath).
+    Allocating through jit is what makes the result a GLOBAL array when
+    the mesh spans multiple processes — a host-side ``jnp.zeros`` +
+    ``device_put`` only ever produces a single-process value."""
+    import jax
+    import jax.numpy as jnp
+    return jax.jit(jnp.zeros, static_argnums=(0, 1),
+                   out_shardings=sharding)
 
 from ray_tpu._private import events
 from ray_tpu.inference.scheduler import (FINISH_LENGTH, PrefillChunk,
@@ -70,6 +84,16 @@ class EngineConfig:
     top_k: int = 0
     top_p: float = 1.0
     cache_dtype: Any = None       # default: model activation dtype
+    # prefix-block quantization (kv_quant.py): "int8" stores the BLOCK
+    # pool as int8 values + fp32 per-(position, head) scale rows —
+    # ~itemsize*D/(D+4) more cached chunks per HBM byte, and the disagg
+    # hand-off ships the same compressed spans. The decode slot pool
+    # stays full precision (it is transient and donated through the hot
+    # program). The miss path write-throughs each completed chunk and
+    # reloads the dequantized values, so greedy output stays
+    # bit-identical between a prefix-cache hit and the miss that
+    # populated it.
+    kv_quant: str = "none"
     # radix/prefix KV cache (prefix_cache.py): extra cache-only slots of
     # the SAME [n_layers, 1, max_len, Hkv, D] shape as decode slots,
     # carved into prefill_chunk-aligned blocks that hold completed
@@ -90,7 +114,7 @@ class InferenceEngine:
     `tensor`, same as make_generate_fn's cache)."""
 
     def __init__(self, model, params, config: Optional[EngineConfig] = None,
-                 mesh=None, rules=None, seed: int = 0):
+                 mesh=None, rules=None, seed: int = 0, spec=None):
         import jax
         import jax.numpy as jnp
 
@@ -101,9 +125,27 @@ class InferenceEngine:
         self._rules = rules
         cfg = self.config
         mcfg = model.cfg
-        if cfg.max_len > mcfg.max_seq_len:
-            raise ValueError(f"max_len={cfg.max_len} exceeds the model's "
-                             f"max_seq_len={mcfg.max_seq_len}")
+        from ray_tpu.inference import spec_decode as spec_lib
+        from ray_tpu.inference.kv_quant import check_mode
+        self._kv_quant = check_mode(cfg.kv_quant) == "int8"
+        # speculative decoding (spec_decode.py): both slot pools grow by
+        # k positions so the fixed [len, len+k+1) verify write window
+        # never clamps back onto live entries
+        self._spec = spec_lib.resolve_spec(spec)
+        self._spec_k = self._spec.k if self._spec is not None else 0
+        self._pool_len = cfg.max_len + self._spec_k
+        if self._pool_len > mcfg.max_seq_len:
+            raise ValueError(
+                f"max_len={cfg.max_len} (+ spec k={self._spec_k}) exceeds "
+                f"the model's max_seq_len={mcfg.max_seq_len}")
+        self._draft_model = self._draft_params = None
+        if self._spec is not None:
+            self._draft_model, self._draft_params = spec_lib.resolve_draft(
+                self._spec, mcfg)
+            if self._pool_len > self._draft_model.cfg.max_seq_len:
+                raise ValueError(
+                    f"draft max_seq_len={self._draft_model.cfg.max_seq_len}"
+                    f" < max_len + k = {self._pool_len}")
         self.prefix_cache = None
         if cfg.prefix_cache_slots > 0:
             from ray_tpu.inference.prefix_cache import RadixPrefixCache
@@ -123,7 +165,7 @@ class InferenceEngine:
         self._rng = jax.random.PRNGKey(seed)
 
         dtype = cfg.cache_dtype or mcfg.dtype
-        pool_shape = (mcfg.n_layers, cfg.n_slots, cfg.max_len,
+        pool_shape = (mcfg.n_layers, cfg.n_slots, self._pool_len,
                       mcfg.n_kv_heads, mcfg.head_dim)
         # scratch is prefill_chunk longer than a slot so a padded final
         # chunk can never clamp its write window back onto real entries
@@ -131,6 +173,7 @@ class InferenceEngine:
         self._scratch_shape = (mcfg.n_layers, 1, self._scratch_len,
                                mcfg.n_kv_heads, mcfg.head_dim)
         self._pool_sharding = None
+        self._target_pool_shape = pool_shape
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -146,26 +189,76 @@ class InferenceEngine:
         self._pool_k = self._zeros(pool_shape, dtype)
         self._pool_v = self._zeros(pool_shape, dtype)
         self._cache_dtype = dtype
+        self._fp_itemsize = int(jnp.dtype(dtype).itemsize)
+        self._dpool_k = self._dpool_v = None
+        self._draft_scratch_shape = None
+        if self._spec is not None:
+            # draft slot pool: same layout as the target's (incl. the k
+            # padding), replicated — the draft is small by design and
+            # its scan runs inside the one fused program
+            dcfg = self._draft_model.cfg
+            dshape = (dcfg.n_layers, cfg.n_slots, self._pool_len,
+                      dcfg.n_kv_heads, dcfg.head_dim)
+            dsh = None
+            if mesh is not None:
+                # same logical layout as the target pool, pruned against
+                # the DRAFT shape (its kv-head count may not divide the
+                # tensor axis)
+                from jax.sharding import (NamedSharding,
+                                          PartitionSpec as P)
+
+                from ray_tpu.parallel import sharding as sharding_lib
+                from ray_tpu.parallel.train_step import (
+                    _prune_indivisible, logical_pspec_to_mesh)
+                drules = self._rules or sharding_lib.DEFAULT_RULES
+                dsh = NamedSharding(mesh, _prune_indivisible(
+                    logical_pspec_to_mesh(
+                        P(None, "batch", None, "kv_heads", None), drules),
+                    dshape, mesh))
+            self._dpool_k = self._zeros(dshape, dtype, sharding=dsh)
+            self._dpool_v = self._zeros(dshape, dtype, sharding=dsh)
+            self._draft_scratch_shape = (
+                dcfg.n_layers, 1, self._scratch_len, dcfg.n_kv_heads,
+                dcfg.head_dim)
         self._blocks_k = self._blocks_v = None
+        self._blocks_ks = self._blocks_vs = None
         if self.prefix_cache is not None:
             # block storage: prefix_cache_slots more rows of the same
             # per-slot shape, replicated (blocks are read via copies
             # into the replicated scratch cache, never attended over
-            # in place, so they need no batch sharding)
+            # in place, so they need no batch sharding). kv_quant="int8"
+            # stores int8 values + fp32 per-(position, head) scale rows.
+            bdtype = jnp.int8 if self._kv_quant else dtype
             block_shape = (mcfg.n_layers, cfg.prefix_cache_slots,
                            cfg.max_len, mcfg.n_kv_heads, mcfg.head_dim)
-            with self._mesh_ctx():
-                self._blocks_k = jnp.zeros(block_shape, dtype)
-                self._blocks_v = jnp.zeros(block_shape, dtype)
+            rsh = None
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                rsh = NamedSharding(mesh, PartitionSpec())
+            self._blocks_k = self._zeros(block_shape, bdtype, sharding=rsh)
+            self._blocks_v = self._zeros(block_shape, bdtype, sharding=rsh)
+            if self._kv_quant:
+                scale_shape = block_shape[:-1]
+                self._blocks_ks = self._zeros(scale_shape, jnp.float32,
+                                              sharding=rsh)
+                self._blocks_vs = self._zeros(scale_shape, jnp.float32,
+                                              sharding=rsh)
 
         # host-side slot state (fixed width, mirrors the device arrays)
         self._lengths = np.zeros((cfg.n_slots,), np.int32)
         self._last_tok = np.zeros((cfg.n_slots,), np.int32)
         self._temps = np.zeros((cfg.n_slots,), np.float32)
         self._scratch: Dict[int, Any] = {}    # rid -> (sk, sv)
+        self._draft_scratch: Dict[int, Any] = {}    # rid -> (dk, dv)
 
         self.decode_compile_count = 0
         self.prefill_compile_count = 0
+        # spec decode accounting (greedy rows only: sampled rows always
+        # force accept = 0 and would just dilute the rate)
+        self.spec_verify_compile_count = 0
+        self.draft_prefill_compile_count = 0
+        self.spec_tokens_proposed = 0
+        self.spec_tokens_accepted = 0
         self.steps = 0
         self.tokens_generated = 0
         # disagg hand-off accounting (serve/disagg.py)
@@ -192,15 +285,28 @@ class InferenceEngine:
         self._build_fns()
 
     # ------------------------------------------------------------ device fns
-    def _zeros(self, shape, dtype):
+    def _zeros(self, shape, dtype, sharding=None):
         import jax.numpy as jnp
         with self._mesh_ctx():
-            z = jnp.zeros(shape, dtype)
-            if self._pool_sharding is not None and len(shape) == 5 \
-                    and shape[2] == self.config.max_len:
-                import jax
-                z = jax.device_put(z, self._pool_sharding)
-            return z
+            if self.mesh is not None:
+                # allocate THROUGH a jitted zeros with explicit output
+                # sharding: under a multi-process mesh this yields a
+                # global array directly (device_put of a host value
+                # cannot), and on one process it is equivalent. The
+                # TARGET slot pool shards batch/kv_heads; callers pass
+                # their own sharding for anything whose divisibility was
+                # pruned against a different shape; everything else is
+                # replicated.
+                from jax.sharding import NamedSharding, PartitionSpec
+                sh = sharding
+                if sh is None:
+                    if self._pool_sharding is not None \
+                            and tuple(shape) == self._target_pool_shape:
+                        sh = self._pool_sharding
+                    else:
+                        sh = NamedSharding(self.mesh, PartitionSpec())
+                return _sharded_zeros(sh)(tuple(shape), jnp.dtype(dtype))
+            return jnp.zeros(shape, dtype)
 
     def _mesh_ctx(self):
         if self.mesh is None:
@@ -266,7 +372,42 @@ class InferenceEngine:
         self._decode_fn = jax.jit(
             decode, donate_argnums=(1, 2) if donate else ())
 
-        if self.prefix_cache is not None:
+        self._spec_step_fn = None
+        self._draft_prefill_fn = None
+        if self._spec is not None:
+            from ray_tpu.inference.spec_decode import build_spec_step
+            draft_model = self._draft_model
+
+            def _count_verify_trace():
+                # the fused draft+verify program REPLACES decode as the
+                # per-step program: both counters watch the same
+                # compile-once contract (tests assert 1 and 1)
+                self.decode_compile_count += 1
+                self.spec_verify_compile_count += 1
+
+            self._spec_step_fn = jax.jit(
+                build_spec_step(model, draft_model, self._spec.k,
+                                top_k, top_p,
+                                on_trace=_count_verify_trace),
+                donate_argnums=(2, 3, 4, 5) if donate else ())
+
+            def draft_prefill(dparams, sk, sv, tokens, pos0):
+                # prompt KV for the draft cache: same chunked path as
+                # the target's prefill, no sampling (the draft never
+                # emits during prefill)
+                self.draft_prefill_compile_count += 1
+                cache = {"k": sk, "v": sv, "idx": pos0}
+                _, new = draft_model.apply({"params": dparams}, tokens,
+                                           cache=cache,
+                                           chunked_prefill=True)
+                return new["k"], new["v"]
+
+            self._draft_prefill_fn = jax.jit(
+                draft_prefill, donate_argnums=(1, 2) if donate else ())
+
+        if self.prefix_cache is not None and self._kv_quant:
+            self._build_quant_span_fns(donate)
+        elif self.prefix_cache is not None:
             mcfg = self.model.cfg
             span = (mcfg.n_layers, 1, cfg.prefill_chunk,
                     mcfg.n_kv_heads, mcfg.head_dim)
@@ -320,6 +461,67 @@ class InferenceEngine:
             self._export_span_fn = jax.jit(export_span)
             self._import_span_fn = jax.jit(
                 import_span, donate_argnums=(0, 1) if donate else ())
+
+    def _build_quant_span_fns(self, donate):
+        """int8 variants of the four span programs: same fixed span
+        shape + traced offsets (= one compile each, ever), but the block
+        side carries int8 values plus fp32 per-(position, head) scale
+        rows and the scratch side stays full precision — quantize on
+        save, dequantize on load, ship compressed on export."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.inference.kv_quant import dequantize_kv, quantize_kv
+        cfg = self.config
+        mcfg = self.model.cfg
+        span = (mcfg.n_layers, 1, cfg.prefill_chunk,
+                mcfg.n_kv_heads, mcfg.head_dim)
+        sspan = span[:-1]
+        cdtype = self._cache_dtype
+
+        def save_spanq(bk, bv, bks, bvs, sk, sv, slot, dst, src):
+            ck = jax.lax.dynamic_slice(sk, (0, 0, src, 0, 0), span)
+            cv = jax.lax.dynamic_slice(sv, (0, 0, src, 0, 0), span)
+            qk, ks = quantize_kv(ck)
+            qv, vs = quantize_kv(cv)
+            bk = jax.lax.dynamic_update_slice(bk, qk, (0, slot, dst, 0, 0))
+            bv = jax.lax.dynamic_update_slice(bv, qv, (0, slot, dst, 0, 0))
+            bks = jax.lax.dynamic_update_slice(bks, ks, (0, slot, dst, 0))
+            bvs = jax.lax.dynamic_update_slice(bvs, vs, (0, slot, dst, 0))
+            return bk, bv, bks, bvs
+
+        def load_spanq(sk, sv, bk, bv, bks, bvs, slot, src, dst):
+            qk = jax.lax.dynamic_slice(bk, (0, slot, src, 0, 0), span)
+            qv = jax.lax.dynamic_slice(bv, (0, slot, src, 0, 0), span)
+            ks = jax.lax.dynamic_slice(bks, (0, slot, src, 0), sspan)
+            vs = jax.lax.dynamic_slice(bvs, (0, slot, src, 0), sspan)
+            sk = jax.lax.dynamic_update_slice(
+                sk, dequantize_kv(qk, ks, cdtype), (0, 0, dst, 0, 0))
+            sv = jax.lax.dynamic_update_slice(
+                sv, dequantize_kv(qv, vs, cdtype), (0, 0, dst, 0, 0))
+            return sk, sv
+
+        def export_spanq(bk, bv, bks, bvs, slot, src):
+            qk = jax.lax.dynamic_slice(bk, (0, slot, src, 0, 0), span)
+            qv = jax.lax.dynamic_slice(bv, (0, slot, src, 0, 0), span)
+            ks = jax.lax.dynamic_slice(bks, (0, slot, src, 0), sspan)
+            vs = jax.lax.dynamic_slice(bvs, (0, slot, src, 0), sspan)
+            return qk, qv, ks, vs
+
+        def import_spanq(bk, bv, bks, bvs, qk, qv, ks, vs, slot, dst):
+            bk = jax.lax.dynamic_update_slice(bk, qk, (0, slot, dst, 0, 0))
+            bv = jax.lax.dynamic_update_slice(bv, qv, (0, slot, dst, 0, 0))
+            bks = jax.lax.dynamic_update_slice(bks, ks, (0, slot, dst, 0))
+            bvs = jax.lax.dynamic_update_slice(bvs, vs, (0, slot, dst, 0))
+            return bk, bv, bks, bvs
+
+        self._save_span_fn = jax.jit(
+            save_spanq, donate_argnums=(0, 1, 2, 3) if donate else ())
+        self._load_span_fn = jax.jit(
+            load_spanq, donate_argnums=(0, 1) if donate else ())
+        self._export_span_fn = jax.jit(export_spanq)
+        self._import_span_fn = jax.jit(
+            import_spanq, donate_argnums=(0, 1, 2, 3) if donate else ())
 
     # -------------------------------------------------------------- intake
     def submit(self, tokens, max_new_tokens: int = 64,
@@ -416,6 +618,7 @@ class InferenceEngine:
             now = time.monotonic()
             for st in self.sched.reap(now):
                 self._scratch.pop(st.rid, None)
+                self._draft_scratch.pop(st.rid, None)
             chunks = self.sched.plan_prefill()
             did = False
             for ch in chunks:
@@ -453,24 +656,62 @@ class InferenceEngine:
                     queue_depth=self.sched.queue_depth())
                 compiles0 = self.decode_compile_count
                 t_dec0 = time.perf_counter()
-                with self._mesh_ctx():
-                    toks, self._pool_k, self._pool_v, self._rng = \
-                        self._decode_fn(
-                            self.params, self._pool_k, self._pool_v,
-                            self._lengths, self._last_tok, self._rng,
-                            self._temps)
-                toks_host = np.asarray(toks)
+                if self._spec is not None:
+                    with self._mesh_ctx():
+                        (out, acc, self._pool_k, self._pool_v,
+                         self._dpool_k, self._dpool_v, self._rng) = \
+                            self._spec_step_fn(
+                                self.params, self._draft_params,
+                                self._pool_k, self._pool_v,
+                                self._dpool_k, self._dpool_v,
+                                self._lengths, self._last_tok,
+                                self._rng, self._temps)
+                    out_host = np.asarray(out)
+                    acc_host = np.asarray(acc)
+                else:
+                    with self._mesh_ctx():
+                        toks, self._pool_k, self._pool_v, self._rng = \
+                            self._decode_fn(
+                                self.params, self._pool_k, self._pool_v,
+                                self._lengths, self._last_tok, self._rng,
+                                self._temps)
+                    toks_host = np.asarray(toks)
                 t_dec1 = time.perf_counter()
                 # capture before decode_emit: an evicted state's slot is
                 # None by the time the profiler reads it
                 slots = [st.slot for st in active]
                 now = time.monotonic()
-                for st in active:
-                    slot = st.slot
-                    self._lengths[slot] += 1
-                    self._last_tok[slot] = toks_host[slot]
-                    self.tokens_generated += 1
-                    self.sched.decode_emit(st, int(toks_host[slot]), now)
+                n_emitted = 0
+                if self._spec is not None:
+                    # accepted prefix + one bonus token per slot. ALL
+                    # accept-count control flow happens HERE, on
+                    # materialized numpy values — a Python branch on the
+                    # traced count inside the program is the classic
+                    # retrace bug (rtlint RT002 fixture).
+                    for st in active:
+                        slot = st.slot
+                        accepted = int(acc_host[slot])
+                        if self._temps[slot] <= 0.0:
+                            self.spec_tokens_proposed += self._spec_k
+                            self.spec_tokens_accepted += accepted
+                        for j in range(accepted + 1):
+                            self._lengths[slot] += 1
+                            tok = int(out_host[slot, j])
+                            self._last_tok[slot] = tok
+                            self.tokens_generated += 1
+                            n_emitted += 1
+                            self.sched.decode_emit(st, tok, now)
+                            if st.slot is None:
+                                break    # finished (EOS / max tokens)
+                else:
+                    for st in active:
+                        slot = st.slot
+                        self._lengths[slot] += 1
+                        self._last_tok[slot] = toks_host[slot]
+                        self.tokens_generated += 1
+                        n_emitted += 1
+                        self.sched.decode_emit(st, int(toks_host[slot]),
+                                               now)
                 if self.decode_compile_count > compiles0:
                     # a decode retrace is THE perf cliff this engine is
                     # built to avoid — make every occurrence a first-class
@@ -484,7 +725,7 @@ class InferenceEngine:
                     attribution = self._profile_decode(
                         [int(self._lengths[s]) for s in slots],
                         t_iter0, t_admit, t_dec0, t_dec1)
-                dspan.end(tokens=len(active), **attribution)
+                dspan.end(tokens=n_emitted, **attribution)
                 did = True
             self.steps += 1
             if self.on_step is not None:
@@ -547,6 +788,19 @@ class InferenceEngine:
                 # runs over [0, prefix_matched)
                 sk_sv = self._restore_prefix(st, *sk_sv)
         sk, sv = sk_sv
+        dk_dv = None
+        if self._spec is not None:
+            dk_dv = self._draft_scratch.get(st.rid)
+            if dk_dv is None:
+                dk_dv = (self._zeros(self._draft_scratch_shape,
+                                     self._cache_dtype),
+                         self._zeros(self._draft_scratch_shape,
+                                     self._cache_dtype))
+                if st.prefix_matched:
+                    # the block pool holds TARGET KV only; the (cheap)
+                    # draft re-prefills the matched range so its cache
+                    # stays aligned with the target's
+                    dk_dv = self._draft_replay(st, *dk_dv)
         prompt = st.request.tokens
         chunk = np.zeros((1, cfg.prefill_chunk), np.int32)
         chunk[0, :ch.length] = prompt[ch.start:ch.start + ch.length]
@@ -570,6 +824,12 @@ class InferenceEngine:
                 parent_span_id=pspan.span_id, fn="prefill",
                 compile_count=self.prefill_compile_count)
         pspan.end()
+        if self._spec is not None:
+            with self._mesh_ctx():
+                ndk, ndv = self._draft_prefill_fn(
+                    self._draft_params, dk_dv[0], dk_dv[1],
+                    jnp.asarray(chunk), np.int32(ch.start))
+            dk_dv = (ndk, ndv)
         if ch.is_last:
             slot = st.slot
             if self.prefix_cache is not None:
@@ -577,14 +837,23 @@ class InferenceEngine:
             with self._mesh_ctx():
                 self._pool_k, self._pool_v = self._insert_fn(
                     self._pool_k, self._pool_v, sk, sv, np.int32(slot))
+                if self._spec is not None:
+                    self._dpool_k, self._dpool_v = self._insert_fn(
+                        self._dpool_k, self._dpool_v, dk_dv[0], dk_dv[1],
+                        np.int32(slot))
             self._scratch.pop(st.rid, None)
+            self._draft_scratch.pop(st.rid, None)
             self._lengths[slot] = len(prompt)
             first = int(tok)
             self._last_tok[slot] = first
             self._temps[slot] = st.temperature
             self.sched.prefill_done(st, first, time.monotonic())
         else:
+            if self._kv_quant and self.prefix_cache is not None:
+                sk, sv = self._publish_chunk_quant(st, sk, sv, ch)
             self._scratch[st.rid] = (sk, sv)
+            if self._spec is not None:
+                self._draft_scratch[st.rid] = dk_dv
             self.sched.advance_prefill(st, ch.length)
 
     # ------------------------------------------------------- prefix cache
@@ -597,9 +866,17 @@ class InferenceEngine:
         with self._mesh_ctx():
             for i, node in enumerate(st.prefix_nodes):
                 bslot, boff = divmod(node.block, self._blocks_per_slot)
-                sk, sv = self._load_span_fn(
-                    sk, sv, self._blocks_k, self._blocks_v,
-                    np.int32(bslot), np.int32(boff * C), np.int32(i * C))
+                if self._kv_quant:
+                    sk, sv = self._load_span_fn(
+                        sk, sv, self._blocks_k, self._blocks_v,
+                        self._blocks_ks, self._blocks_vs,
+                        np.int32(bslot), np.int32(boff * C),
+                        np.int32(i * C))
+                else:
+                    sk, sv = self._load_span_fn(
+                        sk, sv, self._blocks_k, self._blocks_v,
+                        np.int32(bslot), np.int32(boff * C),
+                        np.int32(i * C))
         events.record_instant(
             "engine.prefix_hit", category="engine",
             trace_id=st.span.trace_id if st.span else None,
@@ -621,9 +898,60 @@ class InferenceEngine:
         with self._mesh_ctx():
             for off, block in created:
                 bslot, boff = divmod(block, self._blocks_per_slot)
-                self._blocks_k, self._blocks_v = self._save_span_fn(
-                    self._blocks_k, self._blocks_v, sk, sv,
+                self._save_block(sk, sv, bslot, boff * C, off)
+
+    def _save_block(self, sk, sv, bslot, dst, src):
+        """One chunk scratch -> block pool, quantizing when int8 is on
+        (caller holds the lock and the mesh context)."""
+        if self._kv_quant:
+            (self._blocks_k, self._blocks_v, self._blocks_ks,
+             self._blocks_vs) = self._save_span_fn(
+                self._blocks_k, self._blocks_v, self._blocks_ks,
+                self._blocks_vs, sk, sv,
+                np.int32(bslot), np.int32(dst), np.int32(src))
+        else:
+            self._blocks_k, self._blocks_v = self._save_span_fn(
+                self._blocks_k, self._blocks_v, sk, sv,
+                np.int32(bslot), np.int32(dst), np.int32(src))
+
+    def _publish_chunk_quant(self, st, sk, sv, ch):
+        """int8 miss path, non-final chunks: publish each COMPLETED full
+        chunk into the quantized block pool as it finishes, then reload
+        the dequantized values into this request's OWN scratch — the
+        miss request attends exactly the numbers a later prefix-cache
+        hit will restore, so greedy output is bit-identical hit vs miss
+        (write-through caching, compile-once edition). The final chunk
+        (full or padded) is save-only in _populate_prefix: the admission
+        match is capped one token short of the prompt, so no hit ever
+        restores it and both paths attend it raw."""
+        C = self.config.prefill_chunk
+        end = ch.start + ch.length
+        created = self.prefix_cache.insert(st.request.tokens[:end])
+        with self._mesh_ctx():
+            for off, block in created:
+                bslot, boff = divmod(block, self._blocks_per_slot)
+                self._save_block(sk, sv, bslot, boff * C, off)
+                sk, sv = self._load_span_fn(
+                    sk, sv, self._blocks_k, self._blocks_v,
+                    self._blocks_ks, self._blocks_vs,
                     np.int32(bslot), np.int32(boff * C), np.int32(off))
+        return sk, sv
+
+    def _draft_replay(self, st, dk, dv):
+        """Prefix-hit draft warmup: re-prefill the matched range through
+        the draft model (chunk-aligned by construction; prefix_matched
+        is a multiple of prefill_chunk)."""
+        import jax.numpy as jnp
+        C = self.config.prefill_chunk
+        prompt = st.request.tokens
+        with self._mesh_ctx():
+            for off in range(0, st.prefix_matched, C):
+                chunk = np.zeros((1, C), np.int32)
+                chunk[0, :] = prompt[off:off + C]
+                dk, dv = self._draft_prefill_fn(
+                    self._draft_params, dk, dv, jnp.asarray(chunk),
+                    np.int32(off))
+        return dk, dv
 
     # --------------------------------------------------- disagg hand-off
     def export_kv_blocks(self, tokens, max_chunks: Optional[int] = None):
@@ -650,10 +978,21 @@ class InferenceEngine:
                     for node in nodes:
                         bslot, boff = divmod(node.block,
                                              self._blocks_per_slot)
-                        ck, cv = self._export_span_fn(
-                            self._blocks_k, self._blocks_v,
-                            np.int32(bslot), np.int32(boff * C))
-                        spans.append((np.asarray(ck), np.asarray(cv)))
+                        if self._kv_quant:
+                            # int8 wire: values + scale rows — the
+                            # hand-off payload shrinks with the pool
+                            qk, qv, ks, vs = self._export_span_fn(
+                                self._blocks_k, self._blocks_v,
+                                self._blocks_ks, self._blocks_vs,
+                                np.int32(bslot), np.int32(boff * C))
+                            spans.append(
+                                (np.asarray(qk), np.asarray(qv),
+                                 np.asarray(ks), np.asarray(vs)))
+                        else:
+                            ck, cv = self._export_span_fn(
+                                self._blocks_k, self._blocks_v,
+                                np.int32(bslot), np.int32(boff * C))
+                            spans.append((np.asarray(ck), np.asarray(cv)))
             finally:
                 self.prefix_cache.release(nodes)
             if spans:
@@ -676,18 +1015,46 @@ class InferenceEngine:
         n = min(len(spans), len(tokens) // C)
         if n <= 0:
             return 0
+        from ray_tpu.inference import kv_quant as kvq
         with self._lock:
             created = self.prefix_cache.insert(
                 [int(t) for t in tokens[:n * C]])
             with self._mesh_ctx():
                 for off, block in created:
-                    ck, cv = spans[off // C]
+                    span = spans[off // C]
                     bslot, boff = divmod(block, self._blocks_per_slot)
-                    self._blocks_k, self._blocks_v = self._import_span_fn(
-                        self._blocks_k, self._blocks_v,
-                        jnp.asarray(ck, self._cache_dtype),
-                        jnp.asarray(cv, self._cache_dtype),
-                        np.int32(bslot), np.int32(boff * C))
+                    if self._kv_quant:
+                        if len(span) == 4:
+                            qk, qv, ks, vs = span
+                        else:
+                            # fp wire from a non-quantized exporter:
+                            # quantize host-side (bit-identical math to
+                            # the device save path)
+                            qk, ks = kvq.quantize_kv_np(span[0])
+                            qv, vs = kvq.quantize_kv_np(span[1])
+                        (self._blocks_k, self._blocks_v, self._blocks_ks,
+                         self._blocks_vs) = self._import_span_fn(
+                            self._blocks_k, self._blocks_v,
+                            self._blocks_ks, self._blocks_vs,
+                            jnp.asarray(qk, jnp.int8),
+                            jnp.asarray(qv, jnp.int8),
+                            jnp.asarray(ks, jnp.float32),
+                            jnp.asarray(vs, jnp.float32),
+                            np.int32(bslot), np.int32(boff * C))
+                    else:
+                        if len(span) == 4:
+                            # int8 wire into an fp pool: dequantize on
+                            # the host before landing the block
+                            ck = kvq.dequantize_kv_np(span[0], span[2])
+                            cv = kvq.dequantize_kv_np(span[1], span[3])
+                        else:
+                            ck, cv = span
+                        self._blocks_k, self._blocks_v = \
+                            self._import_span_fn(
+                                self._blocks_k, self._blocks_v,
+                                jnp.asarray(ck, self._cache_dtype),
+                                jnp.asarray(cv, self._cache_dtype),
+                                np.int32(bslot), np.int32(boff * C))
             imported = len(created) * C
             if imported:
                 self.kv_imports += 1
@@ -712,4 +1079,22 @@ class InferenceEngine:
             out["kv_exports"] = self.kv_exports
             out["kv_imports"] = self.kv_imports
             out["remote_prefix_tokens"] = self.remote_prefix_tokens
+        if self._spec is not None:
+            prop = self.spec_tokens_proposed
+            out["spec_k"] = self._spec_k
+            out["spec_verify_compile_count"] = \
+                self.spec_verify_compile_count
+            out["spec_tokens_proposed"] = prop
+            out["spec_tokens_accepted"] = self.spec_tokens_accepted
+            out["spec_accept_rate"] = (
+                round(self.spec_tokens_accepted / prop, 4) if prop
+                else 0.0)
+        if self._kv_quant:
+            from ray_tpu.inference import kv_quant as kvq
+            mcfg = self.model.cfg
+            out["kv_quant"] = "int8"
+            out["kv_quant_slot_gain"] = round(
+                kvq.slot_gain(mcfg.head_dim, self._fp_itemsize), 3)
+            out["kv_quant_slot_gain_vs_fp16"] = round(
+                kvq.slot_gain(mcfg.head_dim, 2), 3)
         return out

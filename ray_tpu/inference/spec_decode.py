@@ -1,0 +1,192 @@
+"""Draft-model speculative decoding for the slot-pool engine — exactly
+one extra fixed-shape program (ROADMAP item 1).
+
+The classic transform: a small draft model proposes K greedy tokens per
+slot, the target model scores all K+1 positions in ONE batched forward
+(the same chunked-prefill cached-attention path prefill uses), and the
+engine emits the longest agreeing prefix plus one bonus token from the
+target's own distribution. Greedy decoding is EXACT by construction —
+every emitted token is an argmax of target logits computed over the
+identical cache contents the one-token decode program would have seen,
+so spec-on and spec-off streams are bit-identical and the accept rate
+only moves throughput, never output.
+
+Compile-once discipline (the engine's whole perf story):
+
+- the draft loop is a ``lax.scan`` of K+1 single-token draft steps
+  INSIDE the program (scan iteration i also writes draft KV for its
+  input token at position ``len+i``, so the draft cache is complete
+  however many drafts the target accepts);
+- the verify forward is one fixed ``[n_slots, K+1]`` call — shapes
+  never depend on accept counts;
+- accept counts come back to the host as an ``[n_slots]`` vector and
+  ALL control flow on them (how many tokens to emit) happens host-side
+  on materialized numpy values — a Python branch on the traced accept
+  count inside the program is the classic retrace bug (rtlint RT002
+  has a fixture for it);
+- both KV pools are K positions longer than ``max_len`` so the fixed
+  write window ``[len, len+K+1)`` never clamps back onto live entries
+  (the same padding argument as the engine's prefill scratch).
+
+Rejected speculation leaves garbage KV above ``len + accepted + 1`` in
+both pools; it is never attended (the causal mask cuts at the per-row
+``idx``) and the next step's window overwrites it before it could be.
+
+Sampled rows (temperature > 0) fall back to emitting one
+target-sampled token per step: position 0 of the verify output is
+drawn through ``sample_logits_dynamic`` exactly like the non-spec
+decode program, and the accept count is forced to 0, so sampling
+semantics (one fresh draw per emitted token) are preserved.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+
+@dataclasses.dataclass
+class SpecDecodeConfig:
+    """Speculative-decoding knobs for :class:`InferenceEngine`.
+
+    draft_model: registry name / TransformerConfig / TransformerLM of
+        the (small) proposer. Must share the target's vocab.
+    k: draft tokens proposed per step; the engine emits 1..k+1 tokens
+        per decode step depending on agreement.
+    draft_params_fn: optional zero-arg callable returning the draft
+        param tree (checkpoint restore, or the target's own params for
+        a self-draft upper-bound probe); defaults to random init with
+        ``draft_seed``.
+    """
+    draft_model: Any = None
+    k: int = 4
+    draft_params_fn: Optional[Callable[[], Any]] = None
+    draft_seed: int = 0
+
+
+def resolve_spec(spec) -> Optional[SpecDecodeConfig]:
+    """Accept None / SpecDecodeConfig / kwargs dict."""
+    if spec is None:
+        return None
+    if isinstance(spec, SpecDecodeConfig):
+        cfg = spec
+    elif isinstance(spec, dict):
+        cfg = SpecDecodeConfig(**spec)
+    else:
+        raise TypeError(f"spec_decode: expected SpecDecodeConfig or "
+                        f"dict, got {type(spec).__name__}")
+    if cfg.draft_model is None:
+        raise ValueError("spec_decode requires a draft_model")
+    if cfg.k < 1:
+        raise ValueError(f"spec_decode k={cfg.k}; must be >= 1")
+    return cfg
+
+
+def resolve_draft(cfg: SpecDecodeConfig, target_cfg):
+    """Build (draft_module, draft_params) and validate compatibility
+    with the target model config."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import MODEL_REGISTRY, TransformerLM
+    from ray_tpu.models.transformer import TransformerConfig
+    m = cfg.draft_model
+    if isinstance(m, str):
+        m = TransformerLM(MODEL_REGISTRY[m])
+    elif isinstance(m, TransformerConfig):
+        m = TransformerLM(m)
+    if m.cfg.vocab_size != target_cfg.vocab_size:
+        raise ValueError(
+            f"draft vocab {m.cfg.vocab_size} != target vocab "
+            f"{target_cfg.vocab_size}: accept comparison is meaningless")
+    if cfg.draft_params_fn is not None:
+        params = cfg.draft_params_fn()
+    else:
+        tokens0 = jnp.zeros((1, 8), jnp.int32)
+        params = m.init(jax.random.PRNGKey(cfg.draft_seed),
+                        tokens0)["params"]
+    return m, params
+
+
+def accept_prefix(drafts, out, temps):
+    """Longest agreeing prefix, per slot (pure jnp; shape-stable).
+
+    drafts: int32[S, K] — the draft's proposals d_1..d_K.
+    out:    int32[S, K+1] — the target's choice at every position
+            (out[:, j] is what the target emits AFTER j accepted
+            drafts; out[:, :K] is what d_{j+1} must equal to count).
+    temps:  fp32[S] — sampled rows (temp > 0) force accept = 0.
+
+    Returns int32[S] in [0, K].
+    """
+    import jax.numpy as jnp
+    match = (drafts == out[:, :-1]).astype(jnp.int32)
+    acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+    return jnp.where(jnp.asarray(temps) > 0.0, 0, acc).astype(jnp.int32)
+
+
+def build_spec_step(model, draft_model, k: int, top_k: int, top_p: float,
+                    on_trace: Optional[Callable[[], None]] = None):
+    """The fused draft+verify step function (un-jitted; the engine jits
+    it with pool donation and owns the compile counter via
+    ``on_trace``).
+
+    Signature of the returned function::
+
+        spec_step(params, dparams, pk, pv, dk, dv, lengths, toks,
+                  rng, temps)
+          -> (out [S, K+1], accept [S], pk, pv, dk, dv, rng)
+
+    where pk/pv are the target slot pools, dk/dv the draft slot pools
+    (both ``max_len + K`` positions long), lengths/toks/temps the
+    engine's host-mirrored per-slot vectors. ``out[s, :accept[s]+1]``
+    are the tokens slot ``s`` emits this step.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.sampling import sample_logits_dynamic
+
+    def spec_step(params, dparams, pk, pv, dk, dv, lengths, toks, rng,
+                  temps):
+        if on_trace is not None:
+            on_trace()       # trace-time only: counts XLA cache misses
+        rng, sub = jax.random.split(rng)
+
+        # ---- draft: K+1 greedy single-token steps under lax.scan.
+        # Iteration j consumes cur_j (cur_0 = the last emitted token),
+        # writes its KV at position len+j, and proposes cur_{j+1}; the
+        # extra (K+1)th iteration exists only for its KV write, so a
+        # fully accepted step leaves the draft cache complete through
+        # position len+K.
+        def draft_body(carry, j):
+            dk, dv, cur = carry
+            cache = {"k": dk, "v": dv, "idx": lengths + j}
+            logits, new = draft_model.apply({"params": dparams},
+                                            cur[:, None], cache=cache)
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            return (new["k"], new["v"], nxt), nxt
+
+        (dk, dv, _), ys = jax.lax.scan(draft_body, (dk, dv, toks),
+                                       jnp.arange(k + 1))
+        drafts = jnp.transpose(ys[:k])                     # [S, K]
+
+        # ---- verify: ONE target forward over [last_tok, d_1..d_K].
+        # chunked_prefill reuses the cached-attention path (per-row idx,
+        # causal window) — the same program shape prefill compiles.
+        seq = jnp.concatenate([toks[:, None], drafts], axis=1)
+        logits, new = model.apply({"params": params}, seq,
+                                  cache={"k": pk, "v": pv,
+                                         "idx": lengths},
+                                  chunked_prefill=True)
+        # position 0 samples exactly like the non-spec decode program
+        # (greedy rows reduce to argmax; sampled rows get a fresh draw)
+        out0 = sample_logits_dynamic(logits[:, 0, :], sub, temps,
+                                     top_k=top_k, top_p=top_p)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out = jnp.concatenate(
+            [out0[:, None].astype(jnp.int32), greedy[:, 1:]], axis=1)
+        accept = accept_prefix(drafts, out, temps)
+        return out, accept, new["k"], new["v"], dk, dv, rng
+
+    return spec_step

@@ -7,10 +7,12 @@ from ray_tpu.serve.schema import deploy_from_config
 from ray_tpu.serve.deployment import Application, Deployment
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.sharded import ShardedEngineReplica, build_sharded_app
 
 __all__ = ["deployment", "run", "shutdown", "status", "batch", "delete",
            "get_app_handle", "Deployment", "Application",
            "DeploymentHandle", "DeploymentResponse", "multiplexed",
            "get_multiplexed_model_id", "start", "proxies", "grpc_call",
            "deploy_from_config", "slo_status", "fleet_status",
-           "set_tenant_quota", "get_tenant_quotas"]
+           "set_tenant_quota", "get_tenant_quotas",
+           "ShardedEngineReplica", "build_sharded_app"]

@@ -66,30 +66,46 @@ logger = logging.getLogger(__name__)
 
 
 # ------------------------------------------------------------ KV framing
-def pack_kv_spans(spans: List[Tuple[np.ndarray, np.ndarray]]) -> bytes:
+def pack_kv_spans(spans: List[Tuple[np.ndarray, ...]]) -> bytes:
     """Frame exported KV spans into one contiguous payload:
     ``[u32 header_len][msgpack {n, shape, dtype}][k0][v0][k1][v1]...``
     with raw array bytes back to back — the shape ``unpack_kv_spans``
     reads as zero-copy ``np.frombuffer`` views of the arena buffer the
-    data plane received into."""
+    data plane received into.
+
+    A ``kv_quant="int8"`` exporter hands 4-tuple spans ``(qk, qv,
+    k_scales, v_scales)``; the header then carries ``quant: "int8"``
+    plus the scale shape/dtype and each span frames as
+    ``[qk][qv][ks][vs]`` — the wire payload shrinks by
+    ``~itemsize * D / (D + 4)`` vs the fp framing (kv_quant.slot_gain),
+    which is the disagg hand-off half of the int8 win."""
     if not spans:
         hdr = msgpack.packb({"n": 0, "shape": [], "dtype": ""})
         return len(hdr).to_bytes(4, "little") + hdr
     k0 = spans[0][0]
-    hdr = msgpack.packb({"n": len(spans), "shape": list(k0.shape),
-                         "dtype": str(k0.dtype)})
+    meta = {"n": len(spans), "shape": list(k0.shape),
+            "dtype": str(k0.dtype)}
+    if len(spans[0]) == 4:
+        s0 = spans[0][2]
+        meta["quant"] = "int8"
+        meta["sshape"] = list(s0.shape)
+        meta["sdtype"] = str(s0.dtype)
+    hdr = msgpack.packb(meta)
     parts = [len(hdr).to_bytes(4, "little"), hdr]
-    for k, v in spans:
-        parts.append(np.ascontiguousarray(k).tobytes())
-        parts.append(np.ascontiguousarray(v).tobytes())
+    for span in spans:
+        for a in span:
+            parts.append(np.ascontiguousarray(a).tobytes())
     return b"".join(parts)
 
 
-def unpack_kv_spans(buf) -> List[Tuple[np.ndarray, np.ndarray]]:
+def unpack_kv_spans(buf) -> List[Tuple[np.ndarray, ...]]:
     """Inverse of :func:`pack_kv_spans`. Accepts bytes or a memoryview
     (e.g. the zero-copy arena view ``ray_tpu.get`` returns) and hands
     back ``np.frombuffer`` views into it — no copy until the engine's
-    one host->device put."""
+    one host->device put. Quantized payloads come back as the same
+    4-tuples the exporter produced; ``import_kv_blocks`` accepts either
+    form on either engine (host re/de-quantization bridges mixed-mode
+    tiers)."""
     mv = memoryview(buf)
     hlen = int.from_bytes(mv[:4], "little")
     meta = msgpack.unpackb(bytes(mv[4:4 + hlen]), raw=False)
@@ -100,13 +116,27 @@ def unpack_kv_spans(buf) -> List[Tuple[np.ndarray, np.ndarray]]:
     dtype = np.dtype(meta["dtype"])
     span_bytes = dtype.itemsize * int(np.prod(shape))
     off = 4 + hlen
+
+    def take(nbytes, dt, shp):
+        nonlocal off
+        a = np.frombuffer(mv[off:off + nbytes], dt).reshape(shp)
+        off += nbytes
+        return a
+
     spans = []
+    if meta.get("quant") == "int8":
+        sshape = tuple(int(s) for s in meta["sshape"])
+        sdtype = np.dtype(meta["sdtype"])
+        sbytes = sdtype.itemsize * int(np.prod(sshape))
+        for _ in range(n):
+            spans.append((take(span_bytes, dtype, shape),
+                          take(span_bytes, dtype, shape),
+                          take(sbytes, sdtype, sshape),
+                          take(sbytes, sdtype, sshape)))
+        return spans
     for _ in range(n):
-        k = np.frombuffer(mv[off:off + span_bytes], dtype).reshape(shape)
-        off += span_bytes
-        v = np.frombuffer(mv[off:off + span_bytes], dtype).reshape(shape)
-        off += span_bytes
-        spans.append((k, v))
+        spans.append((take(span_bytes, dtype, shape),
+                      take(span_bytes, dtype, shape)))
     return spans
 
 
@@ -266,6 +296,10 @@ class DisaggLLMDeployment(LLMDeployment):
         self._m_handoff_tokens = Counter(
             "serve_kv_handoff_tokens_total",
             "prompt tokens imported via KV hand-off")
+        self._m_handoff_bytes = Counter(
+            "serve_kv_handoff_bytes_total",
+            "KV hand-off payload bytes pulled over the data plane "
+            "(int8 framing roughly halves this vs fp16)")
 
     # ------------------------------------------------------- hand-off
     def _call_prefill(self, toks: List[int]) -> Dict:
@@ -320,7 +354,9 @@ class DisaggLLMDeployment(LLMDeployment):
             imported = eng.import_kv_blocks(toks[:covered], spans)
             self._m_handoffs.inc(tags={"outcome": "ok"})
             self._m_handoff_tokens.inc(max(0, imported))
-            hspan.end(ok=True, covered=covered, imported=imported)
+            self._m_handoff_bytes.inc(len(payload))
+            hspan.end(ok=True, covered=covered, imported=imported,
+                      payload_bytes=len(payload))
         except Exception as e:
             # rung 4: local prefill. Nothing has streamed, so
             # exactly-once delivery is untouched — the request simply

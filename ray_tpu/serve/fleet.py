@@ -533,6 +533,18 @@ class ShellPool:
             self.checked_out_total += 1
             return self._idle.pop(0)    # FIFO: oldest (warmest) first
 
+    def checkout_many(self, n: int) -> Optional[List[Any]]:
+        """Atomic gang checkout: n shells or none. A partial gang is
+        useless (every rank of a sharded replica must come up together)
+        and handing out half the pool would starve the next single-shell
+        revival for nothing."""
+        with self._lock:
+            if len(self._idle) < n:
+                return None
+            self.checked_out_total += n
+            out, self._idle = self._idle[:n], self._idle[n:]
+            return out
+
     def discard(self, shell: Any):
         """A shell that failed mid-attach is in an unknown state: kill
         it rather than pool it."""
@@ -579,6 +591,7 @@ class ReplicaShell:
         self._replica_cls = Replica
         Replica._init_state(self)
         self._attached = False
+        self._shard = None      # set by attach_shard (gang revival)
         self._prewarm()
 
     def _prewarm(self):
@@ -603,6 +616,32 @@ class ReplicaShell:
         self._attached = True
         return True
 
+    def attach_shard(self, rank: int, world_size: int, group_name: str,
+                     serialized_callable: bytes, init_args: tuple,
+                     init_kwargs: Dict, is_function: bool) -> bool:
+        """Gang-aware attach: turn this warm shell into ONE RANK of a
+        sharded replica group (serve/sharded_replica.py). The fleet
+        manager checks out ``world_size`` shells atomically and runs
+        this on all of them CONCURRENTLY — setup_distributed's
+        rendezvous and the callable's lockstep ``on_shell_attach``
+        warmup both need every rank in flight at once. Chaos fires at
+        the same two points as a plain attach; one rank failing
+        discards the whole gang (partial gangs are never published)."""
+        from ray_tpu._private import rpc
+        from ray_tpu.serve.sharded_replica import ReplicaShard
+        rpc._maybe_inject_failure("shell_attach")
+        shard = ReplicaShard(rank, world_size)
+        shard.setup_distributed(group_name)
+        shard.init_callable(serialized_callable, tuple(init_args),
+                            init_kwargs, is_function)
+        hook = getattr(shard._callable, "on_shell_attach", None)
+        if hook is not None:
+            hook()
+        rpc._maybe_inject_failure("shell_attach")
+        self._shard = shard
+        self._attached = True
+        return True
+
     def _require_attached(self):
         if not self._attached:
             raise RuntimeError("replica shell has no deployment attached")
@@ -610,31 +649,69 @@ class ReplicaShell:
     # ------------------------------------------------- replica protocol
     def handle_request(self, method, args, kwargs):
         self._require_attached()
+        if self._shard is not None:
+            return self._shard.handle_request(method, args, kwargs)
         return self._replica_cls.handle_request(self, method, args, kwargs)
 
     def handle_stream(self, method, args, kwargs):
         self._require_attached()
+        if self._shard is not None:
+            yield from self._shard.handle_stream(method, args, kwargs)
+            return
         yield from self._replica_cls.handle_stream(self, method, args,
                                                    kwargs)
 
     def begin_drain(self):
+        if self._shard is not None:
+            return self._shard.begin_drain()
         return self._replica_cls.begin_drain(self)
 
     def get_runtime_state(self):
+        if self._shard is not None:
+            return self._shard.get_runtime_state()
         return self._replica_cls.get_runtime_state(self)
 
     def get_queue_len(self):
+        if self._shard is not None:
+            return self._shard.get_queue_len()
         return self._replica_cls.get_queue_len(self)
 
     def check_health(self):
         # an idle pooled shell is healthy by construction
         if not self._attached:
             return True
+        if self._shard is not None:
+            return self._shard.check_health()
         return self._replica_cls.check_health(self)
 
     def reconfigure(self, user_config):
         self._require_attached()
+        if self._shard is not None:
+            return self._shard.reconfigure(user_config)
         return self._replica_cls.reconfigure(self, user_config)
+
+    # ------------------------------------- shard protocol (gang peers)
+    # Rank 0's ReplicaShard fans to peer handles by these names — when
+    # the gang was revived from pooled shells, the peers ARE shells.
+    def set_peers(self, peers):
+        self._require_attached()
+        return self._shard.set_peers(peers)
+
+    def run_shard(self, method, args, kwargs):
+        self._require_attached()
+        return self._shard.run_shard(method, args, kwargs)
+
+    def run_shard_drain(self, method, args, kwargs):
+        self._require_attached()
+        return self._shard.run_shard_drain(method, args, kwargs)
+
+    def check_peer_health(self):
+        self._require_attached()
+        return self._shard.check_peer_health()
+
+    def reconfigure_shard(self, user_config):
+        self._require_attached()
+        return self._shard.reconfigure_shard(user_config)
 
 
 # ---------------------------------------------------------- fleet manager
@@ -744,26 +821,32 @@ class FleetManager:
                 spec = dep["spec"]
                 gen = dep.get("gen", 0)
             handle, group, via = None, None, "shell"
-            # try every pooled shell once, then one fresh cold build —
-            # the chaos suite kills shells mid-attach and the held
-            # requests must still land exactly once
-            for attempt in range(max(1, self.pool.size)):
-                shell = self.pool.checkout()
-                if shell is None:
-                    break
-                try:
-                    ray_tpu.get(shell.attach.remote(
-                        spec["callable"], tuple(spec["init_args"]),
-                        spec["init_kwargs"], spec["is_function"]),
-                        timeout=cfg.fleet_attach_timeout_s)
-                    handle = shell
-                    break
-                except Exception:
-                    logger.warning(
-                        "shell attach failed for %s/%s (attempt %d); "
-                        "discarding shell", app, name, attempt + 1,
-                        exc_info=True)
-                    self.pool.discard(shell)
+            n_hosts = int(spec["config"].get("num_hosts") or 1)
+            if n_hosts > 1:
+                got = self._attach_shard_gang(spec, n_hosts)
+                if got is not None:
+                    handle, group = got
+            else:
+                # try every pooled shell once, then one fresh cold
+                # build — the chaos suite kills shells mid-attach and
+                # the held requests must still land exactly once
+                for attempt in range(max(1, self.pool.size)):
+                    shell = self.pool.checkout()
+                    if shell is None:
+                        break
+                    try:
+                        ray_tpu.get(shell.attach.remote(
+                            spec["callable"], tuple(spec["init_args"]),
+                            spec["init_kwargs"], spec["is_function"]),
+                            timeout=cfg.fleet_attach_timeout_s)
+                        handle = shell
+                        break
+                    except Exception:
+                        logger.warning(
+                            "shell attach failed for %s/%s (attempt %d); "
+                            "discarding shell", app, name, attempt + 1,
+                            exc_info=True)
+                        self.pool.discard(shell)
             if handle is None:
                 via = "cold"
                 self.cold_builds_total += 1
@@ -801,6 +884,46 @@ class FleetManager:
                 self.pool.ensure()     # replenish for the next cold start
             except Exception:
                 logger.debug("shell pool refill failed", exc_info=True)
+
+    def _attach_shard_gang(self, spec: Dict, n_hosts: int):
+        """Gang-aware pre-warm revival for a sharded (``num_hosts > 1``)
+        deployment: check out ``n_hosts`` shells atomically and attach
+        them CONCURRENTLY as the ranks of one replica group —
+        rendezvous + lockstep warmup need every rank in flight at once
+        (ReplicaShell.attach_shard). Returns ``(rank0_handle,
+        group_record)`` or None (pool too shallow / attach failed /
+        topology-pinned spec) — the caller cold-builds via the
+        controller's gang path.
+
+        Topology-pinned gangs always cold-build: pooled shells carry no
+        placement, so they cannot satisfy STRICT_SPREAD over one
+        slice's hosts."""
+        import uuid
+
+        import ray_tpu
+        if spec["config"].get("topology"):
+            return None
+        shells = self.pool.checkout_many(n_hosts)
+        if shells is None:
+            return None
+        group_name = f"serve-shard-{uuid.uuid4().hex[:8]}"
+        try:
+            ray_tpu.get(
+                [s.attach_shard.remote(
+                    rank, n_hosts, group_name, spec["callable"],
+                    tuple(spec["init_args"]), spec["init_kwargs"],
+                    spec["is_function"])
+                 for rank, s in enumerate(shells)],
+                timeout=cfg.fleet_attach_timeout_s)
+            ray_tpu.get(shells[0].set_peers.remote(shells[1:]), timeout=60)
+        except Exception:
+            logger.warning(
+                "gang shell attach failed for %s (%d ranks); discarding "
+                "the whole gang", spec["name"], n_hosts, exc_info=True)
+            for s in shells:
+                self.pool.discard(s)
+            return None
+        return shells[0], {"members": list(shells), "pg": None}
 
     def _record_cold_start(self, key: tuple, cold_ms: float, via: str):
         with self._lock:

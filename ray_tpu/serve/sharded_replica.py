@@ -158,9 +158,34 @@ class ReplicaShard:
                     except Exception:
                         self._wedged = True
                         raise
+                if completed:
+                    self._verify_stream_digest()
         finally:
             with self._lock:
                 self._ongoing -= 1
+
+    def _verify_stream_digest(self):
+        """Digest agreement on sampled tokens (opt-in: the callable
+        exposes ``last_stream_digest``). After a completed stream every
+        rank must have produced the same token bytes — a mismatch means
+        the SPMD invariant broke (rank-local rng drift, bad kernel) and
+        the gang is serving split-brain output, so it wedges itself for
+        whole-group replacement rather than continue."""
+        import ray_tpu
+        fn = getattr(self._callable, "last_stream_digest", None)
+        if fn is None or not self._peers:
+            return
+        local = fn()
+        theirs = ray_tpu.get(
+            [p.run_shard.remote("last_stream_digest", (), {})
+             for p in self._peers], timeout=30)
+        for rank, d in enumerate(theirs, start=1):
+            if d != local:
+                self._wedged = True
+                raise RuntimeError(
+                    f"sharded replica digest divergence: rank 0 "
+                    f"produced {local}, rank {rank} produced {d} — "
+                    f"gang wedged for replacement")
 
     def run_shard_drain(self, method: str, args: Tuple, kwargs: Dict):
         """Peer side of a streamed request: step the generator to

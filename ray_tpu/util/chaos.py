@@ -256,6 +256,64 @@ class ShellAttachKiller:
         rpc._CHAOS_SPEC = None
 
 
+class GangRankKiller:
+    """Kills one NON-ZERO rank of a sharded serving replica gang
+    mid-decode: :class:`~ray_tpu.serve.sharded.ShardedEngineReplica`
+    runs the ``gang_rank`` injection hook before every engine step on
+    ranks != 0, and when it fires the rank SIGKILLs its own process —
+    the crash shape (no exception crosses the actor boundary; the peer
+    simply stops answering while rank 0 is mid-stream).
+
+    What the recovery path must then deliver, in order:
+
+    1. rank 0's bounded peer-drain wait times out → the gang WEDGES
+       (``_wedged``) — a half-dead SPMD world is never reused;
+    2. ``check_health`` raises → the controller retires every member +
+       the placement group as one unit (whole-gang drain);
+    3. the fleet manager revives through ``checkout_many`` +
+       ``attach_shard`` (gang-aware pre-warm) or a cold gang build;
+    4. the severed stream re-routes with ``resume_tokens`` — delivered
+       tokens ride the prompt, so the client sees each token exactly
+       once and a greedy stream continues bit-identically.
+
+    Spec: ``RAY_TPU_TESTING_RPC_FAILURE="gang_rank=p"``; like the other
+    RPC-chaos specs the env must reach the victim actor before its
+    first injection check caches the parsed spec. ``arm_local`` /
+    ``disarm_local`` reset the cache for in-process tests (rank 0 never
+    checks the hook, so arming a single-process gang is inert — the
+    unit tier patches ``os.kill`` to observe the would-be death)."""
+
+    SPEC_ENV = "RAY_TPU_TESTING_RPC_FAILURE"
+
+    def __init__(self, probability: float = 1.0):
+        if not 0.0 < probability <= 1.0:
+            raise ValueError("probability must be in (0, 1]")
+        self.probability = probability
+
+    def spec(self) -> str:
+        return f"gang_rank={self.probability}"
+
+    def env(self, base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+        e = dict(base if base is not None else os.environ)
+        prior = e.get(self.SPEC_ENV)
+        e[self.SPEC_ENV] = f"{prior},{self.spec()}" if prior else self.spec()
+        return e
+
+    def arm_local(self):
+        """Arm the CURRENT process (direct-instantiation tests): sets
+        the env var and resets rpc.py's parsed-spec cache so the next
+        injection check re-reads it. Pair with :meth:`disarm_local`."""
+        from ray_tpu._private import rpc
+        os.environ[self.SPEC_ENV] = self.spec()
+        rpc._CHAOS_SPEC = None
+
+    @staticmethod
+    def disarm_local():
+        from ray_tpu._private import rpc
+        os.environ.pop(GangRankKiller.SPEC_ENV, None)
+        rpc._CHAOS_SPEC = None
+
+
 class StageKiller:
     """Injects stage loss into the elastic MPMD pipeline trainer
     (train/mpmd.py) through BOTH failure channels the recovery path must

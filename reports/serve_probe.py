@@ -162,7 +162,12 @@ def _run_disagg(model, params, spec, reqs, arrivals, n_slots, max_len,
     engine fills KV blocks, a decode engine imports them through the
     real wire framing (pack/unpack round-trip) and serves the Poisson
     stream. Returns per-run rates + decode-engine stats + hand-off
-    count — recorded next to the colocated number in the SAME entry."""
+    accounting (count, payload bytes, and the fp16-framing bytes the
+    same spans would have cost — with ``kv_quant: "int8"`` the saving
+    is the wire half of the int8 win) — recorded next to the colocated
+    number in the SAME entry."""
+    import numpy as np
+
     from ray_tpu.inference import EngineConfig, InferenceEngine
     from ray_tpu.serve.disagg import pack_kv_spans, unpack_kv_spans
 
@@ -173,6 +178,7 @@ def _run_disagg(model, params, spec, reqs, arrivals, n_slots, max_len,
                          prefill_chunk=prefill_chunk,
                          prefill_budget=spec.get("prefill_budget",
                                                  2 * prefill_chunk),
+                         kv_quant=spec.get("kv_quant", "none"),
                          prefix_cache_slots=pslots)).start()
 
     pslots = max(1, int(cache_slots))
@@ -182,6 +188,7 @@ def _run_disagg(model, params, spec, reqs, arrivals, n_slots, max_len,
     list(decode.submit(reqs[0]["prompt"][:4], max_new_tokens=2))
     C = prefill_chunk
     handoffs = [0]
+    wire = {"payload_bytes": 0, "fp16_bytes": 0}
 
     def submit_one(r):
         toks = [int(t) for t in r["prompt"]]
@@ -197,6 +204,10 @@ def _run_disagg(model, params, spec, reqs, arrivals, n_slots, max_len,
                 decode.import_kv_blocks(toks[:covered],
                                         unpack_kv_spans(payload))
                 handoffs[0] += 1
+                wire["payload_bytes"] += len(payload)
+                wire["fp16_bytes"] += sum(
+                    (np.asarray(s[0]).size + np.asarray(s[1]).size) * 2
+                    for s in spans)
         return decode.submit(toks, max_new_tokens=r["new"])
 
     rates = []
@@ -214,7 +225,7 @@ def _run_disagg(model, params, spec, reqs, arrivals, n_slots, max_len,
     prefill.stop()
     decode.stop()
     rates.sort()
-    return rates, stats, handoffs[0]
+    return rates, stats, handoffs[0], wire
 
 
 def run(spec):
@@ -323,8 +334,11 @@ def run(spec):
     if spec.get("disagg"):
         # disagg-vs-colocated split (ROADMAP item 1): the same workload
         # through a prefill-tier/decode-tier pair with real KV hand-off
-        # framing, recorded next to the colocated median above
-        d_rates, d_stats, handoffs = _run_disagg(
+        # framing, recorded next to the colocated median above. The
+        # colocated figure above never changes with kv_quant — only the
+        # disagg tiers opt in, keeping serve_tokens_per_s ratchet-
+        # comparable across rounds.
+        d_rates, d_stats, handoffs, wire = _run_disagg(
             model, params, spec, reqs, arrivals, n_slots, max_len,
             prefill_chunk, cache_slots or 2)
         d_med = d_rates[len(d_rates) // 2]
@@ -343,10 +357,39 @@ def run(spec):
             if lookups else 0.0,
             "disagg_decode_compile_count":
                 d_stats.get("decode_compile_count"),
+            "kv_handoff_payload_bytes": wire["payload_bytes"],
+            "kv_handoff_fp16_bytes": wire["fp16_bytes"],
         })
+        if spec.get("kv_quant", "none") != "none":
+            saved = wire["fp16_bytes"] - wire["payload_bytes"]
+            result.update({
+                "kv_quant": spec["kv_quant"],
+                "kv_handoff_bytes_saved_vs_fp16": saved,
+                "kv_handoff_wire_ratio_vs_fp16": round(
+                    wire["payload_bytes"] / wire["fp16_bytes"], 3)
+                if wire["fp16_bytes"] else None,
+                "kv_quant_slot_gain_vs_fp16":
+                    d_stats.get("kv_quant_slot_gain_vs_fp16"),
+            })
+    if spec.get("sharded"):
+        # sharded-replica figure as its OWN nested entry (the colocated
+        # single-device serve_tokens_per_s above stays untouched for the
+        # vs_r05_ratchet comparison; reports/sharded_probe.py owns the
+        # methodology)
+        _here = os.path.dirname(os.path.abspath(__file__))
+        if _here not in sys.path:
+            sys.path.insert(0, _here)
+        import sharded_probe
+        result["sharded"] = sharded_probe.run(dict(
+            spec.get("sharded") if isinstance(spec.get("sharded"), dict)
+            else {}))
     return result
 
 
 if __name__ == "__main__":
-    spec = json.loads(sys.argv[sys.argv.index("--one") + 1])
+    args = sys.argv[1:]
+    spec = json.loads(args[args.index("--one") + 1]) \
+        if "--one" in args else {}
+    if "--sharded" in args:
+        spec.setdefault("sharded", True)
     print("RESULT " + json.dumps(run(spec)), flush=True)

@@ -1,0 +1,20 @@
+"""BAD: the spec-decode retrace bug — host coercion and Python control
+flow on the traced accept count inside a jitted verify step (RT002).
+Each distinct accept count would retrace (or just crash under jit);
+accept_prefix must stay a lax cumprod/sum with a fixed-shape write."""
+import jax
+
+
+@jax.jit
+def verify_step(drafts, verified, n_accept):
+    n = int(n_accept)                  # RT002: concretizes traced count
+    if n_accept > 0:                   # RT002: Python branch on traced value
+        return verified[:, :n]
+    return drafts
+
+
+def build_accept(model):
+    def accept(drafts, out, temps):
+        k = out.argmax(-1).item()      # RT002: .item() host sync in trace
+        return drafts[:k]
+    return jax.jit(accept)

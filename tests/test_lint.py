@@ -47,6 +47,7 @@ def rules_hit(result):
     ("rt001_bad_sleep.py", "RT001", 3),
     ("rt001_bad_handler.py", "RT001", 3),
     ("rt002_bad_coerce.py", "RT002", 3),
+    ("rt002_bad_spec_accept.py", "RT002", 3),
     ("rt002_bad_donate.py", "RT002", 2),
     ("rt002_bad_donate_apply.py", "RT002", 2),
     ("rt003_bad_unlocked.py", "RT003", 3),
