@@ -760,6 +760,28 @@ def bench_observability_overhead():
     return {"skipped": True, "reason": last}
 
 
+def bench_control_plane():
+    """Scheduler-throughput ratchets (reports/control_probe.py): drives
+    hundreds of actor launches + placement decisions through a live
+    mini-cluster and reports actor_launch_per_s, placement p50/p99, and
+    the worst per-handler GCS RPC p99 the storm produced — with the
+    probe's own plausibility guards (no sub-ms process launches, no
+    zero-p99 under load)."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    runner = os.path.join(here, "reports", "control_probe.py")
+    spec = {"actors": 100, "waves": 3, "placements": 60}
+    last = "unknown"
+    for attempt in range(2):
+        if attempt:
+            time.sleep(5)
+        result, last = _run_probe(runner, spec, timeout=900)
+        if result is not None:
+            return result
+        log(f"control plane probe failed: {last}")
+    return {"skipped": True, "reason": last}
+
+
 def bench_train_step_mfu():
     """Flagship-model train step on the real chip: tokens/s + MFU.
 
@@ -1404,10 +1426,13 @@ def main():
                 "decode_steps_per_s_on": rec.get("decode_steps_per_s_on"),
                 "decode_steps_per_s_off": rec.get(
                     "decode_steps_per_s_off"),
+                "overhead_gcs_pct": rec.get("overhead_gcs_pct"),
+                "gcs_rpc_wrap_us": rec.get("gcs_rpc_wrap_us"),
                 "within_budget": rec.get("within_budget")}
             log(f"observability_overhead: decode "
                 f"{rec['overhead_decode_pct']}%"
                 f" put {rec.get('overhead_put_pct')}% "
+                f"gcs {rec.get('overhead_gcs_pct')}% "
                 f"(within_budget={rec.get('within_budget')})")
             if rec.get("metrics_query_ms") is not None:
                 results["metrics_query_ms"] = {
@@ -1428,6 +1453,40 @@ def main():
         log(f"observability overhead probe FAILED: {e}")
         results["observability_overhead"] = {"skipped": True,
                                              "reason": str(e)[:200]}
+
+    try:
+        cp = bench_control_plane()
+        if not cp.get("skipped") and cp.get("plausible"):
+            results["actor_launch_per_s"] = {
+                "value": cp["actor_launch_per_s"],
+                "unit": "launches_per_s",
+                "spread": cp.get("launch_spread"),
+                "runs": cp.get("launch_runs"),
+                "actors_per_wave": cp.get("actors_per_wave"),
+                "waves": cp.get("waves")}
+            results["placement_latency_ms"] = {
+                "value": cp["placement_latency_p50_ms"], "unit": "ms",
+                "p99_ms": cp["placement_latency_p99_ms"],
+                "placements": cp.get("placements")}
+            if cp.get("gcs_rpc_p99_ms") is not None:
+                results["gcs_rpc_p99_ms"] = {
+                    "value": cp["gcs_rpc_p99_ms"], "unit": "ms",
+                    "handler": cp.get("gcs_rpc_top_handler"),
+                    "handlers": cp.get("gcs_rpc_handlers")}
+            log(f"control_plane: {cp['actor_launch_per_s']} launches/s "
+                f"(spread {cp.get('launch_spread')}), placement p50 "
+                f"{cp['placement_latency_p50_ms']}ms p99 "
+                f"{cp['placement_latency_p99_ms']}ms, gcs rpc p99 "
+                f"{cp.get('gcs_rpc_p99_ms')}ms "
+                f"({cp.get('gcs_rpc_top_handler')})")
+        else:
+            results["control_plane"] = cp
+            log(f"control plane probe skipped/rejected: "
+                f"{cp.get('reason') or cp.get('rejected')}")
+    except Exception as e:
+        log(f"control plane probe FAILED: {e}")
+        results["control_plane"] = {"skipped": True,
+                                    "reason": str(e)[:200]}
     if not mfu_res.get("skipped"):
         vs_r05_mfu = round(mfu_res["mfu"] / R05_TRAIN_STEP_MFU, 3)
         results["train_step_mfu"] = {

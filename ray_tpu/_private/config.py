@@ -224,6 +224,45 @@ _flag("metrics_ts_max_samples", int, 600,
 _flag("metrics_ts_max_series", int, 4096,
       "Total (metric, tags, worker) series the GCS time-series plane "
       "retains; new series past the cap are counted and dropped.")
+# Observability: GCS hot-path tracing + launch attribution (gcs_obs.py)
+_flag("gcs_slow_rpc_ms", float, 50.0,
+      "A GCS handler call slower than this emits a gcs.rpc span onto "
+      "the runtime-event timeline (always, regardless of sampling); "
+      "faster calls are sampled 1-in-gcs_rpc_sample_n. 0 disables the "
+      "span path entirely (histograms still accumulate).")
+_flag("gcs_rpc_sample_n", int, 100,
+      "Sample rate for FAST handler spans: every Nth sub-threshold call "
+      "per handler also emits a gcs.rpc span (0 = slow calls only). "
+      "Latency/inflight histograms always record every call.")
+_flag("gcs_obs_interval_s", float, 2.0,
+      "Cadence of the GCS self-metrics loop (per-handler RPC "
+      "histograms, pubsub backlog/latency, KV and table size gauges "
+      "ingested into the time-series plane as worker 'gcs'). 0 "
+      "disables the loop.")
+_flag("launch_trace_enabled", bool, True,
+      "Thread an actor.launch root span through GCS placement, node "
+      "manager resource wait/worker obtain, and worker callable init, "
+      "so every actor/replica launch renders as a phase-decomposed "
+      "track in `ray_tpu timeline` and feeds the "
+      "runtime_launch_phase_ms{phase} gauges.")
+# Observability: crash black boxes (blackbox.py)
+_flag("blackbox_enabled", bool, True,
+      "Every daemon (GCS, node managers, workers) mirrors its flight-"
+      "recorder ring and periodic metrics snapshots to a bounded "
+      "on-disk NDJSON black box, sealed on clean exit / SIGTERM / "
+      "GCS-disconnect death. `ray_tpu blackbox` stitches surviving "
+      "boxes into one cross-node post-mortem timeline.")
+_flag("blackbox_dir", str, "",
+      "Directory for black-box files (empty = "
+      "/tmp/raytpu/<session>/blackbox). One <process>-<pid>.bbox.ndjson "
+      "per process plus at most one rotated .1 segment each.")
+_flag("blackbox_max_bytes", int, 4 * 1024 * 1024,
+      "Per-process black-box size bound: the live segment rotates to a "
+      "single .1 segment at half this, so live+rotated never exceed it.")
+_flag("blackbox_metrics_interval_s", float, 5.0,
+      "Cadence of the black box's metrics-registry snapshot records "
+      "(the 'last known metrics' a post-mortem sees for a SIGKILL'd "
+      "process). 0 disables periodic snapshots (seal still writes one).")
 # Observability: object-lifetime ledger (GCS object_ledger table)
 _flag("ledger_enabled", bool, True,
       "Maintain per-object provenance records (creator, owner, size, "

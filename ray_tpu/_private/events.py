@@ -51,7 +51,7 @@ __all__ = [
     "record_span", "record_instant", "record_complete", "start_span",
     "Span", "current_context", "trace_context", "new_trace_id",
     "new_span_id", "enabled", "set_enabled", "flush", "drain", "stats",
-    "configure", "set_sink", "set_identity",
+    "configure", "set_sink", "set_identity", "set_tap", "peek",
 ]
 
 _lock = threading.Lock()
@@ -61,6 +61,7 @@ _dropped_unreported = 0       # drops since the last flushed batch
 _capacity = int(os.environ.get("RAY_TPU_RUNTIME_EVENT_BUFFER", "8192"))
 _enabled = os.environ.get("RAY_TPU_FLIGHT_RECORDER", "1") != "0"
 _sink: Optional[Callable[[List[Dict]], None]] = None
+_tap: Optional[Callable[[Dict], None]] = None
 _identity: Dict[str, str] = {}
 _flusher_started = False
 _tls = threading.local()
@@ -269,6 +270,11 @@ def _append(rec: Dict) -> None:
             _dropped_total += 1
             _dropped_unreported += 1
         _buf.append(rec)
+    if _tap is not None:
+        try:
+            _tap(rec)
+        except Exception:
+            pass
     if not _flusher_started:
         _ensure_flusher()
 
@@ -308,6 +314,27 @@ def set_sink(fn: Optional[Callable[[List[Dict]], None]]) -> None:
     manager) use this to ship through their own GCS connection."""
     global _sink
     _sink = fn
+
+
+def set_tap(fn: Optional[Callable[[Dict], None]]) -> None:
+    """Install a copy-tap: called with every ring record as it is
+    appended, WITHOUT consuming it (flush/drain still ship normally).
+    The crash black box uses this to mirror the flight recorder to disk
+    continuously, so a SIGKILL'd process still leaves its last records
+    behind. Must be cheap and must not raise (exceptions are swallowed
+    to protect the recording hot path)."""
+    global _tap
+    _tap = fn
+
+
+def peek(max_records: Optional[int] = None) -> List[Dict]:
+    """Copy (do NOT consume) the newest buffered records — the black
+    box seals with these so a final flush and a post-mortem snapshot
+    can both see the same tail."""
+    with _lock:
+        if max_records is None:
+            return list(_buf)
+        return list(_buf[-max_records:])
 
 
 def set_identity(node_id: Optional[str] = None,

@@ -104,6 +104,18 @@ class GcsServer:
             retention_s=cfg.metrics_ts_retention_s,
             max_samples=cfg.metrics_ts_max_samples,
             max_series=cfg.metrics_ts_max_series)
+        # hot-path observability: per-handler latency/inflight, pubsub
+        # deliver latency, table sizes (gcs_obs.py); self-ingested into
+        # metrics_ts on the _obs_loop cadence as worker "gcs"
+        from ray_tpu._private.gcs_obs import GcsObservability
+        self.obs = GcsObservability(self)
+        self._obs_task: Optional[asyncio.Task] = None
+        # in-flight launch table (node managers notify launch_phase):
+        # actor_id -> {name, phase, phase_ts, started, node_id} — the
+        # `ray_tpu status` control-plane pane reads this; completed
+        # launches retire into the _launch_done ring
+        self.launches: Dict[str, Dict] = {}
+        self._launch_done: List[Dict] = []
         self.server = None
 
     # ------------------------------------------------------------- lifecycle
@@ -151,8 +163,11 @@ class GcsServer:
             "get_prefix_summaries": self.h_get_prefix_summaries,
             "set_tenant_quota": self.h_set_tenant_quota,
             "get_tenant_quotas": self.h_get_tenant_quotas,
+            "launch_phase": self.h_launch_phase,
+            "control_plane_stats": self.h_control_plane_stats,
             "ping": lambda conn: "pong",
         }
+        handlers = self.obs.wrap_handlers(handlers)
         self.server = rpc.Server(handlers, name="gcs")
         self.server.on_disconnect = self._on_disconnect
         self._load_snapshot()
@@ -182,6 +197,8 @@ class GcsServer:
         self._snapshot_task = None
         if self.persist_path:
             self._snapshot_task = asyncio.ensure_future(self._snapshot_loop())
+        if cfg.gcs_obs_interval_s > 0:
+            self._obs_task = asyncio.ensure_future(self._obs_loop())
         logger.info("GCS listening at %s", self.address)
         return self.address
 
@@ -303,11 +320,26 @@ class GcsServer:
             except Exception:
                 logger.exception("snapshot save failed")
 
+    async def _obs_loop(self):
+        """Self-ingest the control plane's own metrics (same pattern as
+        the ledger sweep's gauges): the GCS is its own metrics agent,
+        pushing as worker 'gcs' with no pusher thread."""
+        while True:
+            await asyncio.sleep(cfg.gcs_obs_interval_s)
+            try:
+                self.obs.refresh_config()
+                self.h_report_metrics(None, "gcs", self.obs.metric_rows())
+            except Exception:
+                logger.exception("gcs self-metrics export failed")
+
     async def stop(self):
         if self._death_checker:
             self._death_checker.cancel()
         if self._ledger_sweeper:
             self._ledger_sweeper.cancel()
+        if self._obs_task:
+            self._obs_task.cancel()
+            self._obs_task = None
         if getattr(self, "_snapshot_task", None):
             self._snapshot_task.cancel()
             self._snapshot_task = None
@@ -381,9 +413,13 @@ class GcsServer:
         self._touch_node(node_id)
         logger.info("node %s registered at %s (%s)", node_id[:12], address, resources)
         self._publish("NODE", node_id, {"state": "ALIVE", **_node_public(self.nodes[node_id])})
+        # gcs_ts lets the registering node measure its wall-clock offset
+        # vs the GCS (local - gcs, half-RTT error bound) — the black box
+        # records it so cross-node stitches can de-skew
         return {"node_id": node_id, "cluster_view": self._cluster_view(),
                 "view_version": self._view_version,
-                "system_config": cfg.snapshot()}
+                "system_config": cfg.snapshot(),
+                "gcs_ts": time.time()}
 
     def h_heartbeat(self, conn, node_id: str,
                     available: Optional[Dict[str, float]] = None,
@@ -527,6 +563,127 @@ class GcsServer:
         asyncio.ensure_future(self._schedule_actor(actor_id))
         return True
 
+    # ------------------------------------------------- launch attribution
+    # One actor.launch root span per launch, decomposed phase-by-phase:
+    # the GCS owns placement; the node manager and worker record their
+    # phases (resource_wait / worker_obtain / become_actor /
+    # callable_init) as children under the trace ctx forwarded with the
+    # create_actor call. The in-flight table feeds `ray_tpu status`.
+    def _launch_begin(self, actor_id: str, spec: Dict) -> Optional[Dict]:
+        if not cfg.launch_trace_enabled:
+            return None
+        ent = self.launches.get(actor_id)
+        if ent is None:
+            from ray_tpu._private import events as _events
+            now = time.time()
+            ent = self.launches[actor_id] = {
+                "actor_id": actor_id,
+                "name": (spec.get("name")
+                         or spec.get("class_name") or "actor"),
+                "trace_id": _events.new_trace_id(),
+                "root_span_id": _events.new_span_id(),
+                "started": now, "phase": "placement", "phase_ts": now,
+                "retries": 0, "node_id": None,
+            }
+        return ent
+
+    def _launch_phase(self, ent: Optional[Dict], phase: str,
+                      ts: Optional[float] = None):
+        if ent is not None:
+            ent["phase"] = phase
+            ent["phase_ts"] = time.time() if ts is None else ts
+
+    def _launch_span_row(self, ent: Dict, name: str, start: float,
+                         end: float, parent: Optional[str],
+                         **attrs) -> None:
+        """One launch-phase span row straight into this GCS's own
+        task-event ring (category 'launch' -> its own timeline track)."""
+        from ray_tpu._private import events as _events
+        span_id = _events.new_span_id()
+        self.h_add_task_events(None, [{
+            "task_id": span_id, "kind": "runtime_event",
+            "type": "RUNTIME_EVENT", "event_kind": "span",
+            "name": name, "category": "launch",
+            "trace_id": ent["trace_id"], "span_id": span_id,
+            "parent_span_id": parent, "node_id": ent.get("node_id"),
+            "worker_id": "gcs",
+            "attrs": {"actor_id": ent["actor_id"],
+                      "actor": ent["name"], **attrs},
+            "state": "RUNNING", "ts": start,
+        }, {"task_id": span_id, "state": "FINISHED", "ts": end}])
+
+    def _launch_finish(self, actor_id: str, ok: bool,
+                       error: Optional[str] = None):
+        ent = self.launches.pop(actor_id, None)
+        if ent is None:
+            return
+        now = time.time()
+        total_ms = (now - ent["started"]) * 1e3
+        # the root span row reuses the pre-minted root_span_id so the
+        # children recorded remotely already parent under it
+        from ray_tpu._private import events as _events  # noqa: F401
+        self.h_add_task_events(None, [{
+            "task_id": ent["root_span_id"], "kind": "runtime_event",
+            "type": "RUNTIME_EVENT", "event_kind": "span",
+            "name": "actor.launch", "category": "launch",
+            "trace_id": ent["trace_id"], "span_id": ent["root_span_id"],
+            "parent_span_id": None, "node_id": ent.get("node_id"),
+            "worker_id": "gcs",
+            "attrs": {"actor_id": actor_id, "actor": ent["name"],
+                      "ok": ok, "retries": ent["retries"],
+                      "total_ms": round(total_ms, 3),
+                      **({"error": error} if error else {})},
+            "state": "RUNNING", "ts": ent["started"],
+        }, {"task_id": ent["root_span_id"],
+            "state": "FINISHED" if ok else "FAILED", "ts": now}])
+        self._launch_done.append({
+            "actor_id": actor_id, "actor": ent["name"], "ok": ok,
+            "total_ms": round(total_ms, 3), "finished": now})
+        del self._launch_done[:-100]
+
+    async def h_launch_phase(self, conn, actor_id: str, phase: str,
+                             ts: Optional[float] = None,
+                             node_id: Optional[str] = None):
+        """Node managers report phase transitions of an in-flight launch
+        (resource_wait / worker_obtain / become_actor) so the status
+        pane shows WHERE a slow launch currently sits."""
+        ent = self.launches.get(actor_id)
+        if ent is not None:
+            self._launch_phase(ent, phase, ts)
+            if node_id:
+                ent["node_id"] = node_id
+        return True
+
+    def h_control_plane_stats(self, conn, top_n: int = 3):
+        """One-call snapshot for the `ray_tpu status` control-plane
+        pane: hottest handlers by p99, pubsub backlog, in-flight
+        launches with their current phase, black boxes on disk."""
+        now = time.time()
+        inflight = [{"actor_id": e["actor_id"][:12], "actor": e["name"],
+                     "phase": e["phase"],
+                     "phase_age_s": round(now - e["phase_ts"], 3),
+                     "age_s": round(now - e["started"], 3),
+                     "node_id": (e.get("node_id") or "")[:12]}
+                    for e in self.launches.values()]
+        inflight.sort(key=lambda e: -e["age_s"])
+        done = self._launch_done[-20:]
+        from ray_tpu._private import blackbox as _bb
+        return {
+            "handlers": self.obs.top_handlers(top_n),
+            "rpc_inflight": self.obs.inflight_total,
+            "pubsub": {"backlog": self.obs.pubsub_pending,
+                       "delivered": self.obs.pubsub_delivered,
+                       "failed": self.obs.pubsub_failed},
+            "launches": inflight,
+            "launches_done": len(self._launch_done),
+            "recent_launch_ms": [d["total_ms"] for d in done],
+            "blackboxes": _bb.count_boxes(self._blackbox_dir()),
+        }
+
+    def _blackbox_dir(self) -> str:
+        return (cfg.blackbox_dir
+                or f"/tmp/raytpu/{self.session_name}/blackbox")
+
     async def _schedule_actor(self, actor_id: str, delay: float = 0.0):
         if delay:
             await asyncio.sleep(delay)
@@ -534,6 +691,8 @@ class GcsServer:
         if row is None or row["state"] == DEAD:
             return
         spec = row["spec"]
+        launch = self._launch_begin(actor_id, spec)
+        attempt_t0 = time.time()
         req = dict(spec.get("resources") or {})
         sched = spec.get("scheduling") or {}
         pg_id = sched.get("placement_group_id")
@@ -545,6 +704,8 @@ class GcsServer:
                 row["death_cause"] = f"placement group {pg_id} not ready"
                 self._persist_actor(actor_id)
                 self._publish("ACTOR", actor_id, _actor_public(row))
+                self._launch_finish(actor_id, ok=False,
+                                    error="placement group not ready")
                 return
             idx = sched.get("placement_group_bundle_index", 0)
             if idx < 0:
@@ -558,17 +719,34 @@ class GcsServer:
                 strategy_args=sched)
         if target is None:
             # infeasible right now: retry until resources appear
+            if launch is not None:
+                launch["retries"] += 1
             asyncio.ensure_future(self._schedule_actor(actor_id, delay=0.5))
             return
         node_conn = self.node_conns.get(target)
         if node_conn is None or node_conn.closed:
+            if launch is not None:
+                launch["retries"] += 1
             asyncio.ensure_future(self._schedule_actor(actor_id, delay=0.2))
             return
+        launch_trace = None
+        if launch is not None:
+            launch["node_id"] = target
+            self._launch_span_row(
+                launch, "launch.placement", attempt_t0, time.time(),
+                launch["root_span_id"], node=target[:12],
+                strategy=sched.get("strategy", "DEFAULT"),
+                pg=bool(pg_id))
+            self._launch_phase(launch, "node_create")
+            launch_trace = {"trace_id": launch["trace_id"],
+                            "parent_span_id": launch["root_span_id"],
+                            "actor_id": actor_id}
         try:
             result = await node_conn.call("create_actor", spec=spec,
                                           pg_id=pg_id,
                                           bundle_index=sched.get(
-                                              "placement_group_bundle_index", 0))
+                                              "placement_group_bundle_index", 0),
+                                          launch_trace=launch_trace)
         except (rpc.RpcError, rpc.ConnectionLost) as e:
             logger.warning("actor %s creation on %s failed: %s",
                            actor_id[:12], target[:12], e)
@@ -585,6 +763,7 @@ class GcsServer:
         row["worker_id"] = result["worker_id"]
         self._persist_actor(actor_id)
         self._publish("ACTOR", actor_id, _actor_public(row))
+        self._launch_finish(actor_id, ok=True)
 
     async def _handle_actor_failure(self, actor_id: str, reason: str,
                                     from_scheduler: bool = False):
@@ -611,6 +790,7 @@ class GcsServer:
             row["death_cause"] = reason
             self._persist_actor(actor_id)
             self._publish("ACTOR", actor_id, _actor_public(row))
+            self._launch_finish(actor_id, ok=False, error=reason)
 
     def h_get_actor_info(self, conn, actor_id: str):
         row = self.actors.get(actor_id)
@@ -666,6 +846,7 @@ class GcsServer:
                 self.named_actors.pop((row["namespace"], row["name"]), None)
             self._persist_actor(actor_id)
             self._publish("ACTOR", actor_id, _actor_public(row))
+            self._launch_finish(actor_id, ok=False, error="killed")
         if node_conn is not None and not node_conn.closed:
             try:
                 await node_conn.call("kill_worker", worker_id=row.get("worker_id"),
@@ -1174,13 +1355,22 @@ class GcsServer:
             if sub.closed:
                 self.subscribers[channel].discard(sub)
                 continue
-            asyncio.ensure_future(self._safe_notify(sub, channel, key, payload))
+            # t0 stamped at accept: deliver latency includes event-loop
+            # queueing, which is the signal (a backed-up GCS loop shows
+            # up here before anywhere else)
+            asyncio.ensure_future(self._safe_notify(
+                sub, channel, key, payload, self.obs.note_publish()))
 
-    async def _safe_notify(self, conn, channel, key, payload):
+    async def _safe_notify(self, conn, channel, key, payload, t0=None):
         try:
             await conn.notify("pubsub", channel=channel, key=key, payload=payload)
         except Exception:
             self.subscribers.get(channel, set()).discard(conn)
+            if t0 is not None:
+                self.obs.note_deliver(t0, ok=False)
+            return
+        if t0 is not None:
+            self.obs.note_deliver(t0, ok=True)
 
     # ----------------------------------------------------- placement groups
     async def h_create_placement_group(self, conn, pg_id: str,
@@ -1316,6 +1506,21 @@ def main():
         gcs = GcsServer(port=args.port, session_name=args.session_name,
                         persist_path=args.persist_path)
         addr = await gcs.start()
+        # crash black box: continuous event/metrics mirror + seal on
+        # SIGTERM / clean exit (SIGKILL leaves the continuous appends)
+        from ray_tpu._private import blackbox as _bb
+        _bb.configure(gcs._blackbox_dir(), "gcs",
+                      worker_id="gcs")
+        import signal
+
+        def _on_term(signum, frame):
+            _bb.seal(f"signal_{signum}")
+            raise SystemExit(0)
+
+        try:
+            signal.signal(signal.SIGTERM, _on_term)
+        except (ValueError, OSError):
+            pass
         # announce the bound address on stdout for the supervisor
         print(f"GCS_ADDRESS={addr}", flush=True)
         await asyncio.Event().wait()

@@ -158,6 +158,12 @@ class NodeManager:
         self._log_files: Dict[int, list] = {}
         # compiled-DAG channel mirrors this daemon writes into
         self._dag_channels: Dict[str, object] = {}
+        # launch critical-path attribution: last-observed duration per
+        # launch phase on this node (resource_wait / worker_obtain /
+        # become_actor) -> runtime_launch_phase_ms{phase} gauges
+        self._launch_phase_ms: Dict[str, float] = {}
+        self._launches_total = 0
+        self._clock_offset_s = 0.0   # local wall clock minus GCS clock
         # thread_checker.h analog: no-op unless RAY_TPU_LOOP_SANITIZER
         from ray_tpu.util.sanitizers import SingleLoopChecker
         self._loop_checker = SingleLoopChecker("NodeManager")
@@ -229,6 +235,10 @@ class NodeManager:
         # one head-side config governs the cluster (reference:
         # GetSystemConfig handshake, node_manager.proto:432)
         cfg.apply(resp.get("system_config") or {})
+        if resp.get("gcs_ts"):
+            # local minus GCS clock (half-RTT error bound) — recorded in
+            # the black box header so cross-node stitches de-skew
+            self._clock_offset_s = time.time() - float(resp["gcs_ts"])
         await self.gcs.call("subscribe", channel="NODE")
         # spill target: node-local dir by default, any fsspec URI when
         # cfg.spill_uri is set (gs:// on real pods; memory:// in tests)
@@ -261,6 +271,17 @@ class NodeManager:
         _events.set_identity(node_id=self.node_id,
                              worker_id=f"nm-{self.node_id[:12]}")
         _events.set_sink(_ship_events)
+
+        # crash black box: continuous on-disk mirror of this daemon's
+        # event ring + metrics snapshots (sealed on the GCS-disconnect
+        # death path and on clean exit; a SIGKILL keeps the appends)
+        from ray_tpu._private import blackbox as _blackbox
+        bb = _blackbox.configure(
+            cfg.blackbox_dir or f"/tmp/raytpu/{self.session_name}/blackbox",
+            f"nm-{self.node_id[:12]}", node_id=self.node_id,
+            worker_id=f"nm-{self.node_id[:12]}")
+        if bb is not None and self._clock_offset_s:
+            bb.set_clock_offset(self._clock_offset_s)
 
         # object-lifetime ledger: same daemon-sink pattern — this
         # process's spill/restore/evict/arrival deltas ship over the
@@ -360,6 +381,11 @@ class NodeManager:
                         cfg.gcs_reconnect_timeout_s)
                     for w in list(self.workers.values()):
                         self._kill_proc(w)
+                    from ray_tpu._private import blackbox as _blackbox
+                    _blackbox.record("marker", event="gcs_disconnect",
+                                     gcs=self.gcs_address,
+                                     down_s=round(now - down_since, 1))
+                    _blackbox.seal("gcs_disconnect")
                     os._exit(1)
                 logger.warning("heartbeat failed; reconnecting to GCS")
                 last_sent = None
@@ -449,6 +475,15 @@ class NodeManager:
         tags = {"node": self.node_id[:12]}
         rows = [gauge_snapshot("node_workers", len(self.workers),
                                "live worker processes", tags)]
+        for phase, ms in self._launch_phase_ms.items():
+            rows.append(gauge_snapshot(
+                "runtime_launch_phase_ms", ms,
+                "most recent actor-launch phase duration on this node "
+                "(ms)", {**tags, "phase": phase}))
+        if self._launches_total:
+            rows.append(counter_snapshot(
+                "node_actor_launches_total", self._launches_total,
+                "actors launched on this node", tags))
         if self.store is not None:
             try:
                 st = self.store.stats()
@@ -1287,25 +1322,64 @@ class NodeManager:
         self._wake_lease_waiters()
 
     # ---------------------------------------------------------------- actors
-    async def h_create_actor(self, conn, spec: Dict, pg_id=None, bundle_index=0):
+    # ------------------------------------------------- launch attribution
+    # The node-manager slice of the actor.launch critical path: each
+    # phase records a child span under the trace ctx the GCS forwarded,
+    # updates the runtime_launch_phase_ms{phase} gauge, and reports the
+    # phase transition so `ray_tpu status` shows where an in-flight
+    # launch currently sits.
+    def _launch_enter(self, lt: Optional[Dict], phase: str) -> float:
+        if lt is not None:
+            async def _notify():
+                try:
+                    await self.gcs.notify(
+                        "launch_phase", actor_id=lt.get("actor_id"),
+                        phase=phase, node_id=self.node_id)
+                except Exception:
+                    pass
+            try:
+                asyncio.ensure_future(_notify())
+            except Exception:
+                pass
+        return time.time()
+
+    def _launch_exit(self, lt: Optional[Dict], phase: str, t0: float,
+                     **attrs) -> None:
+        end = time.time()
+        self._launch_phase_ms[phase] = round((end - t0) * 1e3, 3)
+        if lt is not None:
+            from ray_tpu._private import events as _events
+            _events.record_complete(
+                f"launch.{phase}", t0, end, category="launch",
+                trace_id=lt.get("trace_id"),
+                parent_span_id=lt.get("parent_span_id"),
+                actor_id=lt.get("actor_id"), **attrs)
+
+    async def h_create_actor(self, conn, spec: Dict, pg_id=None, bundle_index=0,
+                             launch_trace: Optional[Dict] = None):
+        lt = launch_trace if cfg.launch_trace_enabled else None
         resources = dict(spec.get("resources") or {})
         bundle = self.bundles.get((pg_id, bundle_index)) if pg_id else None
         pool_avail = bundle["available"] if bundle else self.available
         # queue for resources (leases drain within their idle timeout)
+        t_phase = self._launch_enter(lt, "resource_wait")
+        waited = False
         deadline = time.monotonic() + cfg.actor_resource_wait_s
         while not (scheduling_fits(pool_avail, resources)
                    and self._chips_fit(resources)):
-            if conn.closed:
+            if conn is not None and conn.closed:
                 raise RuntimeError("actor requester gone")
             if time.monotonic() > deadline:
                 raise RuntimeError(
                     f"insufficient resources for actor: {resources}")
+            waited = True
             fut = asyncio.get_event_loop().create_future()
             self._lease_waiters.append(fut)
             try:
                 await asyncio.wait_for(fut, timeout=0.5)
             except asyncio.TimeoutError:
                 pass
+        self._launch_exit(lt, "resource_wait", t_phase, waited=waited)
         # claim chips atomically with the float accounting (see h_lease)
         scheduling_sub(pool_avail, resources)
         chips = self._allocate_chips(resources)
@@ -1318,6 +1392,7 @@ class NodeManager:
         # still adopt (and tag) an untagged worker, a containered one
         # matches exactly or spawns inside the image
         env_hash = runtime_env_hash(spec.get("runtime_env"))
+        t_phase = self._launch_enter(lt, "worker_obtain")
         try:
             w = await self._obtain_worker(env_hash=env_hash,
                                           proc_env=proc_env)
@@ -1325,6 +1400,8 @@ class NodeManager:
             self._free_chips.extend(chips)
             scheduling_addback(pool_avail, resources)
             raise
+        self._launch_exit(lt, "worker_obtain", t_phase,
+                          worker=w.worker_id[:12])
         w.state = "actor"
         w.actor_id = spec["actor_id"]
         # register the reservation as a lease keyed off the worker so
@@ -1335,11 +1412,19 @@ class NodeManager:
                                   "bundle": bundle, "chips": chips}
         if chips:
             spec = {**spec, "accelerator_ids": {"TPU": chips}}
+        if lt is not None:
+            # the worker records launch.callable_init under this ctx
+            spec = {**spec, "_launch_trace": {
+                "trace_id": lt.get("trace_id"),
+                "parent_span_id": lt.get("parent_span_id")}}
+        t_phase = self._launch_enter(lt, "become_actor")
         try:
             await w.conn.call("become_actor", spec=spec)
         except (rpc.RpcError, rpc.ConnectionLost) as e:
             await self._on_worker_death(w, f"actor init failed: {e}")
             raise RuntimeError(f"actor __init__ failed: {e}")
+        self._launch_exit(lt, "become_actor", t_phase)
+        self._launches_total += 1
         return {"worker_address": w.address, "worker_id": w.worker_id}
 
     async def h_dump_stacks(self, conn):
@@ -2222,6 +2307,8 @@ def main():
             except (NotImplementedError, OSError):
                 pass
         await stop_evt.wait()
+        from ray_tpu._private import blackbox as _blackbox
+        _blackbox.seal("sigterm")
         await asyncio.wait_for(nm.stop(), timeout=5)
 
     try:

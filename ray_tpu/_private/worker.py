@@ -2977,8 +2977,30 @@ class CoreWorker:
                     self._spawn(self._exec_consumer(q)))
         inner = cls.__ray_tpu_actual_class__ if hasattr(
             cls, "__ray_tpu_actual_class__") else cls
+        # launch attribution: the callable-init phase (user __init__ —
+        # model build, checkpoint load) records as a child of the
+        # actor.launch trace the node manager forwarded in the spec
+        lt = spec.get("_launch_trace") or {}
+        t_init = time.time()
         instance = await self.loop.run_in_executor(
             self.executor, lambda: inner(*args, **kwargs))
+        init_ms = (time.time() - t_init) * 1e3
+        try:
+            from ray_tpu._private import events as _events
+            _events.record_complete(
+                "launch.callable_init", t_init, time.time(),
+                category="launch", trace_id=lt.get("trace_id"),
+                parent_span_id=lt.get("parent_span_id"),
+                actor_id=spec["actor_id"])
+            from ray_tpu.util.metrics import Gauge
+            if not hasattr(self, "_launch_phase_gauge"):
+                self._launch_phase_gauge = Gauge(
+                    "runtime_launch_phase_ms",
+                    "most recent actor-launch phase duration (ms)")
+            self._launch_phase_gauge.set(round(init_ms, 3),
+                                         tags={"phase": "callable_init"})
+        except Exception:
+            pass
         self.actor_instance = instance
         return {"ok": True}
 
@@ -3104,6 +3126,14 @@ class CoreWorker:
         try:
             from ray_tpu.util import metrics as _metrics
             _metrics.stop_pusher()
+        except Exception:
+            pass
+        # seal the crash black box: final metrics snapshot + seal record
+        # (atexit would also fire, but a clean stop should seal while the
+        # ring is already drained, marking this box as a graceful exit)
+        try:
+            from ray_tpu._private import blackbox as _blackbox
+            _blackbox.seal("clean_exit")
         except Exception:
             pass
         # cancel-and-await every background task (senders, dispatchers,

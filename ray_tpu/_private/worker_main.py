@@ -55,6 +55,15 @@ def main():
         loop.call_soon(sanitizers.maybe_install)
     worker_mod.global_worker = Worker(core, owns_loop=False)
 
+    # crash black box: continuous on-disk mirror of this worker's event
+    # ring + metrics snapshots; clean shutdown seals it in stop_async
+    from ray_tpu._private import blackbox
+    from ray_tpu._private.config import cfg
+    blackbox.configure(
+        cfg.blackbox_dir or f"/tmp/raytpu/{args.session_name}/blackbox",
+        f"worker-{core.worker_id[:12]}", node_id=args.node_id,
+        worker_id=core.worker_id)
+
     import ray_tpu
     ray_tpu._set_connected_from_worker(core)
 

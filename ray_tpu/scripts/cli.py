@@ -182,6 +182,42 @@ def _memory_pane(state, window: float) -> str:
     return "\n".join(lines)
 
 
+def _control_pane(state) -> str:
+    """Control-plane pane for `status --watch`: GCS RPC p99 by the
+    top-3 handlers, in-flight launches with their current phase, pubsub
+    backlog, and black boxes on disk — straight from the GCS's live
+    handler stats (control_plane_stats), not the windowed TS plane."""
+    try:
+        stats = state.control_plane_stats(top_n=3)
+    except Exception as e:
+        return f"CONTROL PLANE\n  (unavailable: {e})"
+    lines = [f"{'CONTROL PLANE':<40} "
+             f"rpc in-flight={stats.get('rpc_inflight', 0)}  "
+             f"pubsub backlog={stats.get('pubsub', {}).get('backlog', 0)}  "
+             f"black boxes={stats.get('blackboxes', 0)}"]
+    for h in stats.get("handlers") or []:
+        lines.append(
+            f"  rpc {h['handler']:<28} p50={_fmt_metric(h['p50_ms'])}ms "
+            f"p99={_fmt_metric(h['p99_ms'])}ms calls={h['calls']} "
+            f"slow={h['slow']} err={h['errors']}")
+    launches = stats.get("launches") or []
+    for ln in launches[:5]:
+        lines.append(
+            f"  launch {ln.get('actor', '?'):<24} phase={ln['phase']} "
+            f"({_fmt_metric(ln['phase_age_s'])}s) "
+            f"total={_fmt_metric(ln['age_s'])}s retries={ln['retries']}")
+    if not launches:
+        recent = stats.get("recent_launch_ms") or []
+        if recent:
+            lines.append(
+                f"  (no launches in flight; last "
+                f"{len(recent)} took {_fmt_metric(min(recent))}-"
+                f"{_fmt_metric(max(recent))}ms)")
+        else:
+            lines.append("  (no launches in flight)")
+    return "\n".join(lines)
+
+
 def cmd_status(args):
     import ray_tpu
     from ray_tpu.util import state
@@ -203,6 +239,8 @@ def cmd_status(args):
             print(f"ray_tpu status --watch  (refresh {interval:.1f}s, "
                   f"window {window:.0f}s, ctrl-c to exit)\n")
             print(json.dumps(summary, default=str))
+            print()
+            print(_control_pane(state))
             print()
             print(_memory_pane(state, window))
             print()
@@ -261,6 +299,70 @@ def cmd_timeline(args):
     out = args.output or "ray-tpu-timeline.json"
     ray_tpu.timeline(out)
     print(f"wrote {out} (open in chrome://tracing or Perfetto)")
+
+
+def cmd_blackbox(args):
+    """Stitch surviving crash black boxes into one cross-node
+    post-mortem timeline. Needs no live cluster — it reads the NDJSON
+    boxes off disk, which is the point: the GCS/node that would answer
+    RPCs is exactly what died."""
+    from ray_tpu._private import blackbox as bb
+    paths = []
+    for p in args.paths or []:
+        if os.path.isdir(p):
+            paths.extend(bb.scan_boxes(p))
+        else:
+            paths.append(p)
+    if not args.paths:
+        import glob
+        for d in sorted(glob.glob("/tmp/raytpu/*/blackbox")):
+            paths.extend(bb.scan_boxes(d))
+    if not paths:
+        print("no black boxes found (pass a session blackbox dir or "
+              "box files)", file=sys.stderr)
+        sys.exit(1)
+    merged = bb.stitch(paths, max_skew_s=args.max_skew)
+    if args.json:
+        print(json.dumps(merged, indent=2, default=str))
+        return
+    print(f"{len(merged['boxes'])} black boxes:")
+    for b in merged["boxes"]:
+        print(f"  {b['process']:<24} node={b['node_id'][:12] or '-':<12} "
+              f"records={b['records']:<6} "
+              f"offset={b['clock_offset_s']:+.3f}s  seal={b['seal_reason']}")
+    print()
+    rows = merged["records"]
+    shown = rows[-args.limit:] if args.limit and len(rows) > args.limit \
+        else rows
+    if len(shown) < len(rows):
+        print(f"(showing last {len(shown)} of {len(rows)} records)")
+    for m in shown:
+        rec = m["rec"]
+        kind = rec.get("kind", "?")
+        t = time.strftime("%H:%M:%S",
+                          time.localtime(m["adj_ts"])) + \
+            f".{int((m['adj_ts'] % 1) * 1000):03d}"
+        if kind == "event":
+            dur = ""
+            if rec.get("start") and rec.get("end"):
+                dur = f" {1e3 * (rec['end'] - rec['start']):.1f}ms"
+            detail = f"{rec.get('name')}{dur}"
+            attrs = rec.get("attrs") or {}
+            if attrs:
+                detail += " " + json.dumps(attrs, default=str)[:80]
+        elif kind == "metrics":
+            detail = f"snapshot ({len(rec.get('metrics') or [])} metrics)"
+        elif kind == "seal":
+            detail = f"SEALED: {rec.get('reason')}"
+        elif kind == "marker":
+            detail = " ".join(f"{k}={v}" for k, v in rec.items()
+                              if k not in ("kind", "ts", "seq"))
+        elif kind == "header":
+            detail = (f"pid={rec.get('pid')}"
+                      + (" (rotated)" if rec.get("rotated") else ""))
+        else:
+            detail = json.dumps(rec, default=str)[:100]
+        print(f"{t} {m['process']:<24} {kind:<8} {detail}")
 
 
 def _fmt_bytes(n) -> str:
@@ -547,6 +649,21 @@ def main(argv=None):
     pt.add_argument("--address", default=None)
     pt.add_argument("--output", "-o", default=None)
     pt.set_defaults(fn=cmd_timeline)
+
+    pbb = sub.add_parser(
+        "blackbox", help="stitch crash black boxes into one cross-node "
+        "post-mortem timeline (reads NDJSON off disk; no cluster needed)")
+    pbb.add_argument("paths", nargs="*",
+                     help="box files or session blackbox dirs; default "
+                          "scans /tmp/raytpu/*/blackbox")
+    pbb.add_argument("--json", action="store_true",
+                     help="emit the merged timeline as JSON")
+    pbb.add_argument("--limit", type=int, default=200,
+                     help="max records to print (newest kept; 0 = all)")
+    pbb.add_argument("--max-skew", type=float, default=0.0,
+                     help="clamp clock offsets larger than this many "
+                          "seconds to 0 (implausible-skew guard)")
+    pbb.set_defaults(fn=cmd_blackbox)
 
     pm = sub.add_parser(
         "memory", help="cluster object/memory observability "

@@ -604,14 +604,23 @@ class ReplicaShell:
 
     def attach(self, serialized_callable: bytes, init_args: tuple,
                init_kwargs: Dict, is_function: bool) -> bool:
-        from ray_tpu._private import rpc
+        from ray_tpu._private import events, rpc
         rpc._maybe_inject_failure("shell_attach")
+        # launch attribution: callable construction and compile warmup
+        # chain under the revival's replica.launch trace (the task ctx
+        # propagated with the attach call)
+        t0 = time.time()
         self._replica_cls._init_callable(
             self, serialized_callable, tuple(init_args), init_kwargs,
             is_function)
+        t1 = time.time()
+        events.record_complete("launch.shell_attach", t0, t1,
+                               category="launch")
         hook = getattr(self._callable, "on_shell_attach", None)
         if hook is not None:
             hook()
+            events.record_complete("launch.warmup", t1, time.time(),
+                                   category="launch")
         rpc._maybe_inject_failure("shell_attach")
         self._attached = True
         return True
@@ -627,16 +636,22 @@ class ReplicaShell:
         warmup both need every rank in flight at once. Chaos fires at
         the same two points as a plain attach; one rank failing
         discards the whole gang (partial gangs are never published)."""
-        from ray_tpu._private import rpc
+        from ray_tpu._private import events, rpc
         from ray_tpu.serve.sharded_replica import ReplicaShard
         rpc._maybe_inject_failure("shell_attach")
+        t0 = time.time()
         shard = ReplicaShard(rank, world_size)
         shard.setup_distributed(group_name)
         shard.init_callable(serialized_callable, tuple(init_args),
                             init_kwargs, is_function)
+        t1 = time.time()
+        events.record_complete("launch.shell_attach", t0, t1,
+                               category="launch", rank=rank)
         hook = getattr(shard._callable, "on_shell_attach", None)
         if hook is not None:
             hook()
+            events.record_complete("launch.warmup", t1, time.time(),
+                                   category="launch", rank=rank)
         rpc._maybe_inject_failure("shell_attach")
         self._shard = shard
         self._attached = True
@@ -830,10 +845,15 @@ class FleetManager:
                 # try every pooled shell once, then one fresh cold
                 # build — the chaos suite kills shells mid-attach and
                 # the held requests must still land exactly once
+                from ray_tpu._private import events
                 for attempt in range(max(1, self.pool.size)):
+                    t_co = time.time()
                     shell = self.pool.checkout()
                     if shell is None:
                         break
+                    events.record_complete(
+                        "launch.shell_checkout", t_co, time.time(),
+                        category="launch", app=app, deployment=name)
                     try:
                         ray_tpu.get(shell.attach.remote(
                             spec["callable"], tuple(spec["init_args"]),

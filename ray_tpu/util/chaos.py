@@ -386,6 +386,54 @@ class StageKiller:
             pass
 
 
+class GcsRpcDelayer:
+    """Injects latency into ONE named GCS handler: the observability
+    wrapper (``_private/gcs_obs.py``) checks the spec before dispatching
+    each ``h_*`` RPC and sleeps the armed handler on the event loop
+    (``asyncio.sleep`` — other handlers keep flowing, exactly the shape
+    of one slow table scan wedging a single RPC family). Used to drive
+    the slow-handler span path (``gcs.rpc`` runtime events over
+    ``gcs_slow_rpc_ms``) and the p99 histogram tail deterministically.
+
+    Spec: ``RAY_TPU_TESTING_GCS_RPC_DELAY="gcs_rpc=handler:ms"`` where
+    ``handler`` is the RPC method name without the ``h_`` prefix (e.g.
+    ``gcs_rpc=kv_get:75``); comma-compose entries to delay several
+    handlers. The env must reach the GCS process before its first RPC
+    (the spec is parsed once and cached); ``arm_local`` /
+    ``disarm_local`` reset the cache for in-process GcsServer tests."""
+
+    SPEC_ENV = "RAY_TPU_TESTING_GCS_RPC_DELAY"
+
+    def __init__(self, handler: str, delay_ms: float):
+        if delay_ms < 0:
+            raise ValueError("delay_ms must be >= 0")
+        self.handler = handler
+        self.delay_ms = delay_ms
+
+    def spec(self) -> str:
+        return f"gcs_rpc={self.handler}:{self.delay_ms}"
+
+    def env(self, base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+        e = dict(base if base is not None else os.environ)
+        prior = e.get(self.SPEC_ENV)
+        e[self.SPEC_ENV] = f"{prior},{self.spec()}" if prior else self.spec()
+        return e
+
+    def arm_local(self):
+        """Arm the CURRENT process (in-process GcsServer tests): sets
+        the env var and resets gcs_obs's parsed-spec cache so the next
+        dispatch re-reads it. Pair with :meth:`disarm_local`."""
+        from ray_tpu._private import gcs_obs
+        os.environ[self.SPEC_ENV] = self.spec()
+        gcs_obs._DELAY_SPEC = None
+
+    @staticmethod
+    def disarm_local():
+        from ray_tpu._private import gcs_obs
+        os.environ.pop(GcsRpcDelayer.SPEC_ENV, None)
+        gcs_obs._DELAY_SPEC = None
+
+
 class ServeReplicaKiller:
     """Kill serve replica actors mid-request (streaming included) and
     let the controller's reconcile loop replace them — the serving

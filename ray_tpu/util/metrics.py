@@ -21,6 +21,16 @@ _registry_lock = threading.Lock()
 _pusher_started = False
 _pusher_stop = threading.Event()
 _push_failures = 0
+# The snapshot of the most recent FAILED push, kept as (capture_ts,
+# payload). Counters are cumulative so a dropped push loses nothing
+# locally — but a GCS restart wipes the time-series delta baselines,
+# and the first post-restart push would then land the entire cumulative
+# history as one giant delta in the current window. Replaying the
+# buffered pre-outage snapshot (at its original capture time) first
+# re-establishes the baseline, so the current push's delta collapses to
+# just the activity since the failure. metrics_ts reset-detection
+# tolerates the replay even when the "failed" push actually landed.
+_failed_push: Optional[Tuple[float, List[Dict]]] = None
 
 
 def _ensure_pusher():
@@ -77,8 +87,12 @@ def _push_interval() -> float:
 def push_once() -> bool:
     """One registry push through the connected worker. Returns True on
     success; the FIRST failure per process logs (at most one line — a
-    dead GCS must not spam), later ones stay silent."""
-    global _push_failures
+    dead GCS must not spam), later ones stay silent. A failed push
+    buffers its snapshot and re-merges it (original capture time) ahead
+    of the next successful push — see _failed_push."""
+    global _push_failures, _failed_push
+    payload: Optional[List[Dict]] = None
+    capture_ts = time.time()
     try:
         import ray_tpu
         if not ray_tpu.is_initialized():
@@ -86,19 +100,28 @@ def push_once() -> bool:
         payload = registry_snapshot()
         if not payload:
             return True
-        core = ray_tpu._get_worker().core
-        ray_tpu._get_worker().gcs_call(
-            "report_metrics",
-            worker_id=core.worker_id,
-            node_id=getattr(core, "node_id", None),
-            metrics=payload)
+        w = ray_tpu._get_worker()
+        core = w.core
+        node_id = getattr(core, "node_id", None)
+        if _failed_push is not None:
+            buf_ts, buf_payload = _failed_push
+            w.gcs_call("report_metrics", worker_id=core.worker_id,
+                       node_id=node_id, metrics=buf_payload, ts=buf_ts)
+            _failed_push = None
+        w.gcs_call("report_metrics", worker_id=core.worker_id,
+                   node_id=node_id, metrics=payload)
         _push_failures = 0
         return True
     except Exception as e:
+        if payload is not None:
+            # keep only the newest failed snapshot: it is cumulative, so
+            # it subsumes every earlier one (bounded buffer by design)
+            _failed_push = (capture_ts, payload)
         if _push_failures == 0:
             logger.warning(
-                "metrics push to GCS failed (%s: %s); further failures "
-                "suppressed until one succeeds", type(e).__name__, e)
+                "metrics push to GCS failed (%s: %s); snapshot buffered "
+                "for replay, further failures suppressed until one "
+                "succeeds", type(e).__name__, e)
         _push_failures += 1
         return False
 

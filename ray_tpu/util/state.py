@@ -89,6 +89,14 @@ def list_metric_series() -> List[Dict]:
     return _w().gcs_call("list_metric_series")
 
 
+def control_plane_stats(top_n: int = 3) -> Dict:
+    """GCS control-plane health: per-handler RPC latency quantiles
+    (top_n slowest by p99), global in-flight RPCs, pubsub backlog /
+    delivery counters, in-flight actor launches with their current
+    phase, and the count of crash black boxes on this session's disk."""
+    return _w().gcs_call("control_plane_stats", top_n=top_n)
+
+
 def list_named_actors(namespace: Optional[str] = None) -> List[Dict]:
     return _w().gcs_call("list_named_actors", namespace=namespace)
 
