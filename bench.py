@@ -656,6 +656,37 @@ def bench_multi_model_churn():
     return {"skipped": True, "reason": last}
 
 
+def bench_serve_million_sessions():
+    """Million-user front door (reports/edge_probe.py): O(100k)
+    zipf-tenant sessions through >= 2 real proxy admission edges
+    sharing ONE cluster quota policy via GCS-leased token buckets.
+    Headline is the admission-edge p99 TTFT; the same entry carries the
+    fairness check (hot zipf tenant's admitted share <= its weight
+    share + 10%), the escrow proof (zero over-admission while a lease
+    is revoked mid-run — the victim degrades to conservative_frac and
+    GCS keeps its share in the denominator), the decode->decode KV
+    fabric segment (cluster_prefix_hit_rate must beat the local-only
+    baseline with greedy bit-identical output and decode compile-once),
+    and the batched hot-prefix export segment (8 concurrent
+    same-fingerprint misses -> exactly 1 export, relay hops <=
+    log2(K)+1 per the binomial plan). Fully hermetic — real
+    TenantAdmission/QuotaLeaseClient/GcsServer handler code on a
+    virtual clock, no cluster processes."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    runner = os.path.join(here, "reports", "edge_probe.py")
+    spec = {"n_sessions": 100_000, "proxies": 2, "seed": 0}
+    last = "unknown"
+    for attempt in range(2):
+        if attempt:
+            time.sleep(10)
+        result, last = _run_probe(runner, spec, timeout=1200)
+        if result is not None:
+            return result
+        log(f"edge probe failed: {last}")
+    return {"skipped": True, "reason": last}
+
+
 def bench_transfer_gb_per_s():
     """Cross-node object-transfer bandwidth (reports/transfer_probe.py):
     a 256 MB object pushed between two single-box node managers over
@@ -1412,6 +1443,78 @@ def main():
         log(f"multi-model churn probe FAILED: {e}")
         results["multi_model_churn"] = {"skipped": True,
                                         "reason": str(e)[:200]}
+
+    try:
+        edge = bench_serve_million_sessions()
+        if not edge.get("skipped"):
+            det = edge.get("edge") or {}
+            fab = edge.get("fabric") or {}
+            bat = edge.get("batched_export") or {}
+            results["serve_million_sessions"] = {
+                "value": edge.get("p99_ttft_ms"),
+                "unit": "admission_p99_ttft_ms",
+                "sessions": edge.get("sessions"),
+                "proxies": edge.get("proxies"),
+                "sessions_per_s_wall": det.get("sessions_per_s_wall"),
+                "p50_ttft_ms": det.get("p50_ttft_ms"),
+                "hot_tenant_share": det.get("hot_tenant_share"),
+                "hot_tenant_weight_share":
+                    det.get("hot_tenant_weight_share"),
+                "fairness_ok": edge.get("fairness_ok"),
+                "over_admission_total": edge.get("over_admission_total"),
+                "degraded_after_sessions":
+                    det.get("degraded_after_sessions"),
+                "restored_after_sessions":
+                    det.get("restored_after_sessions"),
+                "per_proxy": det.get("per_proxy"),
+                "cluster_prefix_hit_rate":
+                    fab.get("cluster_prefix_hit_rate"),
+                "cluster_prefix_hit_rate_baseline":
+                    fab.get("cluster_prefix_hit_rate_baseline"),
+                "hit_rate_improved": fab.get("hit_rate_improved"),
+                "kv_imports": fab.get("kv_imports"),
+                "bit_identical": fab.get("bit_identical"),
+                "decode_compile_count": fab.get("decode_compile_count"),
+                "export_runs": bat.get("export_runs"),
+                "coalesced": bat.get("coalesced"),
+                "relay_hops_planned": bat.get("relay_hops_planned"),
+                "relay_within_bound": bat.get("relay_within_bound")}
+            gate_failed = (not edge.get("fairness_ok")
+                           or edge.get("over_admission_total")
+                           or fab.get("hit_rate_improved") is False
+                           or fab.get("bit_identical") is False
+                           or (bat.get("export_runs") or 0) > 1
+                           or bat.get("relay_within_bound") is False)
+            if gate_failed:
+                # the edge gate: one fair-share policy across proxies,
+                # escrowed shares under revocation, a fabric that beats
+                # local-only hit rate WITHOUT changing greedy output,
+                # and coalesced single-flight export — any miss is a
+                # regression, flagged loudly
+                results["serve_million_sessions"][
+                    "edge_gate_failed"] = True
+                log(f"serve_million_sessions GATE FAILED: fairness="
+                    f"{edge.get('fairness_ok')}, over_admission="
+                    f"{edge.get('over_admission_total')}, fabric="
+                    f"{fab.get('hit_rate_improved')}/"
+                    f"{fab.get('bit_identical')}, exports="
+                    f"{bat.get('export_runs')}")
+            log(f"serve_million_sessions: p99 "
+                f"{edge.get('p99_ttft_ms')}ms over "
+                f"{edge.get('sessions')} sessions x "
+                f"{edge.get('proxies')} proxies (hot share "
+                f"{det.get('hot_tenant_share')}, over-admission "
+                f"{edge.get('over_admission_total')}, fabric hit "
+                f"{fab.get('cluster_prefix_hit_rate')} vs "
+                f"{fab.get('cluster_prefix_hit_rate_baseline')}, "
+                f"exports {bat.get('export_runs')})")
+        else:
+            results["serve_million_sessions"] = edge
+            log(f"edge probe skipped: {edge.get('reason')}")
+    except Exception as e:
+        log(f"edge probe FAILED: {e}")
+        results["serve_million_sessions"] = {"skipped": True,
+                                             "reason": str(e)[:200]}
 
     try:
         rec = bench_observability_overhead()

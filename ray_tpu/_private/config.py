@@ -335,7 +335,37 @@ _flag("tenant_queue_max", int, 64,
       "Per-tenant ingress wait-queue bound; requests past it are shed "
       "with 429 + Retry-After instead of collapsing the queue.")
 _flag("tenant_retry_after_s", float, 1.0,
-      "Retry-After hint attached to tenant-quota 429 responses.")
+      "Fallback Retry-After hint attached to tenant-quota 429 responses "
+      "when no token bucket exists for the tenant (bucketed tenants "
+      "derive the hint from their actual refill deficit instead, so "
+      "retries spread out rather than herding into synchronized waves).")
+# Cluster-edge shared tenant quotas (serve/fleet.py QuotaLeaseClient;
+# GCS quota_leases table)
+_flag("quota_lease_interval_s", float, 2.0,
+      "Cadence at which each ingress proxy renews its tenant-quota "
+      "lease against the GCS (pushing local burn deltas and picking up "
+      "epoch changes) — the metrics cadence of the shared fair-share "
+      "plane.")
+_flag("quota_lease_ttl_s", float, 10.0,
+      "A proxy lease older than this is expired by the GCS (its rate "
+      "share re-splits to the survivors) and a proxy that cannot renew "
+      "for this long degrades itself to the conservative local quota.")
+_flag("quota_lease_conservative_frac", float, 0.25,
+      "Fraction of its last known per-tenant rate share a proxy keeps "
+      "admitting at while its lease is revoked or unrenewable. The GCS "
+      "escrows a revoked proxy's share (it is NOT re-split until the "
+      "lease expires or re-acquires), so conservative admission below "
+      "the escrowed share can never over-admit cluster-wide.")
+# Cluster-wide KV fabric (serve/disagg.py decode->decode hand-off)
+_flag("kv_fabric_enabled", bool, True,
+      "Let a decode replica pull prefix KV blocks from ANY peer replica "
+      "whose published trie summary covers the prompt (decode->decode "
+      "hand-off over the data plane) before falling back to the prefill "
+      "tier and then to local prefill. Off = prefill-tier funnel only.")
+_flag("kv_fabric_relay_min", int, 2,
+      "Minimum number of concurrent same-fingerprint export waiters on "
+      "distinct nodes before the exporter relays the payload through "
+      "the broadcast tree instead of serving point-to-point pulls.")
 # Multi-model fleet plane: weight source for shell attach / revival
 _flag("fleet_weights_from_arena", bool, True,
       "Deployments whose weights come from a params_fn resolve them "

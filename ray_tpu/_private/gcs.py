@@ -96,6 +96,16 @@ class GcsServer:
         # concurrency quota + DRR weight rows; the "__default__" tenant
         # row moves the fleet-wide defaults. Proxies refresh ~5s.
         self.tenant_quotas: Dict[str, Dict] = {}
+        # cluster-edge shared fair share (serve/fleet.py
+        # QuotaLeaseClient): one lease row per ingress proxy. The epoch
+        # bumps on every membership change (join/leave/expire/revoke) so
+        # a proxy can tell its rate shares are stale from the renew
+        # response alone; burn deltas pushed on the renew cadence feed
+        # the per-tenant cluster burn totals. Leases are ephemeral —
+        # never snapshotted; proxies re-acquire after a GCS restart.
+        self.quota_leases: Dict[str, Dict] = {}
+        self.quota_lease_epoch = 1
+        self.tenant_burn: Dict[str, int] = {}
         # time-series plane over report_metrics pushes (metrics_ts.py):
         # bounded per-series rings answering windowed queries (rate /
         # percentiles) that the latest-snapshot table cannot
@@ -163,6 +173,11 @@ class GcsServer:
             "get_prefix_summaries": self.h_get_prefix_summaries,
             "set_tenant_quota": self.h_set_tenant_quota,
             "get_tenant_quotas": self.h_get_tenant_quotas,
+            "quota_lease_acquire": self.h_quota_lease_acquire,
+            "quota_lease_renew": self.h_quota_lease_renew,
+            "quota_lease_release": self.h_quota_lease_release,
+            "quota_lease_revoke": self.h_quota_lease_revoke,
+            "quota_lease_status": self.h_quota_lease_status,
             "launch_phase": self.h_launch_phase,
             "control_plane_stats": self.h_control_plane_stats,
             "ping": lambda conn: "pong",
@@ -1224,13 +1239,20 @@ class GcsServer:
     # ------------------------------------------------- tenant quotas
     def h_set_tenant_quota(self, conn, tenant: str,
                            quota: Optional[int] = None,
-                           weight: Optional[float] = None):
+                           weight: Optional[float] = None,
+                           rate: Optional[float] = None,
+                           burst: Optional[float] = None):
         """One tenant's fair-share admission row (serve/fleet.py):
         `quota` caps concurrent in-flight requests at the serve ingress
         (<= 0 = unlimited), `weight` sets the tenant's DRR share while
-        queued. Partial updates merge; the "__default__" tenant moves
-        the fleet-wide defaults. Bounded at 4096 tenants (stalest rows
-        retire — same discipline as prefix_summaries)."""
+        queued, `rate` is the tenant's CLUSTER-WIDE admission rate
+        (requests/s, <= 0 = unlimited) that the quota-lease layer splits
+        across proxies, and `burst` the token-bucket depth backing that
+        rate. Partial updates merge; the "__default__" tenant moves the
+        fleet-wide defaults. Bounded at 4096 tenants (stalest rows
+        retire — same discipline as prefix_summaries). A rate change
+        bumps the lease epoch so every proxy re-splits within one renew
+        interval."""
         if not tenant:
             return False
         row = self.tenant_quotas.setdefault(tenant, {"tenant": tenant})
@@ -1238,6 +1260,12 @@ class GcsServer:
             row["quota"] = int(quota)
         if weight is not None:
             row["weight"] = float(weight)
+        if rate is not None:
+            row["rate"] = float(rate)
+            self.quota_lease_epoch += 1
+        if burst is not None:
+            row["burst"] = float(burst)
+            self.quota_lease_epoch += 1
         row["ts"] = time.time()
         if len(self.tenant_quotas) > 4096:
             for t in sorted(self.tenant_quotas,
@@ -1247,6 +1275,104 @@ class GcsServer:
 
     def h_get_tenant_quotas(self, conn):
         return list(self.tenant_quotas.values())
+
+    # ------------------------------------------------- quota leases
+    # Shared tenant fair share across N ingress proxies (ROADMAP item
+    # 2a): the GCS owns each tenant's cluster-wide token-bucket RATE
+    # (tenant_quotas rows) and leases every proxy a share of it. The
+    # epoch bumps on any membership or rate change, so a renew response
+    # carrying a newer epoch tells the proxy to adopt the re-split
+    # shares atomically. A REVOKED proxy's share is escrowed — held out
+    # of the live split until the lease expires or re-acquires — so the
+    # revoked proxy's conservative local admission (a fraction of its
+    # old share, serve/fleet.py) can never combine with the survivors'
+    # shares into cluster-wide over-admission.
+    def _prune_quota_leases(self):
+        now = time.time()
+        ttl = cfg.quota_lease_ttl_s
+        dead = [p for p, row in self.quota_leases.items()
+                if now - row["ts"] > ttl]
+        for p in dead:
+            self.quota_leases.pop(p, None)
+        if dead:
+            self.quota_lease_epoch += 1
+
+    def _quota_shares(self, proxy_id: str) -> Dict[str, Dict]:
+        """This proxy's per-tenant bucket parameters under the current
+        split: every live (non-revoked, non-expired) proxy gets an equal
+        proportional share of each rated tenant's cluster rate; escrowed
+        (revoked) proxies still count in the denominator."""
+        n = max(1, len(self.quota_leases))
+        shares = {}
+        for t, row in self.tenant_quotas.items():
+            rate = float(row.get("rate") or 0.0)
+            if rate <= 0:
+                continue
+            burst = float(row.get("burst") or max(1.0, rate))
+            shares[t] = {"rate": rate / n, "burst": max(1.0, burst / n),
+                         "cluster_rate": rate}
+        return shares
+
+    def h_quota_lease_acquire(self, conn, proxy_id: str):
+        """Join (or re-join after revocation) the proxy membership.
+        Bumps the epoch — every other proxy picks up its smaller share
+        at its next renew — and returns this proxy's split."""
+        if not proxy_id:
+            return None
+        self._prune_quota_leases()
+        row = self.quota_leases.get(proxy_id)
+        if row is None or row.get("revoked"):
+            self.quota_lease_epoch += 1
+        self.quota_leases[proxy_id] = {
+            "proxy_id": proxy_id, "ts": time.time(), "revoked": False}
+        return {"epoch": self.quota_lease_epoch,
+                "n_proxies": len(self.quota_leases),
+                "shares": self._quota_shares(proxy_id),
+                "quotas": list(self.tenant_quotas.values())}
+
+    def h_quota_lease_renew(self, conn, proxy_id: str, epoch: int,
+                            burn: Optional[Dict[str, int]] = None):
+        """Heartbeat + burn-delta push on the metrics cadence. Burn
+        deltas aggregate into per-tenant cluster totals (the edge bench
+        and per-tenant SLO read them); a stale epoch gets the fresh
+        split back; a revoked/unknown lease gets {revoked: True} so the
+        proxy degrades to its conservative local quota and re-acquires."""
+        self._prune_quota_leases()
+        for t, n in (burn or {}).items():
+            self.tenant_burn[t] = self.tenant_burn.get(t, 0) + int(n)
+        row = self.quota_leases.get(proxy_id)
+        if row is None or row.get("revoked"):
+            return {"revoked": True, "epoch": self.quota_lease_epoch}
+        row["ts"] = time.time()
+        out = {"revoked": False, "epoch": self.quota_lease_epoch}
+        if int(epoch) != self.quota_lease_epoch:
+            out["shares"] = self._quota_shares(proxy_id)
+            out["quotas"] = list(self.tenant_quotas.values())
+        return out
+
+    def h_quota_lease_release(self, conn, proxy_id: str):
+        if self.quota_leases.pop(proxy_id, None) is not None:
+            self.quota_lease_epoch += 1
+        return True
+
+    def h_quota_lease_revoke(self, conn, proxy_id: str):
+        """Chaos/test hook (util/chaos.py QuotaLeaseRevoker): mark the
+        lease revoked WITHOUT re-splitting its share — the share stays
+        escrowed (the revoked proxy still counts in the split
+        denominator) until the lease TTLs out or re-acquires, which is
+        what makes conservative local admission provably safe."""
+        row = self.quota_leases.get(proxy_id)
+        if row is None:
+            return False
+        row["revoked"] = True
+        self.quota_lease_epoch += 1
+        return True
+
+    def h_quota_lease_status(self, conn):
+        self._prune_quota_leases()
+        return {"epoch": self.quota_lease_epoch,
+                "leases": [dict(r) for r in self.quota_leases.values()],
+                "tenant_burn": dict(self.tenant_burn)}
 
     # --------------------------------------------------------------- pubsub
     def h_report_metrics(self, conn, worker_id: str, metrics: list,

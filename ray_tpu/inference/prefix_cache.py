@@ -196,6 +196,29 @@ class RadixPrefixCache:
                 n.stamp = stamp
         return out
 
+    def covered_fp(self, tokens: Sequence[int], n_chunks: int
+                   ) -> Optional[int]:
+        """The path fingerprint of the DEEPEST trie node actually
+        covering the first ``n_chunks`` chunks of ``tokens`` (None when
+        even the first chunk is absent). The KV-fabric export path uses
+        this to verify a peer's requested fingerprint against live trie
+        state: a GCS summary is a push-cadence snapshot, so it can name
+        blocks this replica has since evicted — the exporter must prove
+        the fingerprint before shipping spans, or the importer would
+        install KV for the wrong tokens. Stat-free and unpinned (the
+        subsequent ``walk`` pins)."""
+        C = self.chunk_size
+        node = self._root
+        fp = None
+        for c in range(max(0, int(n_chunks))):
+            child = node.children.get(
+                tuple(int(t) for t in tokens[c * C:(c + 1) * C]))
+            if child is None:
+                break
+            fp = child.fp
+            node = child
+        return fp
+
     def summary(self, top_k: int = 128) -> Dict[str, Any]:
         """Compact trie summary for cluster-wide prefix routing: the
         ``top_k`` most-recently-touched nodes' path fingerprints (plus

@@ -217,21 +217,36 @@ def fleet_status() -> Dict:
 
 # ------------------------------------------------------------- tenancy
 def set_tenant_quota(tenant: str, max_concurrent: Optional[int] = None,
-                     weight: Optional[float] = None):
+                     weight: Optional[float] = None,
+                     rate: Optional[float] = None,
+                     burst: Optional[float] = None):
     """Configure one tenant's fair-share admission at the serve ingress
     (serve/fleet.py TenantAdmission; GCS ``tenant_quotas`` table):
     ``max_concurrent`` caps the tenant's in-flight requests (<= 0 =
     unlimited), ``weight`` sets its deficit-round-robin share while
-    queued. The special tenant ``"__default__"`` moves the fleet-wide
-    defaults. Proxies refresh quotas within ~5s."""
+    queued, ``rate`` sets the tenant's CLUSTER-WIDE admission rate in
+    requests/s (<= 0 = unlimited) which the quota-lease layer splits
+    proportionally across live proxies, and ``burst`` the token-bucket
+    depth backing that rate (defaults to ~rate). The special tenant
+    ``"__default__"`` moves the fleet-wide defaults. Proxies refresh
+    quotas within ~5s; rate changes bump the lease epoch so every proxy
+    re-splits within one renew interval (~2s)."""
     return ray_tpu._get_worker().gcs_call(
         "set_tenant_quota", tenant=tenant, quota=max_concurrent,
-        weight=weight)
+        weight=weight, rate=rate, burst=burst)
 
 
 def get_tenant_quotas() -> List[Dict]:
-    """Configured tenant rows: [{tenant, quota, weight, ts}]."""
+    """Configured tenant rows: [{tenant, quota, weight, rate, burst,
+    ts}]."""
     return ray_tpu._get_worker().gcs_call("get_tenant_quotas")
+
+
+def quota_lease_status() -> Dict:
+    """The GCS quota-lease view: {epoch, leases: [...], tenant_burn:
+    {tenant: cluster-total admitted}} — the edge probe reads cluster
+    burn totals from here."""
+    return ray_tpu._get_worker().gcs_call("quota_lease_status")
 
 
 def delete(name: str = "default"):

@@ -912,7 +912,22 @@ class ServeController:
                 tags=tags, threshold=threshold)
 
         try:
-            rows = self._slo_tracker.update(app_name, name, slo, query)
+            # per-tenant burn (ROADMAP 2d): every configured tenant gets
+            # its own burn rows appended, so one tenant torching its
+            # budget raises this deployment's target via BurnRateScaler
+            # (and thus get_replica_demand) even while the aggregate
+            # objective looks healthy
+            tenants: List[str] = []
+            try:
+                tenants = [r["tenant"] for r in
+                           (ray_tpu._get_worker()
+                            .gcs_call("get_tenant_quotas") or [])
+                           if r.get("tenant")
+                           and r["tenant"] != "__default__"]
+            except Exception:
+                pass
+            rows = self._slo_tracker.update(app_name, name, slo, query,
+                                            tenants=tenants or None)
             with self._lock:
                 dep["slo_status"] = rows
             return rows

@@ -40,13 +40,29 @@ cluster cache:
   a prefix warmed on replica A serves sessions that have never touched
   A.
 
+- **Decode→decode KV fabric** (ROADMAP item 2b): any decode replica
+  whose published summary covers the prompt can serve the pinned-arena
+  payload DIRECTLY to a peer via :meth:`DisaggLLMDeployment.peer_export`
+  — same wire framing, same data plane, no prefill-tier funnel. The
+  exporter proves the requested fingerprint against its LIVE trie
+  (``RadixPrefixCache.covered_fp``) before shipping, so a stale summary
+  (blocks evicted since the last publish cadence) is refused instead of
+  installing KV for the wrong tokens. K concurrent exports of one hot
+  fingerprint coalesce in :class:`_ExportSingleFlight` — one
+  ``export_kv_blocks`` run — and when the waiters span enough distinct
+  nodes the payload relays through the PR 11 broadcast tree
+  (``ray_tpu.broadcast_weights``, binomial fan-out) instead of K
+  point-to-point pulls (item 2c).
+
 Fallback ladder (every rung preserves exactly-once token delivery —
 nothing has streamed yet when a rung fails):
 
   1. cluster longest-prefix route  (router; stale summary -> rung 2)
   2. local radix hit               (no hand-off needed)
-  3. KV hand-off from the prefill tier (replica death / timeout -> 4)
-  4. local chunked prefill         (the PR 3 path, always available)
+  3. decode→decode peer hand-off   (KV fabric; dead peer / stale
+                                    fingerprint / empty export -> 4)
+  4. KV hand-off from the prefill tier (replica death / timeout -> 5)
+  5. local chunked prefill         (the PR 3 path, always available)
 """
 
 from __future__ import annotations
@@ -195,7 +211,92 @@ class PrefixSummaryPublisher:
         self._stop.set()
 
 
-# ----------------------------------------------------------- prefill tier
+# ------------------------------------------------------ peer-hint channel
+# The router (serve/handle.py) may know which OTHER replica covers the
+# prompt deepest (its push-updated summary cache) at the moment it
+# routes somewhere else — session affinity or load broke the tie. It
+# threads that knowledge through as a __serve_peer_hint kwarg; the
+# replica pops it into this thread-local and the decode tier's fabric
+# rung tries the hinted peer first, saving a GCS summary query on the
+# hot path. Purely advisory: a wrong/stale hint just falls through to
+# the summary-derived candidates.
+_peer_hint = threading.local()
+
+
+def set_peer_hint(hint: Optional[Dict]):
+    _peer_hint.value = hint
+
+
+def _pop_peer_hint() -> Optional[Dict]:
+    hint = getattr(_peer_hint, "value", None)
+    _peer_hint.value = None
+    return hint
+
+
+# ------------------------------------------------- batched hot-prefix export
+class _ExportSingleFlight:
+    """Exporter-side coalescing for hot prefixes (ROADMAP item 2c): K
+    concurrent ``peer_export`` calls for ONE fingerprint run one
+    ``export_kv_blocks`` + one ``pack_kv_spans``; followers park on the
+    leader's event and share its payload. The leader also sees every
+    waiter's node id, so when the audience spans >=
+    ``cfg.kv_fabric_relay_min`` distinct nodes it relays the
+    pinned-arena payload through the broadcast tree (binomial fan-out,
+    <= log2(K)+1 hops, ``store.broadcast`` events) instead of letting K
+    importers pull point-to-point."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flights: Dict[int, Dict] = {}
+        self.exports = 0     # leader runs (the "exactly 1" assertion)
+        self.coalesced = 0   # follower calls served from a leader's run
+        self.relays = 0      # broadcast-tree relays triggered
+
+    def run(self, key: int, fn, node_id: Optional[str] = None,
+            timeout_s: float = 10.0, relay=None) -> Dict:
+        with self._lock:
+            fl = self._flights.get(key)
+            leader = fl is None
+            if leader:
+                fl = {"ev": threading.Event(), "out": None, "err": None,
+                      "nodes": set([node_id] if node_id else [])}
+                self._flights[key] = fl
+            else:
+                if node_id:
+                    fl["nodes"].add(node_id)
+                self.coalesced += 1
+        if not leader:
+            if not fl["ev"].wait(timeout_s):
+                raise TimeoutError("peer export single-flight timed out")
+            if fl["err"] is not None:
+                raise fl["err"]
+            return fl["out"]
+        try:
+            out = fn()
+            self.exports += 1
+        except Exception as e:
+            with self._lock:
+                self._flights.pop(key, None)
+            fl["err"] = e
+            fl["ev"].set()
+            raise
+        # snapshot the audience and retire the flight BEFORE releasing
+        # waiters: late arrivals start a fresh flight (the trie is warm,
+        # their export is cheap) instead of racing this one's cleanup
+        with self._lock:
+            self._flights.pop(key, None)
+            nodes = set(fl["nodes"])
+        if relay is not None:
+            try:
+                if relay(out, nodes):
+                    self.relays += 1
+            except Exception:
+                # the relay is an optimization: waiters can still pull
+                # the ref point-to-point over the data plane
+                logger.debug("hot-prefix relay failed", exc_info=True)
+        fl["out"] = out
+        fl["ev"].set()
+        return out
 class PrefillLLMDeployment(LLMDeployment):
     """Prefill-tier replica: fills KV blocks, never decodes for clients.
 
@@ -281,11 +382,24 @@ class DisaggLLMDeployment(LLMDeployment):
 
     def __init__(self, model="llama-debug", *, prefill=None,
                  handoff_timeout_s: float = 10.0,
-                 prefix_cache_slots: int = 4, **kw):
+                 prefix_cache_slots: int = 4,
+                 peers: Optional[Dict[str, Any]] = None,
+                 summaries_fn=None, kv_fabric: Optional[bool] = None,
+                 **kw):
         super().__init__(model, prefix_cache_slots=prefix_cache_slots,
                          **kw)
         self._prefill = prefill
         self._handoff_timeout_s = float(handoff_timeout_s)
+        # KV fabric (ROADMAP 2b): `peers` maps replica_id -> direct
+        # object and `summaries_fn` replaces the GCS summary query —
+        # both injectable so the fallback-ladder tests and the fabric
+        # bench segment run hermetically, mirroring _call_prefill's
+        # direct-object support. In a cluster both default to the GCS.
+        self._peers = peers or {}
+        self._summaries_fn = summaries_fn
+        self._kv_fabric = (cfg.kv_fabric_enabled if kv_fabric is None
+                           else bool(kv_fabric))
+        self._singleflight = _ExportSingleFlight()
         self._publisher = PrefixSummaryPublisher(
             self.engine, type(self).__name__).start()
         from ray_tpu.util.metrics import Counter
@@ -300,6 +414,236 @@ class DisaggLLMDeployment(LLMDeployment):
             "serve_kv_handoff_bytes_total",
             "KV hand-off payload bytes pulled over the data plane "
             "(int8 framing roughly halves this vs fp16)")
+        self._m_fabric = Counter(
+            "serve_kv_fabric_total",
+            "decode->decode KV fabric events by kind (peer_ok, "
+            "peer_fallback, export, stale_fp, quant_mismatch, "
+            "coalesced, relayed)",
+            tag_keys=("kind",))
+
+    # ------------------------------------------------- fabric: exporter
+    def peer_export(self, prompt_tokens, max_chunks: Optional[int] = None,
+                    want_fp: Optional[int] = None,
+                    node_id: Optional[str] = None) -> Dict:
+        """Serve this replica's pinned trie blocks to a PEER decode
+        replica — the decode→decode half of the cluster KV fabric. Same
+        contract as ``prefill_export`` (``{covered, chunk, ref|payload}``,
+        int8-or-fp framing decided by this engine's kv_quant) with two
+        deliberate differences: it NEVER prefills a cold prefix (a peer
+        asking for tokens we don't hold should fall to its own ladder,
+        not push work here), and ``want_fp`` must prove against the LIVE
+        trie — a GCS summary is a push-cadence snapshot, so it can name
+        blocks evicted since publication; shipping them would install KV
+        for the wrong tokens on the importer. Concurrent exports of one
+        fingerprint coalesce (single-flight + broadcast-tree relay)."""
+        rpc._maybe_inject_failure("peer_export")
+        toks = [int(t) for t in prompt_tokens]
+        eng = self.engine
+        C = eng.config.prefill_chunk
+        cap = (max(0, len(toks) - 1) // C if max_chunks is None
+               else max(0, int(max_chunks)))
+        cache = eng.prefix_cache
+        if cache is None or cap == 0:
+            raise LookupError("nothing to export")
+        live_fp = cache.covered_fp(toks, cap)
+        if live_fp is None:
+            self._m_fabric.inc(tags={"kind": "stale_fp"})
+            raise LookupError("prefix not cached here (stale summary?)")
+        if want_fp is not None and int(live_fp) != int(want_fp):
+            self._m_fabric.inc(tags={"kind": "stale_fp"})
+            raise LookupError(
+                f"stale fingerprint: caller wants {want_fp:#x}, live "
+                f"trie covers {live_fp:#x} — blocks evicted since the "
+                "last summary publish")
+
+        def _export() -> Dict:
+            span = events.start_span("serve.peer_export", category="serve",
+                                     prompt_tokens=len(toks))
+            try:
+                covered, spans = eng.export_kv_blocks(toks, max_chunks=cap)
+                if not spans:
+                    raise LookupError("prefix evicted under the export")
+                payload = pack_kv_spans(spans)
+                out: Dict[str, Any] = {"covered": covered, "chunk": C,
+                                       "fp": int(live_fp)}
+                try:
+                    import ray_tpu
+                    out["ref"] = ray_tpu.put(payload)
+                except Exception:
+                    out["payload"] = payload
+                self._m_fabric.inc(tags={"kind": "export"})
+                span.set(covered=covered, payload_bytes=len(payload))
+                return out
+            finally:
+                span.end()
+
+        def _relay(out: Dict, nodes: set) -> bool:
+            ref = out.get("ref")
+            try:
+                import ray_tpu
+                nodes = {n for n in nodes
+                         if n and n != ray_tpu.get_runtime_context()
+                         .get("node_id")}
+            except Exception:
+                return False
+            if ref is None or len(nodes) < cfg.kv_fabric_relay_min:
+                return False
+            # binomial fan-out over the data plane: <= log2(K)+1 hops,
+            # each arrival emits store.broadcast events the edge probe
+            # asserts on. After this the waiters' ray_tpu.get(ref) is a
+            # local-arena read.
+            ray_tpu.broadcast_weights(ref, node_ids=sorted(nodes))
+            out["relayed"] = len(nodes)
+            self._m_fabric.inc(tags={"kind": "relayed"})
+            return True
+
+        out = self._singleflight.run(
+            int(live_fp), _export, node_id=node_id,
+            timeout_s=self._handoff_timeout_s, relay=_relay)
+        rpc._maybe_inject_failure("peer_export")
+        return out
+
+    # ------------------------------------------------- fabric: importer
+    def _replica_id(self) -> Optional[str]:
+        try:
+            import ray_tpu
+            return ray_tpu.get_runtime_context().get("actor_id")
+        except Exception:
+            return None
+
+    def _node_id(self) -> Optional[str]:
+        try:
+            import ray_tpu
+            return ray_tpu.get_runtime_context().get("node_id")
+        except Exception:
+            return None
+
+    def _peer_summaries(self) -> List[Dict]:
+        if self._summaries_fn is not None:
+            return self._summaries_fn() or []
+        import ray_tpu
+        return ray_tpu._get_worker().gcs_call(
+            "get_prefix_summaries") or []
+
+    def _peer_candidates(self, toks: List[int], C: int, cap: int,
+                         hint: Optional[Dict]
+                         ) -> List[Tuple[str, Any, int]]:
+        """Peers that claim to cover this prompt, deepest first:
+        ``[(replica_id, callable_peer, depth_chunks)]``. The router's
+        ``__serve_peer_hint`` (if any) ranks first at its claimed depth;
+        the rest come from published summaries. A replica_id without an
+        injected direct object resolves to a raw ActorHandle speaking
+        the replica's ``handle_request`` protocol — no controller hop."""
+        from ray_tpu.inference.prefix_cache import chunk_fingerprints
+        fps = chunk_fingerprints(toks, C, max_chunks=cap)
+        if not fps:
+            return []
+        me = self._replica_id()
+        ranked: List[Tuple[str, int]] = []
+        seen = set()
+        if hint and hint.get("replica_id") and hint["replica_id"] != me:
+            d = min(cap, max(1, int(hint.get("depth") or 0) // C or cap))
+            ranked.append((hint["replica_id"], d))
+            seen.add(hint["replica_id"])
+        try:
+            rows = self._peer_summaries()
+        except Exception:
+            rows = []
+        scored = []
+        for row in rows:
+            rid = row.get("replica_id")
+            if not rid or rid == me or rid in seen:
+                continue
+            if int(row.get("chunk") or 0) != C:
+                continue
+            s = set(row.get("fps") or ())
+            d = 0
+            for j, fp in enumerate(fps):
+                if fp in s:
+                    d = j + 1
+            if d:
+                scored.append((d, rid))
+        scored.sort(reverse=True)
+        ranked.extend((rid, d) for d, rid in scored)
+        out: List[Tuple[str, Any, int]] = []
+        for rid, d in ranked:
+            peer = self._peers.get(rid)
+            if peer is None:
+                try:
+                    from ray_tpu.actor import ActorHandle
+                    peer = ActorHandle(rid, ["handle_request"])
+                except Exception:
+                    continue
+            out.append((rid, peer, d))
+        return out
+
+    def _call_peer(self, peer, toks: List[int], max_chunks: int,
+                   want_fp: Optional[int]) -> Dict:
+        kw = {"max_chunks": max_chunks, "want_fp": want_fp,
+              "node_id": self._node_id()}
+        fn = getattr(peer, "peer_export", None)
+        if fn is not None and not hasattr(fn, "remote"):
+            return fn(toks, **kw)            # direct object (tests/bench)
+        if fn is not None and hasattr(fn, "remote"):
+            return fn.remote(toks, **kw).result(
+                timeout=self._handoff_timeout_s)
+        # raw replica ActorHandle: speak the replica protocol
+        import ray_tpu
+        ref = peer.handle_request.remote("peer_export", (toks,), kw)
+        return ray_tpu.get(ref, timeout=self._handoff_timeout_s)
+
+    def _import_from_peers(self, toks: List[int], C: int, want: int,
+                           hint: Optional[Dict], req_span) -> int:
+        """The fabric rung: try the deepest-covering peers (at most
+        two) and import whatever spans arrive. Raises when no peer
+        delivers — the caller falls down the ladder."""
+        eng = self.engine
+        cap = want // C
+        cands = self._peer_candidates(toks, C, cap, hint)
+        if not cands:
+            raise LookupError("no peer covers this prefix")
+        from ray_tpu.inference.prefix_cache import chunk_fingerprints
+        fps = chunk_fingerprints(toks, C, max_chunks=cap)
+        last: Optional[Exception] = None
+        for rid, peer, depth in cands[:2]:
+            d = max(1, min(depth, cap, len(fps)))
+            try:
+                out = self._call_peer(peer, toks, d, fps[d - 1])
+                if int(out.get("chunk") or 0) != C:
+                    raise ValueError(
+                        f"peer chunk={out.get('chunk')} != {C}")
+                payload = self._fetch_payload(out)
+                spans = unpack_kv_spans(payload)
+                if (spans and len(spans[0]) == 4
+                        and not getattr(eng, "_kv_quant", False)):
+                    # int8 wire into an fp pool is the ONE lossy
+                    # direction (dequantized blocks != fp-prefilled
+                    # blocks); the fabric promises greedy bit-identical,
+                    # so refuse and fall to local prefill. fp wire into
+                    # an int8 pool quantizes with the save-path math and
+                    # stays exact, so that direction imports.
+                    self._m_fabric.inc(tags={"kind": "quant_mismatch"})
+                    raise ValueError(
+                        "quantized peer wire into fp pool; refusing "
+                        "lossy import")
+                covered = min(int(out["covered"]), len(spans) * C)
+                if covered <= 0:
+                    raise LookupError("peer export came back empty")
+                imported = eng.import_kv_blocks(toks[:covered], spans)
+                self._m_fabric.inc(tags={"kind": "peer_ok"})
+                self._m_handoff_tokens.inc(max(0, imported))
+                self._m_handoff_bytes.inc(len(payload))
+                events.record_instant(
+                    "serve.kv_fabric_import", category="serve",
+                    trace_id=req_span.trace_id,
+                    parent_span_id=req_span.span_id,
+                    peer=rid, covered=covered, imported=imported,
+                    payload_bytes=len(payload))
+                return imported
+            except Exception as e:
+                last = e
+                logger.debug("peer KV import from %s failed: %s", rid, e)
+        raise last if last is not None else LookupError("no peer")
 
     # ------------------------------------------------------- hand-off
     def _call_prefill(self, toks: List[int]) -> Dict:
@@ -328,9 +672,13 @@ class DisaggLLMDeployment(LLMDeployment):
         want = (max(0, len(toks) - 1) // C) * C
         local = (eng.prefix_cache.peek(toks)
                  if eng.prefix_cache is not None else 0)
-        if (self._prefill is None or eng.prefix_cache is None
+        hint = _pop_peer_hint()
+        fabric = (self._kv_fabric and eng.prefix_cache is not None
+                  and want > 0 and local < want)
+        if ((self._prefill is None and not fabric)
+                or eng.prefix_cache is None
                 or want == 0 or local >= want):
-            # rung 2 (local hit) or rung 4 (nothing to hand off):
+            # rung 2 (local hit) or rung 5 (nothing to hand off):
             # plain colocated admission
             return super()._submit_request(
                 prompt_tokens, max_new_tokens, temperature, eos_id,
@@ -343,33 +691,54 @@ class DisaggLLMDeployment(LLMDeployment):
             "serve.kv_handoff", category="serve",
             trace_id=req_span.trace_id, parent_span_id=req_span.span_id,
             prompt_tokens=len(toks), local_tokens=local)
+        done = False
         try:
-            out = self._call_prefill(toks)
-            if int(out.get("chunk") or 0) != C:
-                raise ValueError(
-                    f"prefill tier chunk={out.get('chunk')} != {C}")
-            payload = self._fetch_payload(out)
-            spans = unpack_kv_spans(payload)
-            covered = min(int(out["covered"]), len(spans) * C)
-            imported = eng.import_kv_blocks(toks[:covered], spans)
-            self._m_handoffs.inc(tags={"outcome": "ok"})
-            self._m_handoff_tokens.inc(max(0, imported))
-            self._m_handoff_bytes.inc(len(payload))
-            hspan.end(ok=True, covered=covered, imported=imported,
-                      payload_bytes=len(payload))
-        except Exception as e:
-            # rung 4: local prefill. Nothing has streamed, so
-            # exactly-once delivery is untouched — the request simply
-            # pays the prefill it would have paid colocated.
-            self._m_handoffs.inc(tags={"outcome": "fallback"})
-            events.record_instant(
-                "serve.kv_handoff_fallback", category="serve",
-                trace_id=req_span.trace_id,
-                parent_span_id=req_span.span_id,
-                error=type(e).__name__)
-            logger.warning("KV hand-off failed; falling back to local "
-                           "prefill: %s", e)
-            hspan.end(ok=False, error=type(e).__name__)
+            # rung 3: decode→decode KV fabric — a peer replica already
+            # holding the prefix serves it directly; the prefill tier
+            # is no longer the only exporter in the cluster.
+            if fabric:
+                try:
+                    imported = self._import_from_peers(toks, C, want,
+                                                       hint, req_span)
+                    hspan.set(source="peer", imported=imported)
+                    done = True
+                except Exception as e:
+                    self._m_fabric.inc(tags={"kind": "peer_fallback"})
+                    logger.debug("KV fabric rung failed (%s); trying "
+                                 "the next rung", e)
+            if not done and self._prefill is not None:
+                # rung 4: the prefill tier fills cold prefixes on demand
+                try:
+                    out = self._call_prefill(toks)
+                    if int(out.get("chunk") or 0) != C:
+                        raise ValueError(
+                            f"prefill tier chunk={out.get('chunk')} "
+                            f"!= {C}")
+                    payload = self._fetch_payload(out)
+                    spans = unpack_kv_spans(payload)
+                    covered = min(int(out["covered"]), len(spans) * C)
+                    imported = eng.import_kv_blocks(toks[:covered], spans)
+                    self._m_handoffs.inc(tags={"outcome": "ok"})
+                    self._m_handoff_tokens.inc(max(0, imported))
+                    self._m_handoff_bytes.inc(len(payload))
+                    hspan.set(source="prefill", covered=covered,
+                              imported=imported,
+                              payload_bytes=len(payload))
+                    done = True
+                except Exception as e:
+                    self._m_handoffs.inc(tags={"outcome": "fallback"})
+                    logger.warning("KV hand-off failed; falling back to "
+                                   "local prefill: %s", e)
+                    hspan.set(error=type(e).__name__)
+            if not done:
+                # rung 5: local prefill. Nothing has streamed, so
+                # exactly-once delivery is untouched — the request
+                # simply pays the prefill it would have paid colocated.
+                events.record_instant(
+                    "serve.kv_handoff_fallback", category="serve",
+                    trace_id=req_span.trace_id,
+                    parent_span_id=req_span.span_id)
+            hspan.end(ok=done)
         finally:
             eng.release_hold(handle)
         return handle

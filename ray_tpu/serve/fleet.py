@@ -308,6 +308,11 @@ class TenantAdmission:
         self._metrics = None
         self.admitted_total: Dict[str, int] = collections.defaultdict(int)
         self.shed_total: Dict[str, int] = collections.defaultdict(int)
+        # when a token bucket backs this tenant (QuotaLeaseClient at
+        # the proxy), Retry-After derives from its actual refill
+        # deficit instead of the fixed cfg constant — a fixed constant
+        # herds every shed client into one synchronized retry wave.
+        self.retry_hint: Optional[Callable[[str], Optional[float]]] = None
 
     # ----------------------------------------------------------- quotas
     def quota(self, tenant: str) -> int:
@@ -410,7 +415,18 @@ class TenantAdmission:
         self._ensure_metrics()
         if self._metrics is not None:
             self._metrics["shed"].inc(tags={"tenant": tenant})
-        raise TenantQuotaExceeded(tenant, cfg.tenant_retry_after_s)
+        raise TenantQuotaExceeded(tenant, self._retry_after(tenant))
+
+    def _retry_after(self, tenant: str) -> float:
+        hint = self.retry_hint
+        if hint is not None:
+            try:
+                w = hint(tenant)
+                if w is not None and w > 0:
+                    return float(w)
+            except Exception:
+                pass
+        return cfg.tenant_retry_after_s
 
     def _release(self, tenant: str):
         with self._lock:
@@ -460,6 +476,234 @@ class TenantAdmission:
                 "shed_total": dict(self.shed_total),
                 "quotas": dict(self._quota),
                 "default_quota": self.default_quota,
+            }
+
+
+# ---------------------------------------------------- shared quota leases
+class TenantTokenBucket:
+    """One tenant's leased slice of the CLUSTER admission rate at one
+    proxy (ROADMAP item 2a). Pure and clock-injectable: callers pass
+    ``now`` explicitly, so the tier-1 suite drives refill arithmetic
+    hermetically. ``rate <= 0`` means unlimited (untagged traffic stays
+    zero-cost, mirroring the concurrency-quota convention)."""
+
+    __slots__ = ("rate", "burst", "tokens", "_t")
+
+    def __init__(self, rate: float, burst: float, now: float = 0.0):
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self.tokens = self.burst
+        self._t = float(now)
+
+    def _refill(self, now: float):
+        if now > self._t:
+            self.tokens = min(self.burst,
+                              self.tokens + (now - self._t) * self.rate)
+            self._t = now
+
+    def take(self, now: float) -> bool:
+        if self.rate <= 0:
+            return True
+        self._refill(now)
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+    def wait_s(self, now: float) -> float:
+        """Seconds until ONE token refills — the honest Retry-After.
+        Every shed client sees a different deficit, so retries spread
+        out instead of herding into a synchronized wave."""
+        if self.rate <= 0:
+            return 0.0
+        self._refill(now)
+        if self.tokens >= 1.0:
+            return 0.0
+        return (1.0 - self.tokens) / self.rate
+
+    def set_params(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self.tokens = min(self.tokens, self.burst)
+
+
+class QuotaLeaseClient:
+    """Proxy-side half of the GCS quota-lease protocol: N proxies
+    enforce ONE cluster-wide fair-share policy instead of N independent
+    views (ROADMAP item 2a).
+
+    The GCS owns each tenant's cluster admission rate (``tenant_quotas``
+    rows with ``rate``/``burst``, ``serve.set_tenant_quota``) and leases
+    every proxy an equal proportional share; this client turns its share
+    into local :class:`TenantTokenBucket` instances and renews on
+    ``cfg.quota_lease_interval_s`` — pushing per-tenant burn deltas up
+    (they aggregate into cluster totals for the edge bench and
+    per-tenant SLO) and adopting re-split shares whenever the lease
+    epoch moved (proxy join/leave/expire/revoke or a rate change).
+
+    Failure discipline: a proxy whose lease is REVOKED — or that cannot
+    renew for ``cfg.quota_lease_ttl_s`` — immediately degrades every
+    bucket to ``cfg.quota_lease_conservative_frac`` of its last share
+    and keeps trying to re-acquire. The GCS escrows the revoked share
+    (it stays in the split denominator) until the lease TTLs out or
+    re-acquires, so conservative local admission plus the survivors'
+    shares can never sum past the cluster budget: zero over-admission
+    by construction, which is exactly what the ``QuotaLeaseRevoker``
+    chaos asserts. Thread-safe; ``call`` is a ``gcs_call``-like
+    callable so tests inject a fake GCS."""
+
+    def __init__(self, proxy_id: str, call: Callable[..., Any],
+                 clock: Callable[[], float] = time.monotonic,
+                 on_quotas: Optional[Callable[[List[Dict]], None]] = None):
+        self.proxy_id = proxy_id
+        self._call = call
+        self._clock = clock
+        self.on_quotas = on_quotas
+        self._lock = threading.Lock()
+        self._buckets: Dict[str, TenantTokenBucket] = {}
+        self._shares: Dict[str, Dict] = {}
+        self._epoch = 0
+        self._revoked = False
+        self._acquired = False
+        self._renew_t = -1e18
+        self._last_ok_t = -1e18
+        self._burn: Dict[str, int] = collections.defaultdict(int)
+
+    # ---------------------------------------------------------- protocol
+    def acquire(self) -> bool:
+        try:
+            out = self._call("quota_lease_acquire", proxy_id=self.proxy_id)
+        except Exception:
+            logger.debug("quota lease acquire failed", exc_info=True)
+            return False
+        if not out:
+            return False
+        quotas = None
+        with self._lock:
+            quotas = self._apply_locked(out)
+            self._revoked = False
+            self._acquired = True
+            self._last_ok_t = self._clock()
+        if quotas is not None and self.on_quotas is not None:
+            try:
+                self.on_quotas(quotas)
+            except Exception:
+                pass
+        return True
+
+    def _apply_locked(self, out: Dict) -> Optional[List[Dict]]:
+        """Adopt an acquire/renew response: epoch + re-split shares.
+        Returns the piggybacked tenant_quotas rows, if any."""
+        self._epoch = int(out.get("epoch", self._epoch))
+        shares = out.get("shares")
+        if shares is not None:
+            self._shares = {t: dict(s) for t, s in shares.items()}
+            now = self._clock()
+            for t, s in shares.items():
+                b = self._buckets.get(t)
+                if b is None:
+                    self._buckets[t] = TenantTokenBucket(
+                        s["rate"], s["burst"], now=now)
+                else:
+                    b.set_params(s["rate"], s["burst"])
+            for t in list(self._buckets):
+                if t not in shares:
+                    del self._buckets[t]
+        return out.get("quotas")
+
+    def _enter_degraded_locked(self):
+        """Lease revoked or unrenewable: clamp every bucket to the
+        conservative fraction of its LAST KNOWN share until re-lease."""
+        if self._revoked:
+            return
+        self._revoked = True
+        frac = cfg.quota_lease_conservative_frac
+        for b in self._buckets.values():
+            b.set_params(b.rate * frac, b.burst * frac)
+
+    def maybe_renew(self, now: Optional[float] = None):
+        """Throttled renew/re-acquire, called from the request path (and
+        the probe loop) — no dedicated thread needed at the cadence."""
+        now = self._clock() if now is None else now
+        if now - self._renew_t < cfg.quota_lease_interval_s:
+            return
+        self._renew_t = now
+        if not self._acquired or self._revoked:
+            self.acquire()
+            return
+        with self._lock:
+            burn, self._burn = dict(self._burn), collections.defaultdict(int)
+        try:
+            out = self._call("quota_lease_renew", proxy_id=self.proxy_id,
+                             epoch=self._epoch, burn=burn)
+        except Exception:
+            logger.debug("quota lease renew failed", exc_info=True)
+            with self._lock:
+                # re-bank the deltas for the next successful push
+                for t, n in burn.items():
+                    self._burn[t] += n
+                if now - self._last_ok_t > cfg.quota_lease_ttl_s:
+                    self._enter_degraded_locked()
+            return
+        if out and out.get("revoked"):
+            with self._lock:
+                self._enter_degraded_locked()
+            return
+        quotas = None
+        with self._lock:
+            self._last_ok_t = now
+            quotas = self._apply_locked(out or {})
+        if quotas is not None and self.on_quotas is not None:
+            try:
+                self.on_quotas(quotas)
+            except Exception:
+                pass
+
+    def release(self):
+        try:
+            self._call("quota_lease_release", proxy_id=self.proxy_id)
+        except Exception:
+            pass
+
+    # --------------------------------------------------------- admission
+    def admit(self, tenant: str, now: Optional[float] = None
+              ) -> Optional[float]:
+        """``None`` = admitted (one token burned); a float = shed, retry
+        after that many seconds. Unrated tenants pass through — the
+        concurrency quota in :class:`TenantAdmission` still applies."""
+        now = self._clock() if now is None else now
+        self.maybe_renew(now)
+        with self._lock:
+            b = self._buckets.get(tenant)
+            if b is None:
+                return None
+            if b.take(now):
+                self._burn[tenant] += 1
+                return None
+            return max(0.05, b.wait_s(now))
+
+    def retry_hint(self, tenant: str) -> Optional[float]:
+        """Wired into ``TenantAdmission.retry_hint`` so queue-full sheds
+        also carry the honest refill deficit."""
+        with self._lock:
+            b = self._buckets.get(tenant)
+            if b is None or b.rate <= 0:
+                return None
+            return max(0.05, b.wait_s(self._clock()))
+
+    @property
+    def revoked(self) -> bool:
+        return self._revoked
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "proxy_id": self.proxy_id,
+                "epoch": self._epoch,
+                "revoked": self._revoked,
+                "shares": {t: dict(s) for t, s in self._shares.items()},
+                "rates": {t: b.rate for t, b in self._buckets.items()},
+                "pending_burn": dict(self._burn),
             }
 
 

@@ -534,7 +534,8 @@ class _Router:
                 pass   # controller briefly unreachable: keep holding
 
     def pick(self, model_id: str = "", session_id: str = "",
-             avoid: Optional[set] = None, prompt_tokens=None):
+             avoid: Optional[set] = None, prompt_tokens=None,
+             hint_out: Optional[Dict] = None):
         self.refresh()
         if self.prefix_routed and prompt_tokens is not None:
             self._refresh_summaries()
@@ -609,6 +610,22 @@ class _Router:
                     idx = cand[0]
             if model_id:
                 self.model_map[model_id] = idx
+            if hint_out is not None and prefix_depths:
+                # KV-fabric peer hint (serve/disagg.py): routing landed
+                # somewhere OTHER than the deepest-covering replica
+                # (session affinity / load / avoid broke the tie) — tell
+                # the chosen replica who holds the prefix so its fabric
+                # rung skips the GCS summary query
+                best = max(((d, i) for i, d in prefix_depths.items()
+                            if i != idx), default=None)
+                if best is not None and best[0] > prefix_depths.get(idx, 0):
+                    d, i = best
+                    rid = (self.replica_ids[i]
+                           if i < len(self.replica_ids) else None)
+                    if rid:
+                        hint_out["peer"] = {
+                            "replica_id": rid,
+                            "depth": d * (self._summary_chunk or 0)}
             self.inflight[idx] = self.inflight.get(idx, 0) + 1
             return idx, self.replicas[idx]
 
@@ -677,18 +694,24 @@ class DeploymentHandle:
         avoid: set = set()    # replicas that already failed this call
         from ray_tpu._private import events
         for _ in range(retry + 1):
+            hint_out: Optional[Dict] = {} if prompt is not None else None
             with events.record_span("serve.route", category="serve",
                                     deployment=self.deployment_name,
                                     app=self.app_name) as route_span:
                 idx, replica = self._router.pick(model_id, session_id,
                                                  avoid,
-                                                 prompt_tokens=prompt)
+                                                 prompt_tokens=prompt,
+                                                 hint_out=hint_out)
                 route_span.set(replica=idx)
+            call_kwargs = kwargs
+            if hint_out and hint_out.get("peer"):
+                call_kwargs = {**kwargs,
+                               "__serve_peer_hint": hint_out["peer"]}
             try:
                 if stream:
                     ref_gen = replica.handle_stream.options(
                         num_returns="streaming").remote(
-                            method, args, kwargs)
+                            method, args, call_kwargs)
                     resume = None
                     if allow_resubmit:
                         resume = self._make_stream_resume(method, args,
@@ -697,7 +720,8 @@ class DeploymentHandle:
                         ref_gen, self._router, idx, resume=resume,
                         record_chunks=self._router.resumable,
                         unpack=self._router.coalesced)
-                ref = replica.handle_request.remote(method, args, kwargs)
+                ref = replica.handle_request.remote(method, args,
+                                                    call_kwargs)
                 # one resubmit only: the retried response carries NO
                 # further resubmit, so a crash loop surfaces instead of
                 # retrying unboundedly past the caller's timeout

@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
 from typing import Dict, Optional
 
 
@@ -23,6 +24,8 @@ class HttpProxy:
         self._versions = {"routes": 0}
         self._handles = {}
         self._adm = None                       # lazy TenantAdmission
+        self._lease = None                     # lazy QuotaLeaseClient
+        self._ttft_hist = None                 # lazy per-tenant TTFT
         self._addr: Optional[str] = None
         from ray_tpu._private.worker import global_worker
         asyncio.run_coroutine_threadsafe(
@@ -76,6 +79,16 @@ class HttpProxy:
     def ready(self) -> str:
         return self._addr
 
+    def admission_stats(self) -> Dict:
+        """Admission + lease state for probes/tests (reports/edge_probe
+        asserts zero over-admission across proxies from these)."""
+        out = {"admission": None, "lease": None}
+        if self._adm is not None:
+            out["admission"] = self._adm.stats()
+        if self._lease is not None:
+            out["lease"] = self._lease.stats()
+        return out
+
     def _handle_for(self, app_name: str):
         h = self._handles.get(app_name)
         if h is None:
@@ -89,7 +102,31 @@ class HttpProxy:
         if self._adm is None:
             from ray_tpu.serve.fleet import TenantAdmission
             self._adm = TenantAdmission()
+            lease = self._lease_client()
+            if lease is not None:
+                self._adm.retry_hint = lease.retry_hint
         return self._adm
+
+    def _lease_client(self):
+        """Lazy QuotaLeaseClient (serve/fleet.py): this proxy's share of
+        every tenant's CLUSTER admission rate, leased from the GCS so N
+        proxies enforce one fair-share policy. None when the worker is
+        not connected (hermetic tests drive TenantAdmission directly)."""
+        if self._lease is None:
+            try:
+                import ray_tpu
+                from ray_tpu.serve.fleet import QuotaLeaseClient
+                w = ray_tpu._get_worker()
+                ctx = ray_tpu.get_runtime_context()
+                pid = str(ctx.get("actor_id") or f"proxy:{id(self):x}")
+                self._lease = QuotaLeaseClient(
+                    pid, w.gcs_call,
+                    on_quotas=lambda rows: self._adm.apply_quotas(rows)
+                    if self._adm is not None else None)
+                self._lease.acquire()
+            except Exception:
+                return None
+        return self._lease
 
     @staticmethod
     def _fetch_quotas():
@@ -109,18 +146,49 @@ class HttpProxy:
         """Blocking fair-share admission (serve/fleet.py): runs on an
         executor thread, never this event loop. Raises
         TenantQuotaExceeded for over-quota work — mapped to 429 +
-        Retry-After by the caller."""
+        Retry-After by the caller. Two gates in order: this proxy's
+        leased share of the tenant's CLUSTER rate (token bucket, the
+        cheap check), then the local concurrency quota + DRR queue."""
         adm = self._admission()
+        lease = self._lease_client()
+        if lease is not None and tenant:
+            wait = lease.admit(tenant)
+            if wait is not None:
+                from ray_tpu.serve.fleet import TenantQuotaExceeded
+                adm.shed_total[tenant] += 1
+                raise TenantQuotaExceeded(tenant, wait)
         adm.maybe_refresh(self._fetch_quotas)
         return adm.acquire(tenant)
 
     @staticmethod
     def _shed_response(e):
         from aiohttp import web
+        # sub-second precision: the refill-deficit hint loses its
+        # de-herding value if every response rounds up to the same
+        # integer second
+        retry = max(0.05, float(e.retry_after_s))
         return web.Response(
             status=429,
             text=f"tenant {e.tenant!r} over quota",
-            headers={"Retry-After": str(max(1, int(e.retry_after_s)))})
+            headers={"Retry-After": f"{retry:.3f}"})
+
+    def _record_ttft(self, tenant: str, dt_s: float):
+        """Per-tenant time-to-first-byte as THIS tenant experienced it
+        at the ingress (queueing + routing + prefill included) — the
+        observation series the per-tenant SLO burn rows (serve/slo.py
+        evaluate_tenant_slo) are evaluated against."""
+        try:
+            if self._ttft_hist is None:
+                from ray_tpu.util.metrics import Histogram
+                self._ttft_hist = Histogram(
+                    "serve_tenant_ttft_ms",
+                    "ingress-observed time to first byte per tenant",
+                    boundaries=[1.0, 5.0, 25.0, 100.0, 500.0, 2000.0],
+                    tag_keys=("tenant",))
+            self._ttft_hist.observe(dt_s * 1000.0,
+                                    tags={"tenant": tenant or "default"})
+        except Exception:
+            pass
 
     @staticmethod
     def _incoming_trace(request):
@@ -185,11 +253,13 @@ class HttpProxy:
                                  trace_id=trace_id, parent_span_id=parent,
                                  method=request.method, path=path,
                                  app=app_name, tenant=tenant or None)
+        t0 = time.monotonic()
         if (request.headers.get("X-RayTPU-Stream") == "1"
                 or "text/event-stream" in request.headers.get("Accept", "")):
             try:
                 return await self._handle_streaming(request, handle,
-                                                    payload, span)
+                                                    payload, span,
+                                                    tenant=tenant, t0=t0)
             finally:
                 lease.release()
 
@@ -206,12 +276,15 @@ class HttpProxy:
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
         finally:
             lease.release()
+        self._record_ttft(tenant, time.monotonic() - t0)
         span.end(status=200)
         if isinstance(result, (dict, list)):
             return web.json_response(result)
         return web.Response(text=str(result))
 
-    async def _handle_streaming(self, request, handle, payload, span):
+    async def _handle_streaming(self, request, handle, payload, span,
+                                tenant: str = "",
+                                t0: Optional[float] = None):
         """Streaming ingress: drive the deployment's streaming handle on
         an executor thread and relay each chunk as one NDJSON line. A
         client that disconnects mid-stream closes the replica-side
@@ -263,9 +336,13 @@ class HttpProxy:
         await resp.prepare(request)
         producer = loop.run_in_executor(None, _produce)
         try:
+            first = True
             while True:
                 kind, item = await q.get()
                 if kind == "batch":
+                    if first and t0 is not None:
+                        self._record_ttft(tenant, time.monotonic() - t0)
+                        first = False
                     # one write per coalesced frame, one NDJSON line per
                     # item — the client-visible protocol is unchanged
                     await resp.write("".join(

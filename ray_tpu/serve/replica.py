@@ -98,6 +98,20 @@ class Replica:
         (preemption pickup)."""
         return {"queue_len": self._ongoing, "draining": self._draining}
 
+    @staticmethod
+    def _stash_peer_hint(kwargs: Dict):
+        """Routing metadata from the handle's prefix router: which OTHER
+        replica covers this prompt deepest. Parked in a thread-local for
+        the decode tier's KV-fabric rung (serve/disagg.py) — advisory,
+        so any failure here just costs the optimization."""
+        hint = kwargs.pop("__serve_peer_hint", None)
+        if hint is not None:
+            try:
+                from ray_tpu.serve.disagg import set_peer_hint
+                set_peer_hint(hint)
+            except Exception:
+                pass
+
     def handle_request(self, method: str, args: Tuple, kwargs: Dict):
         import ray_tpu
         from ray_tpu import ObjectRef
@@ -113,6 +127,7 @@ class Replica:
                   for k, v in kwargs.items()}
         model_id = kwargs.pop("__serve_model_id", "")
         kwargs.pop("__serve_tenant", "")   # routing metadata, not an arg
+        Replica._stash_peer_hint(kwargs)
         from ray_tpu._private import events
         with self._lock:
             self._ongoing += 1
@@ -171,6 +186,7 @@ class Replica:
                 "replica is draining (preemption notice); re-route")
         model_id = kwargs.pop("__serve_model_id", "")
         kwargs.pop("__serve_tenant", "")
+        Replica._stash_peer_hint(kwargs)
         with self._lock:
             self._ongoing += 1
         # the body's first resumption runs under the streaming task's

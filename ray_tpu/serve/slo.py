@@ -131,6 +131,55 @@ def evaluate_slo(slo, query: Callable[..., Dict]) -> List[Dict]:
     return out
 
 
+def evaluate_tenant_slo(slo, query: Callable[..., Dict],
+                        tenants: List[str]) -> List[Dict]:
+    """Per-tenant burn rows (ROADMAP item 2d): the deployment's latency
+    objective re-evaluated against each tenant's OWN observations
+    (``tenant_latency_metric``, default the proxy-recorded
+    ``serve_tenant_ttft_ms``, filtered by ``tags={"tenant": ...}``).
+    Rows carry ``"tenant"`` and feed the same :class:`BurnRateScaler`
+    input list as the aggregate rows — the scaler takes the max burn
+    across rows, so ONE tenant burning its budget raises the deployment
+    target even while the aggregate p95 looks healthy: tenancy shapes
+    capacity, not just admission. A tenant with no samples in either
+    window burns 0 and is dropped (absent ≠ violating)."""
+    threshold = _cfg_get(slo, "threshold_ms")
+    if threshold is None:
+        threshold = _cfg_get(slo, "p95_ttft_ms")
+    if threshold is None or not tenants:
+        return []
+    budget = float(_cfg_get(slo, "budget_fraction", 0.05) or 0.05)
+    metric = _cfg_get(slo, "tenant_latency_metric",
+                      "serve_tenant_ttft_ms")
+    fast_w = float(_cfg_get(slo, "fast_window_s", 30.0) or 30.0)
+    slow_w = float(_cfg_get(slo, "slow_window_s", 120.0) or 120.0)
+    burn_thr = float(_cfg_get(slo, "burn_threshold", 1.0) or 1.0)
+    out: List[Dict] = []
+    for tenant in tenants:
+        burns = {}
+        seen = False
+        for label, w in (("fast", fast_w), ("slow", slow_w)):
+            r = query(metric, window=w, agg="frac_over",
+                      threshold=float(threshold),
+                      tags={"tenant": tenant})
+            frac = r.get("value")
+            seen = seen or frac is not None
+            burns[label] = (frac or 0.0) / budget
+        if not seen:
+            continue
+        out.append({
+            "objective": "tenant_latency", "tenant": tenant,
+            "metric": metric, "target": float(threshold),
+            "budget_fraction": budget,
+            "burn_fast": round(burns["fast"], 4),
+            "burn_slow": round(burns["slow"], 4),
+            "violating": (burns["fast"] > burn_thr
+                          and burns["slow"] > burn_thr),
+            "windows": [fast_w, slow_w],
+        })
+    return out
+
+
 class BurnRateScaler:
     """Burn-driven replica-target policy — the consumer of the rows
     ``evaluate_slo`` produces (ROADMAP item 2's "control loop
@@ -234,17 +283,55 @@ class SloTracker:
                     "slo_violating",
                     "1 while both burn windows exceed the threshold",
                     tag_keys=("app", "deployment", "objective")),
+                "tenant_burn": Gauge(
+                    "slo_tenant_burn_rate",
+                    "per-tenant error-budget burn rate (slow window)",
+                    tag_keys=("app", "deployment", "tenant")),
             }
         return self._gauges
 
     def update(self, app: str, deployment: str, slo,
-               query: Callable[..., Dict]) -> List[Dict]:
-        """Evaluate + publish. Returns the evaluation rows (surfaced via
-        the controller's get_slo_status)."""
+               query: Callable[..., Dict],
+               tenants: Optional[List[str]] = None) -> List[Dict]:
+        """Evaluate + publish. Returns the evaluation rows — aggregate
+        objectives first, then per-tenant rows when ``tenants`` is
+        given (surfaced via the controller's get_slo_status; the whole
+        list feeds BurnRateScaler, so tenant burn shapes capacity)."""
         from ray_tpu._private import events
         rows = evaluate_slo(slo, query)
         g = self._ensure_gauges()
+        if tenants:
+            trows = evaluate_tenant_slo(slo, query, tenants)
+            for row in trows:
+                g["tenant_burn"].set(
+                    row["burn_slow"],
+                    tags={"app": app, "deployment": deployment,
+                          "tenant": row["tenant"]})
+                key = (app, deployment, "tenant:" + row["tenant"])
+                was = self._violating.get(key, False)
+                self._violating[key] = row["violating"]
+                if row["violating"] and not was:
+                    events.record_instant(
+                        "slo.violation", category="serve", app=app,
+                        deployment=deployment, objective="tenant_latency",
+                        tenant=row["tenant"], target=row["target"],
+                        burn_fast=row["burn_fast"],
+                        burn_slow=row["burn_slow"])
+                    logger.warning(
+                        "tenant SLO violation: %s/%s tenant=%s burn "
+                        "fast=%.2f slow=%.2f", app, deployment,
+                        row["tenant"], row["burn_fast"], row["burn_slow"])
+                elif was and not row["violating"]:
+                    events.record_instant(
+                        "slo.recovered", category="serve", app=app,
+                        deployment=deployment, objective="tenant_latency",
+                        tenant=row["tenant"],
+                        burn_fast=row["burn_fast"],
+                        burn_slow=row["burn_slow"])
+            rows = rows + trows
         for row in rows:
+            if row.get("tenant"):
+                continue   # published above with tenant tags
             tags = {"app": app, "deployment": deployment,
                     "objective": row["objective"]}
             g["burn"].set(row["burn_fast"], tags={**tags, "window": "fast"})

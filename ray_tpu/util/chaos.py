@@ -209,6 +209,52 @@ class PrefillExportKiller:
         rpc._CHAOS_SPEC = None
 
 
+class PeerExportKiller:
+    """Injects failure into the decode→decode KV fabric: a decode
+    replica's ``peer_export`` (serve/disagg.py) runs the injection hook
+    at entry AND right before returning, so with probability ``p`` an
+    export dies either before the live-trie fingerprint check or AFTER
+    the payload exists but before the peer receives it — the two halves
+    of "peer replica killed mid-export". The importing replica must
+    fall down its ladder (prefill hand-off, then LOCAL prefill) with
+    exactly-once token delivery preserved.
+
+    Spec: ``RAY_TPU_TESTING_RPC_FAILURE="peer_export=p"``; like the
+    other RPC-chaos specs it must be in the environment BEFORE the
+    victim process parses it. Compose with :class:`ServeReplicaKiller`
+    on the decode deployment for the actor-death variant."""
+
+    SPEC_ENV = "RAY_TPU_TESTING_RPC_FAILURE"
+
+    def __init__(self, probability: float = 1.0):
+        if not 0.0 < probability <= 1.0:
+            raise ValueError("probability must be in (0, 1]")
+        self.probability = probability
+
+    def spec(self) -> str:
+        return f"peer_export={self.probability}"
+
+    def env(self, base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+        e = dict(base if base is not None else os.environ)
+        prior = e.get(self.SPEC_ENV)
+        e[self.SPEC_ENV] = f"{prior},{self.spec()}" if prior else self.spec()
+        return e
+
+    def arm_local(self):
+        """Arm the CURRENT process (direct-instantiation tests): sets
+        the env var and resets rpc.py's parsed-spec cache so the next
+        injection check re-reads it. Pair with :meth:`disarm_local`."""
+        from ray_tpu._private import rpc
+        os.environ[self.SPEC_ENV] = self.spec()
+        rpc._CHAOS_SPEC = None
+
+    @staticmethod
+    def disarm_local():
+        from ray_tpu._private import rpc
+        os.environ.pop(PeerExportKiller.SPEC_ENV, None)
+        rpc._CHAOS_SPEC = None
+
+
 class ShellAttachKiller:
     """Injects failure into the fleet plane's cold-start path: a
     pre-warmed replica shell's ``attach`` (serve/fleet.py ReplicaShell)
@@ -536,4 +582,93 @@ class ServeReplicaKiller:
             except Exception:
                 pass
             time.sleep(0.5)
+        return False
+
+
+class QuotaLeaseRevoker:
+    """Revoke a proxy's tenant-quota lease at the GCS mid-traffic and
+    assert the no-over-admission invariant of the lease protocol
+    (serve/fleet.py QuotaLeaseClient + _private/gcs.py quota_lease_*):
+
+      * the GCS ESCROWS the revoked share — the lease row stays in the
+        denominator of the per-proxy split, so surviving proxies' shares
+        do NOT grow while the revoked proxy may still be admitting;
+      * the revoked proxy learns of the revocation on its next renew and
+        degrades every local bucket to ``quota_lease_conservative_frac``
+        of its last share (strictly below the escrowed share), so the
+        cluster-wide admitted rate can only FALL during the window;
+      * the proxy re-acquires on a later renew tick and is restored to a
+        full (re-split) share — degradation is transient, not sticky.
+
+    Unlike the RPC-failure killers this is not env-spec injection: the
+    action is a real ``quota_lease_revoke`` control call against a live
+    GCS, so the revoker holds a ``gcs_call``-style callable (e.g.
+    ``worker.gcs_call`` or a test's fake-GCS shim)."""
+
+    def __init__(self, gcs_call, seed: int = 0):
+        self._call = gcs_call
+        self.revoked: List[str] = []
+        self._rng = random.Random(seed)
+
+    def status(self) -> Dict:
+        """Raw ``quota_lease_status`` row: epoch, lease table (with
+        per-row ``revoked`` flags), cluster tenant burn totals."""
+        return self._call("quota_lease_status") or {}
+
+    def lease_ids(self, live_only: bool = True) -> List[str]:
+        rows = self.status().get("leases") or []
+        return [r["proxy_id"] for r in rows
+                if not (live_only and r.get("revoked"))]
+
+    def revoke(self, proxy_id: str) -> bool:
+        """Revoke one proxy's lease. Returns False when the GCS has no
+        such lease (already expired/released)."""
+        ok = bool(self._call("quota_lease_revoke", proxy_id=proxy_id))
+        if ok:
+            self.revoked.append(proxy_id)
+        return ok
+
+    def revoke_one(self) -> Optional[str]:
+        """Revoke a random live lease; returns its proxy_id or None when
+        no live lease exists."""
+        ids = self.lease_ids(live_only=True)
+        if not ids:
+            return None
+        pid = self._rng.choice(sorted(ids))
+        return pid if self.revoke(pid) else None
+
+    def wait_for_degraded(self, lease_client, timeout_s: float = 15.0,
+                          poke=None) -> bool:
+        """Block until ``lease_client`` (the victim proxy's
+        QuotaLeaseClient) has observed the revocation and entered
+        conservative mode. The client only learns on a renew, and
+        renews ride the request path — pass ``poke`` (a zero-arg
+        callable, e.g. ``lambda: client.maybe_renew(now)``) to drive
+        ticks when no traffic is flowing."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if poke is not None:
+                try:
+                    poke()
+                except Exception:
+                    pass
+            if lease_client.revoked:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def wait_for_release(self, lease_client, timeout_s: float = 15.0,
+                         poke=None) -> bool:
+        """Block until the victim has re-acquired a live lease (revoked
+        flag cleared) — the restore half of the chaos round-trip."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if poke is not None:
+                try:
+                    poke()
+                except Exception:
+                    pass
+            if not lease_client.revoked and lease_client.stats()["epoch"]:
+                return True
+            time.sleep(0.05)
         return False

@@ -19,7 +19,15 @@ max_new_tokens both vary per request), measures:
 
 Usage: python serve_probe.py --one '{"model": "tiny", "n_slots": 8,
                                      "n_requests": 24}'
+       python serve_probe.py --one '{...}' --proxies 2
 Prints one line: RESULT {json}
+
+``--proxies N`` (or ``spec["proxies"]``) is the multi-proxy workload
+mode: requests round-robin through N real TenantAdmission edges (the
+proxy ingress gate) before reaching the engine, and the result gains a
+``per_proxy`` section (requests/tokens/ttft_p95 per edge) plus
+``proxy_spread`` = (max - min) / mean of per-proxy tokens — the
+horizontal-edge companion of reports/edge_probe.py's quota-lease bench.
 
 "tiny" is a CPU-sized debug config: unlike the MFU/decode probes this
 one runs without a TPU (the continuous-vs-static comparison is
@@ -93,9 +101,16 @@ def _workload(spec, rng):
     return reqs, arrivals
 
 
-def _run_continuous(engine, reqs, arrivals):
-    """Submit at Poisson offsets; returns (tokens_per_s, handles)."""
+def _run_continuous(engine, reqs, arrivals, edges=None):
+    """Submit at Poisson offsets; returns (tokens_per_s, handles).
+
+    With ``edges`` (a list of real TenantAdmission gates — the
+    multi-proxy mode), request i enters through edge ``i % N`` first
+    and holds its concurrency lease until its stream drains, exactly
+    like HttpProxy does; quotas are unlimited so admission adds its
+    true per-request cost without shedding anything."""
     handles = [None] * len(reqs)
+    leases = [None] * len(reqs)
 
     def submitter():
         t0 = time.perf_counter()
@@ -103,17 +118,21 @@ def _run_continuous(engine, reqs, arrivals):
             delay = at - (time.perf_counter() - t0)
             if delay > 0:
                 time.sleep(delay)
+            if edges:
+                leases[i] = edges[i % len(edges)].acquire("default")
             handles[i] = engine.submit(r["prompt"],
                                        max_new_tokens=r["new"])
     t_start = time.perf_counter()
     th = threading.Thread(target=submitter)
     th.start()
     th.join()
-    total = 0
-    for h in handles:
-        total += len(h.tokens())          # drains to completion
+    counts = []
+    for i, h in enumerate(handles):
+        counts.append(len(h.tokens()))    # drains to completion
+        if leases[i] is not None:
+            leases[i].release()
     wall = time.perf_counter() - t_start
-    return total / wall, handles
+    return sum(counts) / wall, handles, counts
 
 
 def _ttfts_ms(handles):
@@ -271,11 +290,20 @@ def run(spec):
     # warmup: compile all engine programs on a short request
     list(engine.submit(reqs[0]["prompt"][:4], max_new_tokens=2))
 
-    rates, all_handles = [], []
+    n_proxies = int(spec.get("proxies", 0))
+    edges = None
+    if n_proxies >= 2:
+        from ray_tpu.serve.fleet import TenantAdmission
+        edges = [TenantAdmission(default_quota=0)
+                 for _ in range(n_proxies)]
+
+    rates, all_handles, all_counts = [], [], []
     for _ in range(spec.get("runs", 3)):
-        rate, handles = _run_continuous(engine, reqs, arrivals)
+        rate, handles, counts = _run_continuous(engine, reqs, arrivals,
+                                                edges=edges)
         rates.append(rate)
         all_handles.extend(handles)
+        all_counts.extend(counts)
     stats = engine.stats()
     compile_count = stats["decode_compile_count"]
     engine.stop()
@@ -302,6 +330,32 @@ def run(spec):
         "vs_static": round(med / static_rate, 3) if static_rate else None,
         "decode_compile_count": compile_count,
     }
+    if edges:
+        # per-proxy spread: the round-robin edge assignment repeats
+        # each run, so global handle index modulo the request count
+        # recovers request index, and THAT modulo N the proxy
+        per = {}
+        per_tokens = []
+        for j in range(n_proxies):
+            mine = [g for g in range(len(all_handles))
+                    if (g % len(reqs)) % n_proxies == j]
+            hs = [all_handles[g] for g in mine]
+            toks = sum(all_counts[g] for g in mine)
+            ts = sorted(_ttfts_ms(hs))
+            per[f"p{j}"] = {
+                "requests": len(hs), "tokens": toks,
+                "admitted": edges[j].admitted_total.get("default", 0),
+                "shed": sum(edges[j].shed_total.values()),
+                "ttft_p95_ms": round(_p(ts, 0.95), 1)}
+            per_tokens.append(toks)
+        mean_tok = sum(per_tokens) / len(per_tokens)
+        result.update({
+            "proxies": n_proxies,
+            "per_proxy": per,
+            "proxy_spread": round(
+                (max(per_tokens) - min(per_tokens)) / mean_tok, 3)
+            if mean_tok else None,
+        })
     if shared_k:
         # hit/miss TTFT split (the radix cache's reason to exist: a hit
         # skips the shared prefix's prefill entirely) + the same
@@ -313,7 +367,7 @@ def run(spec):
         list(base.submit(reqs[0]["prompt"][:4], max_new_tokens=2))
         base_rates = []
         for _ in range(spec.get("runs", 3)):
-            r0, _h = _run_continuous(base, reqs, arrivals)
+            r0, _h, _c = _run_continuous(base, reqs, arrivals)
             base_rates.append(r0)
         base.stop()
         base_rates.sort()
@@ -392,4 +446,6 @@ if __name__ == "__main__":
         if "--one" in args else {}
     if "--sharded" in args:
         spec.setdefault("sharded", True)
+    if "--proxies" in args:
+        spec.setdefault("proxies", int(args[args.index("--proxies") + 1]))
     print("RESULT " + json.dumps(run(spec)), flush=True)
